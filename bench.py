@@ -1,0 +1,153 @@
+"""Benchmark: train throughput (imgs/sec/chip) of the jitted DP train step.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+`vs_baseline` compares against a reference-style step measured ON THE SAME
+HARDWARE: per-sample CPU-side forward noising (float64, like
+dataset/data_loader.py:92-110) + an un-donated, eager-dispatch update — i.e.
+the reference's host-loop structure with our model. The reference repo
+itself publishes no numbers (BASELINE.md), so the baseline is self-measured.
+
+Usage: python bench.py [preset] [steps]   (default: tiny64 30 steps on the
+real chip; base128/paper256 for the ladder).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build(preset_name: str):
+    from novel_view_synthesis_3d_tpu.config import get_preset, MeshConfig
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.diffusion import make_schedule
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+    from novel_view_synthesis_3d_tpu.train.state import create_train_state
+    from novel_view_synthesis_3d_tpu.train.step import make_train_step
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    cfg = get_preset(preset_name)
+    n_dev = len(jax.devices())
+    per_dev = max(1, cfg.train.batch_size // max(1, n_dev))
+    cfg = cfg.override(**{
+        "train.batch_size": per_dev * n_dev,
+        "mesh.data": n_dev,
+    })
+    mesh = mesh_lib.make_mesh(cfg.mesh)
+    batch = make_example_batch(batch_size=cfg.train.batch_size,
+                               sidelength=cfg.data.img_sidelength)
+    schedule = make_schedule(cfg.diffusion)
+    model = XUNet(cfg.model)
+    state = create_train_state(cfg.train, model, _sample_model_batch(batch))
+    state = mesh_lib.replicate(mesh, state)
+    step = make_train_step(cfg, model, schedule, mesh)
+    device_batch = mesh_lib.shard_batch(mesh, batch)
+    return cfg, mesh, model, schedule, state, step, batch, device_batch
+
+
+def bench_framework(state, step, device_batch, steps: int) -> float:
+    # Warmup/compile.
+    state, m = step(state, device_batch)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, device_batch)
+    jax.block_until_ready(m["loss"])
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_reference_style(cfg, model, schedule, state, batch,
+                          steps: int) -> float:
+    """Reference-structure step: CPU float64 noising per batch + eager
+    (jit-per-call overhead avoided, but no donation, host round-trips for
+    the noised input) — the pmap-replicate pattern of train.py:132-155."""
+    import optax
+    from novel_view_synthesis_3d_tpu.train.state import make_optimizer
+    from novel_view_synthesis_3d_tpu.train.step import compute_loss
+
+    tx = make_optimizer(cfg.train)
+    params = jax.device_get(state.params)
+    opt_state = tx.init(params)
+    sqrt_acp = np.sqrt(np.cumprod(1 - np.asarray(schedule.betas, np.float64)))
+    sqrt_1macp = np.sqrt(1 - np.cumprod(1 - np.asarray(schedule.betas, np.float64)))
+    rng = np.random.default_rng(0)
+
+    def loss_fn(params, model_batch, cond_mask, noise, key):
+        eps = model.apply({"params": params}, model_batch,
+                          cond_mask=cond_mask, train=True,
+                          rngs={"dropout": key})
+        return compute_loss(eps, noise, cfg.train.loss)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    @jax.jit
+    def update(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def one_step(params, opt_state):
+        B = batch["target"].shape[0]
+        # Host-side per-sample noising, float64 (reference data_loader.py:100)
+        t = rng.integers(0, schedule.num_timesteps, size=B)
+        noise = rng.standard_normal(batch["target"].shape)
+        z = (sqrt_acp[t][:, None, None, None] * batch["target"].astype(np.float64)
+             + sqrt_1macp[t][:, None, None, None] * noise)
+        from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+            logsnr_schedule_cosine)
+        model_batch = {
+            "x": jnp.asarray(batch["x"]),
+            "z": jnp.asarray(z, dtype=jnp.float32),
+            "logsnr": jnp.asarray(
+                logsnr_schedule_cosine(t / schedule.num_timesteps),
+                dtype=jnp.float32),
+            "R1": jnp.asarray(batch["R1"]), "t1": jnp.asarray(batch["t1"]),
+            "R2": jnp.asarray(batch["R2"]), "t2": jnp.asarray(batch["t2"]),
+            "K": jnp.asarray(batch["K"]),
+        }
+        cond_mask = jnp.asarray((rng.random(B) > 0.1).astype(np.float32))
+        loss, grads = grad_fn(params, model_batch, cond_mask,
+                              jnp.asarray(noise, jnp.float32),
+                              jax.random.PRNGKey(0))
+        params, opt_state = update(params, opt_state, grads)
+        return params, opt_state, loss
+
+    params, opt_state, loss = one_step(params, opt_state)  # warmup/compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = one_step(params, opt_state)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    preset = sys.argv[1] if len(sys.argv) > 1 else "tiny64"
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    cfg, mesh, model, schedule, state, step, batch, device_batch = build(preset)
+    n_chips = max(1, len(jax.devices()))
+    B = cfg.train.batch_size
+
+    sec_fw = bench_framework(state, step, device_batch, steps)
+    imgs_per_sec_chip = B / sec_fw / n_chips
+
+    sec_ref = bench_reference_style(cfg, model, schedule, state, batch,
+                                    max(5, steps // 3))
+    ref_imgs_per_sec_chip = B / sec_ref / n_chips
+
+    print(json.dumps({
+        "metric": f"train_imgs_per_sec_per_chip_{preset}",
+        "value": round(imgs_per_sec_chip, 3),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(imgs_per_sec_chip / ref_imgs_per_sec_chip, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""novel_view_synthesis_3d_tpu — a TPU-native framework for pose-conditional
+novel view synthesis with diffusion models (3DiM-style X-UNet).
+
+Built from scratch for JAX/XLA on TPU (jit / shard_map / NamedSharding /
+Pallas), with the capability surface of the reference repo
+`shiveshkhaitan/novel_view_synthesis_3d` (see SURVEY.md): X-UNet model,
+DDPM training with classifier-free guidance, on-device ancestral sampling,
+SRN ShapeNet dataset format, distributed data-parallel training, and
+checkpoint/resume.
+"""
+
+__version__ = "0.1.0"
+
+from novel_view_synthesis_3d_tpu.config import (  # noqa: F401
+    Config,
+    DataConfig,
+    DiffusionConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+    get_preset,
+)

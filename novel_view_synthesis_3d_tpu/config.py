@@ -1,0 +1,228 @@
+"""Single config tree for the whole framework.
+
+The reference has no config system at all: hyperparameters are dataclass
+defaults (`/root/reference/model/xunet.py:207-215`), Trainer keyword defaults
+(`/root/reference/train.py:82-88`), or module constants
+(`/root/reference/sampling.py:55,66,134`), and two key model attributes
+(`ch_mult`, `attn_resolutions`) are frozen class attributes that cannot be
+overridden without editing the source. Here every knob from SURVEY.md §2.2/§5.6
+is a real, serializable field, with the BASELINE.json config ladder as presets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """X-UNet hyperparameters (reference: model/xunet.py:205-215)."""
+
+    ch: int = 32
+    ch_mult: Tuple[int, ...] = (1, 2)
+    emb_ch: int = 32
+    num_res_blocks: int = 2
+    attn_resolutions: Tuple[int, ...] = (8, 16, 32)
+    attn_heads: int = 4
+    dropout: float = 0.1
+    use_pos_emb: bool = False
+    use_ref_pose_emb: bool = False
+    # Number of conditioning frames (k in 3DiM). The reference hardcodes 1
+    # (frame axis F = k+1 = 2 throughout model/xunet.py); here it is a field.
+    num_cond_frames: int = 1
+    # --- behavior-vs-bug compat flags (SURVEY.md §7 ledger) ---
+    # Reference GroupNorm shares statistics across both frames
+    # (model/xunet.py:46-52); per-frame stats are what the architecture
+    # intends. Default True = per-frame; False reproduces reference behavior.
+    groupnorm_per_frame: bool = True
+    # Reference attention has no output projection (commented out at
+    # model/xunet.py:126). Default False matches the reference.
+    attn_out_proj: bool = False
+    # --- TPU knobs ---
+    dtype: str = "float32"  # compute dtype: "float32" | "bfloat16"
+    param_dtype: str = "float32"
+    remat: bool = False  # jax.checkpoint each UNet block (memory for FLOPs)
+
+    @property
+    def num_frames(self) -> int:
+        return self.num_cond_frames + 1
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffusionConfig:
+    """Diffusion process (reference: sampling.py:16-53,73-76, T=1000 cosine)."""
+
+    timesteps: int = 1000
+    schedule: str = "cosine"  # only cosine exists in the reference
+    cosine_s: float = 0.008
+    logsnr_min: float = -20.0
+    logsnr_max: float = 20.0
+    # Sampling
+    sample_timesteps: int = 1000  # respaced steps for the ancestral sampler
+    guidance_weight: float = 3.0  # CFG w (reference sampling.py:134)
+    clip_denoised: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """SRN-format dataset options (reference: dataset/data_loader.py:116-140)."""
+
+    root_dir: str = "cars_train_val"
+    img_sidelength: int = 64
+    max_num_instances: int = -1
+    max_observations_per_instance: int = 50
+    specific_observation_idcs: Optional[Tuple[int, ...]] = None
+    samples_per_instance: int = 1
+    # Pipeline
+    num_workers: int = 8
+    prefetch: int = 4
+    shuffle_seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training loop options (reference: train.py:82-126)."""
+
+    batch_size: int = 2  # GLOBAL batch (sharded over the data axis)
+    lr: float = 1e-4
+    num_steps: int = 100_000
+    save_every: int = 1000
+    log_every: int = 50
+    sample_every: int = 0  # 0 = never dump eval samples during training
+    seed: int = 0
+    # Per-sample probability of dropping pose conditioning for CFG
+    # (reference: train.py:64 uses 0.1, but bakes the mask at trace time).
+    cond_drop_prob: float = 0.1
+    # 'mse' (per-element mean squared error, the sane default) or 'frobenius'
+    # (reference train.py:67: L2 norm of the whole flattened residual).
+    loss: str = "mse"
+    # Optimizer
+    optimizer: str = "adam"
+    grad_clip: float = 0.0  # 0 = off
+    warmup_steps: int = 0
+    ema_decay: float = 0.0  # 0 = off; 3DiM paper uses EMA for sampling
+    results_folder: str = "./results"
+    checkpoint_dir: str = "./checkpoints"
+    resume: bool = True  # auto-resume from latest checkpoint (ref: absent)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh for distributed execution (replaces reference pmap, §2.3).
+
+    Axes: 'data' = DP (batch sharding, psum over ICI emitted by XLA),
+    'model' = reserved for TP, 'seq' = ring-attention sequence parallelism.
+    """
+
+    data: int = -1  # -1 = all remaining devices
+    model: int = 1
+    seq: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    diffusion: DiffusionConfig = dataclasses.field(default_factory=DiffusionConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    # ------------------------------------------------------------------
+    # Serialization + overrides
+    # ------------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), indent=2, **kw)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        def build(tp, sub):
+            fields = {f.name: f for f in dataclasses.fields(tp)}
+            kwargs = {}
+            for k, v in sub.items():
+                if k not in fields:
+                    raise KeyError(f"unknown config field {tp.__name__}.{k}")
+                if isinstance(v, list):
+                    v = tuple(v)
+                kwargs[k] = v
+            return tp(**kwargs)
+
+        return cls(
+            model=build(ModelConfig, d.get("model", {})),
+            diffusion=build(DiffusionConfig, d.get("diffusion", {})),
+            data=build(DataConfig, d.get("data", {})),
+            train=build(TrainConfig, d.get("train", {})),
+            mesh=build(MeshConfig, d.get("mesh", {})),
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls.from_dict(json.loads(s))
+
+    def override(self, **dotted: Any) -> "Config":
+        """Override with dotted keys: cfg.override(**{'model.ch': 64})."""
+        d = self.to_dict()
+        for key, val in dotted.items():
+            parts = key.split(".")
+            node = d
+            for p in parts[:-1]:
+                node = node[p]
+            if parts[-1] not in node:
+                raise KeyError(f"unknown config field {key}")
+            node[parts[-1]] = val
+        return Config.from_dict(d)
+
+    def apply_cli(self, argv: Sequence[str]) -> "Config":
+        """Apply 'model.ch=64'-style CLI overrides (values parsed as JSON)."""
+        overrides = {}
+        for arg in argv:
+            if "=" not in arg:
+                raise ValueError(f"override must look like key=value: {arg!r}")
+            k, v = arg.split("=", 1)
+            try:
+                overrides[k] = json.loads(v)
+            except json.JSONDecodeError:
+                overrides[k] = v  # bare string
+        return self.override(**overrides)
+
+
+# ----------------------------------------------------------------------
+# Config ladder presets (BASELINE.json "configs")
+# ----------------------------------------------------------------------
+def get_preset(name: str) -> Config:
+    """Presets for the BASELINE.json config ladder.
+
+    - 'reference': exact reference defaults incl. its behavior quirks
+      (shared-frame GroupNorm stats, Frobenius loss) for parity checks.
+    - 'tiny64':   XUnet-tiny 64px (single-host smoke; ref defaults, sane flags)
+    - 'base128':  XUnet-base 128px, ch=128, ch_mult=(1,2,2,4)
+    - 'paper256': 3DiM paper config 256px, ch=256, ch_mult=(1,2,2,4,4)
+    """
+    if name == "reference":
+        return Config(
+            model=ModelConfig(groupnorm_per_frame=False),
+            train=TrainConfig(loss="frobenius"),
+        )
+    if name == "tiny64":
+        return Config()
+    if name == "base128":
+        return Config(
+            model=ModelConfig(ch=128, ch_mult=(1, 2, 2, 4), emb_ch=512,
+                              dtype="bfloat16"),
+            data=DataConfig(img_sidelength=128),
+            train=TrainConfig(batch_size=8, ema_decay=0.9999),
+            diffusion=DiffusionConfig(sample_timesteps=256),
+        )
+    if name == "paper256":
+        return Config(
+            model=ModelConfig(ch=256, ch_mult=(1, 2, 2, 4, 4), emb_ch=1024,
+                              num_res_blocks=3, dtype="bfloat16", remat=True),
+            data=DataConfig(img_sidelength=256),
+            train=TrainConfig(batch_size=8, ema_decay=0.9999),
+            diffusion=DiffusionConfig(sample_timesteps=256),
+        )
+    raise KeyError(f"unknown preset {name!r}")

@@ -1,0 +1,17 @@
+from novel_view_synthesis_3d_tpu.data.pipeline import (  # noqa: F401
+    cycle,
+    iter_batches,
+    make_dataset,
+    make_grain_loader,
+)
+from novel_view_synthesis_3d_tpu.data.srn import (  # noqa: F401
+    SRNDataset,
+    SRNInstance,
+    load_pose,
+    load_rgb,
+    parse_intrinsics,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import (  # noqa: F401
+    make_example_batch,
+    write_synthetic_srn,
+)

@@ -1,0 +1,122 @@
+"""Input pipeline: Grain multiprocess loading with per-host sharding.
+
+Replaces the reference's torch `DataLoader` (train.py:108-113) — the one
+native-code subsystem of the reference's data path (SURVEY.md §2.4) — with
+Grain worker processes (C++-backed shared-memory queues) + deterministic
+per-host sharding, and a dependency-free in-process iterator as fallback.
+
+Design:
+  - the data source indexes (instance, view) pairs; the conditioning view is
+    the indexed record, the target view is drawn by Grain's per-record RNG
+    (deterministic in (seed, epoch, index) — reproducible across restarts,
+    unlike the reference's np.random in worker processes);
+  - records are CLEAN image pairs; forward noising runs on device in the
+    train step (SURVEY.md §7 ledger);
+  - sharding: each process reads only its 1/jax.process_count() slice —
+    the per-host Grain shards that feed
+    `jax.make_array_from_process_local_data` (parallel/mesh.shard_batch).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from novel_view_synthesis_3d_tpu.config import DataConfig
+from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+
+
+def make_dataset(cfg: DataConfig) -> SRNDataset:
+    return SRNDataset(
+        root_dir=cfg.root_dir,
+        img_sidelength=cfg.img_sidelength,
+        max_num_instances=cfg.max_num_instances,
+        max_observations_per_instance=cfg.max_observations_per_instance,
+        specific_observation_idcs=cfg.specific_observation_idcs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Grain pipeline (multiprocess, deterministic, per-host sharded)
+# ---------------------------------------------------------------------------
+class _PairSource:
+    """grain RandomAccessDataSource over flat (instance, view) indices."""
+
+    def __init__(self, dataset: SRNDataset):
+        self._ds = dataset
+
+    def __len__(self) -> int:
+        return len(self._ds)
+
+    def __getitem__(self, idx: int) -> int:
+        # Defer ALL IO to the random-map transform (which owns the rng that
+        # picks the target view); the source just passes the index through.
+        return int(idx)
+
+
+def make_grain_loader(dataset: SRNDataset, batch_size: int,
+                      *, seed: int = 0, num_workers: int = 8,
+                      num_epochs: Optional[int] = None,
+                      shard_index: Optional[int] = None,
+                      shard_count: Optional[int] = None,
+                      drop_remainder: bool = True):
+    """Grain DataLoader yielding batched numpy dicts (per-host shard)."""
+    import grain.python as pygrain
+    import jax
+
+    shard_index = jax.process_index() if shard_index is None else shard_index
+    shard_count = jax.process_count() if shard_count is None else shard_count
+
+    ds_ref = dataset
+
+    class PairTransform(pygrain.RandomMapTransform):
+        def random_map(self, idx, rng: np.random.Generator):
+            return ds_ref.pair(int(idx), rng)
+
+    sampler = pygrain.IndexSampler(
+        num_records=len(dataset),
+        shard_options=pygrain.ShardOptions(
+            shard_index=shard_index, shard_count=shard_count,
+            drop_remainder=True),
+        shuffle=True,
+        num_epochs=num_epochs,
+        seed=seed,
+    )
+    return pygrain.DataLoader(
+        data_source=_PairSource(dataset),
+        sampler=sampler,
+        operations=[
+            PairTransform(),
+            pygrain.Batch(batch_size=batch_size, drop_remainder=drop_remainder),
+        ],
+        worker_count=num_workers,
+    )
+
+
+# ---------------------------------------------------------------------------
+# In-process fallback iterator (tests, debugging, tiny datasets)
+# ---------------------------------------------------------------------------
+def iter_batches(dataset: SRNDataset, batch_size: int, *, seed: int = 0,
+                 shard_index: int = 0, shard_count: int = 1) -> Iterator[dict]:
+    """Infinite shuffled batch iterator without worker processes."""
+    rng = np.random.default_rng(seed + shard_index)
+    n = len(dataset)
+    local = np.arange(shard_index, n, shard_count)
+    while True:
+        order = rng.permutation(local)
+        for start in range(0, len(order) - batch_size + 1, batch_size):
+            records = [dataset.pair(int(i), rng)
+                       for i in order[start:start + batch_size]]
+            yield {k: np.stack([r[k] for r in records]) for k in records[0]}
+
+
+def cycle(loader) -> Iterator[dict]:
+    """Loop a (possibly finite) loader forever (reference train.py:18-21)."""
+    while True:
+        count = 0
+        for item in loader:
+            count += 1
+            yield item
+        if count == 0:
+            raise RuntimeError("empty data loader")

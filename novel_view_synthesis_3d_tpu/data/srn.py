@@ -1,0 +1,218 @@
+"""SRN ShapeNet dataset format: directory layout, intrinsics, poses, images.
+
+Format (reference dataset/data_loader.py:27-65, data_util.py:12-52,
+util.py:46-81):
+
+  root/<instance>/rgb/*.png|jpg      images (any size, square-cropped)
+  root/<instance>/pose/*.txt         4×4 cam→world pose, either 4 lines of 4
+                                     floats or one line of 16 floats
+  root/<instance>/intrinsics.txt     line 1: f cx cy _
+                                     line 2: grid barycenter (3 floats)
+                                     line 3: scale
+                                     line 4: height width
+                                     line 5 (optional): world2cam flag (int)
+
+Key deviations from the reference (deliberate, SURVEY.md §7 ledger):
+  - intrinsics are parsed ONCE per instance and cached (the reference
+    re-reads + re-parses intrinsics.txt on EVERY __getitem__,
+    data_loader.py:81-83);
+  - images are returned HWC float32 in [-1, 1] (TPU NHWC layout; the
+    reference round-trips through CHW);
+  - NO noising here: the pipeline emits clean pairs, forward diffusion runs
+    on device inside the train step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from glob import glob
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:  # cv2 gives exact INTER_AREA parity with the reference resize
+    import cv2
+
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover
+    _HAS_CV2 = False
+
+from PIL import Image
+
+IMG_EXTENSIONS = (".png", ".jpg", ".jpeg", ".JPG", ".JPEG", ".PNG")
+
+
+def glob_images(directory: str) -> List[str]:
+    paths: List[str] = []
+    for ext in ("*.png", "*.jpg", "*.jpeg", "*.JPG", "*.JPEG", "*.PNG"):
+        paths.extend(glob(os.path.join(directory, ext)))
+    return sorted(set(paths))
+
+
+def parse_intrinsics(filepath: str, trgt_sidelength: Optional[int] = None):
+    """Parse SRN intrinsics.txt → (K 3×3 f32, barycenter, scale, world2cam).
+
+    Focal length and principal point are rescaled to the target sidelength:
+    cx·S/W, cy·S/H, f·S/H (reference util.py:64-67).
+    """
+    with open(filepath, "r") as fh:
+        f, cx, cy, _ = map(float, fh.readline().split())
+        barycenter = np.array(list(map(float, fh.readline().split())),
+                              dtype=np.float32)
+        scale = float(fh.readline())
+        height, width = map(float, fh.readline().split())
+        line5 = fh.readline().strip()
+    try:
+        world2cam = bool(int(line5))
+    except ValueError:
+        world2cam = False
+
+    if trgt_sidelength is not None:
+        cx = cx / width * trgt_sidelength
+        cy = cy / height * trgt_sidelength
+        f = trgt_sidelength / height * f
+
+    K = np.array([[f, 0.0, cx], [0.0, f, cy], [0.0, 0.0, 1.0]],
+                 dtype=np.float32)
+    return K, barycenter, scale, world2cam
+
+
+def load_pose(filename: str) -> np.ndarray:
+    """4×4 cam→world pose from txt: 4 rows of 4, or one flat row of 16."""
+    with open(filename) as fh:
+        lines = fh.read().splitlines()
+    vals = [v for line in lines for v in line.split()]
+    if len(vals) < 16:
+        raise ValueError(f"pose file {filename} has {len(vals)} values, need 16")
+    return np.asarray(vals[:16], dtype=np.float32).reshape(4, 4)
+
+
+def square_center_crop(img: np.ndarray) -> np.ndarray:
+    h, w = img.shape[:2]
+    m = min(h, w)
+    ch, cw = h // 2, w // 2
+    return img[ch - m // 2: ch + m // 2, cw - m // 2: cw + m // 2]
+
+
+def load_rgb(path: str, sidelength: Optional[int] = None) -> np.ndarray:
+    """Image → HWC float32 in [-1, 1]: decode, drop alpha, square-crop,
+    INTER_AREA resize (reference data_util.py:12-24 semantics)."""
+    img = np.asarray(Image.open(path).convert("RGB"), dtype=np.float32) / 255.0
+    img = square_center_crop(img)
+    if sidelength is not None and img.shape[0] != sidelength:
+        if _HAS_CV2:
+            img = cv2.resize(img, (sidelength, sidelength),
+                             interpolation=cv2.INTER_AREA)
+        else:  # PIL BOX filter ≈ area averaging
+            pil = Image.fromarray((img * 255).astype(np.uint8))
+            pil = pil.resize((sidelength, sidelength), Image.BOX)
+            img = np.asarray(pil, dtype=np.float32) / 255.0
+    return (img - 0.5) * 2.0
+
+
+@dataclasses.dataclass
+class SRNInstance:
+    """One object instance; intrinsics parsed once and cached."""
+
+    instance_idx: int
+    instance_dir: str
+    color_paths: List[str]
+    pose_paths: List[str]
+    K: np.ndarray  # (3, 3) rescaled to the dataset sidelength
+    img_sidelength: int
+
+    def __len__(self) -> int:
+        return len(self.pose_paths)
+
+    def view(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(image HWC [-1,1], pose 4×4) for one observation."""
+        rgb = load_rgb(self.color_paths[idx], self.img_sidelength)
+        pose = load_pose(self.pose_paths[idx])
+        return rgb, pose
+
+
+def _subset(paths: List[str],
+            specific: Optional[Sequence[int]],
+            max_n: int) -> List[str]:
+    if specific is not None:
+        return [paths[i] for i in specific]
+    if max_n != -1 and len(paths) > 0:
+        idcs = np.linspace(0, len(paths), num=min(max_n, len(paths)),
+                           endpoint=False, dtype=int)
+        return [paths[i] for i in idcs]
+    return paths
+
+
+class SRNDataset:
+    """All instances of a class directory (reference SceneClassDataset,
+    data_loader.py:116-161), flat-indexed over (instance, view)."""
+
+    def __init__(self, root_dir: str, img_sidelength: int = 64,
+                 max_num_instances: int = -1,
+                 max_observations_per_instance: int = -1,
+                 specific_observation_idcs: Optional[Sequence[int]] = None):
+        self.root_dir = root_dir
+        self.img_sidelength = img_sidelength
+        instance_dirs = sorted(glob(os.path.join(root_dir, "*/")))
+        if not instance_dirs:
+            raise FileNotFoundError(f"no instances under {root_dir!r}")
+        if max_num_instances != -1:
+            instance_dirs = instance_dirs[:max_num_instances]
+
+        self.instances: List[SRNInstance] = []
+        for idx, d in enumerate(instance_dirs):
+            color = _subset(glob_images(os.path.join(d, "rgb")),
+                            specific_observation_idcs,
+                            max_observations_per_instance)
+            pose = _subset(sorted(glob(os.path.join(d, "pose", "*.txt"))),
+                           specific_observation_idcs,
+                           max_observations_per_instance)
+            if len(color) != len(pose):
+                raise ValueError(
+                    f"{d}: {len(color)} images vs {len(pose)} poses")
+            K, _, _, _ = parse_intrinsics(os.path.join(d, "intrinsics.txt"),
+                                          trgt_sidelength=img_sidelength)
+            self.instances.append(SRNInstance(
+                instance_idx=idx, instance_dir=d, color_paths=color,
+                pose_paths=pose, K=K, img_sidelength=img_sidelength))
+
+        self._sizes = np.array([len(i) for i in self.instances])
+        self._offsets = np.concatenate([[0], np.cumsum(self._sizes)])
+
+    def __len__(self) -> int:
+        return int(self._offsets[-1])
+
+    @property
+    def num_instances(self) -> int:
+        return len(self.instances)
+
+    def locate(self, flat_idx: int) -> Tuple[int, int]:
+        """flat index → (instance_idx, view_idx) via binary search (the
+        reference does a linear scan per item, data_loader.py:153-161)."""
+        obj = int(np.searchsorted(self._offsets, flat_idx, side="right") - 1)
+        return obj, int(flat_idx - self._offsets[obj])
+
+    def pair(self, flat_idx: int,
+             rng: np.random.Generator) -> dict:
+        """One training record: clean cond view (the indexed one) + a random
+        clean target view of the same instance, with poses + intrinsics.
+
+        Matches the reference's per-item semantics (data_loader.py:80-113:
+        item idx = conditioning view, uniformly random second view = target)
+        minus the CPU-side noising, which lives on device now.
+        """
+        obj, view = self.locate(flat_idx)
+        inst = self.instances[obj]
+        x, pose1 = inst.view(view)
+        view2 = int(rng.integers(len(inst)))
+        target, pose2 = inst.view(view2)
+        return {
+            "x": x.astype(np.float32),
+            "target": target.astype(np.float32),
+            "R1": pose1[:3, :3],
+            "t1": pose1[:3, 3],
+            "R2": pose2[:3, :3],
+            "t2": pose2[:3, 3],
+            "K": inst.K,
+        }

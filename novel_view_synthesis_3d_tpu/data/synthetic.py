@@ -1,0 +1,129 @@
+"""Synthetic SRN-format dataset for tests and smoke training.
+
+The reference has no test fixtures at all (SURVEY.md §4); this writes a tiny
+but REAL SRN directory tree (rgb/ pose/ intrinsics.txt) whose images are a
+deterministic function of the camera pose, so a model trained on it can
+actually reduce loss and a restored pipeline reproduces identical records.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+from PIL import Image
+
+
+def look_at_pose(cam_pos: np.ndarray, target: np.ndarray | None = None) -> np.ndarray:
+    """cam→world 4×4 with -z... camera +z looking from cam_pos toward target."""
+    target = np.zeros(3) if target is None else target
+    fwd = target - cam_pos
+    fwd = fwd / np.linalg.norm(fwd)
+    up = np.array([0.0, 0.0, 1.0])
+    right = np.cross(fwd, up)
+    if np.linalg.norm(right) < 1e-6:
+        right = np.array([1.0, 0.0, 0.0])
+    right = right / np.linalg.norm(right)
+    down = np.cross(fwd, right)
+    pose = np.eye(4, dtype=np.float32)
+    # columns: camera x (right), y (down), z (forward) in world coords
+    pose[:3, 0] = right
+    pose[:3, 1] = down
+    pose[:3, 2] = fwd
+    pose[:3, 3] = cam_pos
+    return pose
+
+
+def render_view(base_color: np.ndarray, azimuth: float, elevation: float,
+                size: int) -> np.ndarray:
+    """Cheap pose-dependent 'render': a colored blob whose position encodes
+    the camera azimuth/elevation. uint8 HWC."""
+    img = np.full((size, size, 3), 255, dtype=np.uint8)
+    cx = int((np.cos(azimuth) * 0.3 + 0.5) * size)
+    cy = int((np.sin(azimuth) * 0.3 + 0.5) * size)
+    r = max(2, int(size * (0.15 + 0.05 * np.sin(elevation))))
+    yy, xx = np.mgrid[0:size, 0:size]
+    mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= r * r
+    img[mask] = (base_color * 255).astype(np.uint8)
+    # gradient strip encoding azimuth for extra signal
+    strip = (np.linspace(0, 1, size)[None, :, None] * base_color[None, None])
+    img[: size // 8] = (strip[0, :, :] * 255).astype(np.uint8)[None]
+    return img
+
+
+def write_synthetic_srn(root: str, num_instances: int = 3,
+                        views_per_instance: int = 6, image_size: int = 64,
+                        focal: float | None = None,
+                        seed: int = 0) -> str:
+    """Create root/inst_XX/{rgb,pose,intrinsics.txt} in SRN format."""
+    rng = np.random.default_rng(seed)
+    focal = focal if focal is not None else image_size * 1.2
+    for i in range(num_instances):
+        inst = os.path.join(root, f"inst_{i:02d}")
+        os.makedirs(os.path.join(inst, "rgb"), exist_ok=True)
+        os.makedirs(os.path.join(inst, "pose"), exist_ok=True)
+        base_color = rng.uniform(0.2, 1.0, size=3)
+        with open(os.path.join(inst, "intrinsics.txt"), "w") as fh:
+            fh.write(f"{focal} {image_size / 2} {image_size / 2} 0.\n")
+            fh.write("0. 0. 0.\n")
+            fh.write("1.\n")
+            fh.write(f"{image_size} {image_size}\n")
+        for v in range(views_per_instance):
+            az = 2 * np.pi * v / views_per_instance
+            el = 0.3 + 0.1 * np.sin(v)
+            dist = 2.5
+            cam = np.array([
+                dist * np.cos(az) * np.cos(el),
+                dist * np.sin(az) * np.cos(el),
+                dist * np.sin(el),
+            ])
+            pose = look_at_pose(cam)
+            img = render_view(base_color, az, el, image_size)
+            Image.fromarray(img).save(os.path.join(inst, "rgb", f"{v:06d}.png"))
+            # alternate between 4×4 and flat-16 layouts to exercise both parsers
+            path = os.path.join(inst, "pose", f"{v:06d}.txt")
+            if v % 2 == 0:
+                np.savetxt(path, pose, fmt="%.8f")
+            else:
+                with open(path, "w") as fh:
+                    fh.write(" ".join(f"{x:.8f}" for x in pose.reshape(-1)))
+    return root
+
+
+def make_example_batch(batch_size: int = 2, sidelength: int = 64,
+                       num_cond: int = 1,
+                       seed: int = 0) -> dict:
+    """In-memory random batch with geometrically valid poses — the analogue
+    of the reference's `create_sample_data` (train.py:23-34) but with real
+    rotation matrices and intrinsics, shaped for the train step."""
+    rng = np.random.default_rng(seed)
+
+    def pose():
+        az = rng.uniform(0, 2 * np.pi)
+        cam = np.array([2.5 * np.cos(az), 2.5 * np.sin(az), 1.0])
+        return look_at_pose(cam)
+
+    f = sidelength * 1.2
+    K = np.array([[f, 0, sidelength / 2], [0, f, sidelength / 2], [0, 0, 1]],
+                 dtype=np.float32)
+    poses1 = np.stack([
+        np.stack([pose() for _ in range(num_cond)]) for _ in range(batch_size)])
+    poses2 = np.stack([pose() for _ in range(batch_size)])
+    x = rng.uniform(-1, 1, (batch_size, num_cond, sidelength, sidelength, 3))
+    if num_cond == 1:
+        x = x[:, 0]
+        R1 = poses1[:, 0, :3, :3]
+        t1 = poses1[:, 0, :3, 3]
+    else:
+        R1 = poses1[:, :, :3, :3]
+        t1 = poses1[:, :, :3, 3]
+    return {
+        "x": x.astype(np.float32),
+        "target": rng.uniform(-1, 1, (batch_size, sidelength, sidelength, 3)).astype(np.float32),
+        "R1": R1.astype(np.float32),
+        "t1": t1.astype(np.float32),
+        "R2": poses2[:, :3, :3].astype(np.float32),
+        "t2": poses2[:, :3, 3].astype(np.float32),
+        "K": np.broadcast_to(K, (batch_size, 3, 3)).copy(),
+    }

@@ -1,0 +1,7 @@
+from novel_view_synthesis_3d_tpu.diffusion.schedules import (  # noqa: F401
+    DiffusionSchedule,
+    cosine_beta_schedule,
+    logsnr_schedule_cosine,
+    make_schedule,
+    respace,
+)

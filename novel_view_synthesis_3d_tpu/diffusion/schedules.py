@@ -1,0 +1,210 @@
+"""Canonical diffusion-process math (single source of truth).
+
+The reference duplicates this math three times — forward noising inside the
+torch dataset (`/root/reference/dataset/data_loader.py:15-25,94-100`), and the
+reverse-process tables + helpers in the sampler
+(`/root/reference/sampling.py:16-53,73-76`). Here there is exactly one
+implementation, built as float64 numpy tables (matching the reference's
+float64 table construction) packed into a jit-traversable pytree, with
+`q_sample` executed **on device inside the train step** rather than on CPU in
+a data-loader worker.
+
+Math (DDPM, Nichol & Dhariwal cosine schedule, T=1000):
+  ᾱ(t) = cos²(((t/T + s)/(1 + s)) · π/2) / ᾱ(0),  β_t = 1 − ᾱ_t/ᾱ_{t−1}
+  q(z_t | x₀) = N(√ᾱ_t x₀, (1−ᾱ_t) I)
+  x̂₀ = √(1/ᾱ_t) z_t − √(1/ᾱ_t − 1) ε̂
+  q(z_{t−1} | z_t, x₀) = N(c₁ x₀ + c₂ z_t, β̃_t I),
+    c₁ = β_t √ᾱ_{t−1}/(1−ᾱ_t), c₂ = (1−ᾱ_{t−1})√α_t/(1−ᾱ_t),
+    β̃_t = β_t (1−ᾱ_{t−1})/(1−ᾱ_t)
+  logsnr(u) = −2 log tan(a·u + b), b = atan(e^{−λmax/2}),
+    a = atan(e^{−λmin/2}) − b   (u = t/T ∈ [0,1])
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+import numpy as np
+
+from novel_view_synthesis_3d_tpu.config import DiffusionConfig
+
+
+def cosine_beta_schedule(timesteps: int, s: float = 0.008) -> np.ndarray:
+    """Cosine β schedule (float64), clipped to [0, 0.9999].
+
+    Behavior-matches /root/reference/dataset/data_loader.py:15-25 (which is
+    itself the schedule of https://openreview.net/forum?id=-NEXDKk8gZ).
+    """
+    steps = timesteps + 1
+    x = np.linspace(0, timesteps, steps, dtype=np.float64)
+    alphas_cumprod = np.cos(((x / timesteps) + s) / (1 + s) * np.pi * 0.5) ** 2
+    alphas_cumprod = alphas_cumprod / alphas_cumprod[0]
+    betas = 1.0 - (alphas_cumprod[1:] / alphas_cumprod[:-1])
+    return np.clip(betas, 0.0, 0.9999)
+
+
+def logsnr_schedule_cosine(t, *, logsnr_min: float = -20.0, logsnr_max: float = 20.0):
+    """logsnr(t) for continuous t ∈ [0, 1].
+
+    Behavior-matches /root/reference/sampling.py:73-76 and
+    /root/reference/dataset/data_loader.py:94-97. Works on numpy or jnp input.
+    """
+    xp = np if isinstance(t, (float, int, np.ndarray, np.floating)) else jnp
+    b = xp.arctan(xp.exp(-0.5 * logsnr_max))
+    a = xp.arctan(xp.exp(-0.5 * logsnr_min)) - b
+    return -2.0 * xp.log(xp.tan(a * t + b))
+
+
+@flax.struct.dataclass
+class DiffusionSchedule:
+    """Precomputed per-timestep tables as a pytree of f32 device arrays.
+
+    All gather-by-t helpers take integer timestep arrays of shape (B,) (or
+    scalars) and broadcast against image tensors (B, ..., C).
+    """
+
+    betas: jnp.ndarray
+    alphas_cumprod: jnp.ndarray
+    alphas_cumprod_prev: jnp.ndarray
+    sqrt_alphas_cumprod: jnp.ndarray
+    sqrt_one_minus_alphas_cumprod: jnp.ndarray
+    sqrt_recip_alphas_cumprod: jnp.ndarray
+    sqrt_recipm1_alphas_cumprod: jnp.ndarray
+    posterior_variance: jnp.ndarray
+    posterior_log_variance_clipped: jnp.ndarray
+    posterior_mean_coef1: jnp.ndarray
+    posterior_mean_coef2: jnp.ndarray
+    # Continuous-time logsnr schedule parameters.
+    logsnr_min: float = flax.struct.field(pytree_node=False, default=-20.0)
+    logsnr_max: float = flax.struct.field(pytree_node=False, default=20.0)
+    # Map from respaced index -> original timestep (identity if not respaced);
+    # logsnr must always be evaluated at ORIGINAL t/T.
+    timestep_map: jnp.ndarray = None
+    num_original_timesteps: int = flax.struct.field(pytree_node=False, default=1000)
+
+    @property
+    def num_timesteps(self) -> int:
+        return self.betas.shape[0]
+
+    # -- indexing helper ------------------------------------------------
+    def _extract(self, table: jnp.ndarray, t, like: jnp.ndarray) -> jnp.ndarray:
+        """table[t] broadcast to rank of `like` (batch dims lead)."""
+        vals = jnp.take(table, t, axis=0)
+        return vals.reshape(vals.shape + (1,) * (like.ndim - vals.ndim))
+
+    # -- forward process ------------------------------------------------
+    def q_sample(self, x0: jnp.ndarray, t, noise: jnp.ndarray) -> jnp.ndarray:
+        """z_t = √ᾱ_t x₀ + √(1−ᾱ_t) ε  (ref data_loader.py:100, on device)."""
+        return (
+            self._extract(self.sqrt_alphas_cumprod, t, x0) * x0
+            + self._extract(self.sqrt_one_minus_alphas_cumprod, t, x0) * noise
+        )
+
+    # -- reverse process ------------------------------------------------
+    def predict_start_from_noise(self, z_t, t, noise):
+        """x̂₀ from ε̂ (ref sampling.py:43-44)."""
+        return (
+            self._extract(self.sqrt_recip_alphas_cumprod, t, z_t) * z_t
+            - self._extract(self.sqrt_recipm1_alphas_cumprod, t, z_t) * noise
+        )
+
+    def q_posterior(self, x0, z_t, t):
+        """Mean / variance / clipped-log-variance of q(z_{t−1}|z_t, x₀)
+        (ref sampling.py:46-53)."""
+        mean = (
+            self._extract(self.posterior_mean_coef1, t, z_t) * x0
+            + self._extract(self.posterior_mean_coef2, t, z_t) * z_t
+        )
+        var = self._extract(self.posterior_variance, t, z_t)
+        log_var = self._extract(self.posterior_log_variance_clipped, t, z_t)
+        return mean, var, log_var
+
+    # -- conditioning signal --------------------------------------------
+    def logsnr(self, t) -> jnp.ndarray:
+        """logsnr at (respaced) integer timestep t, evaluated at original t/T.
+
+        The reference computes logsnr at t/1000 for both training
+        (data_loader.py:110) and sampling (sampling.py:151).
+        """
+        t_orig = jnp.take(self.timestep_map, t, axis=0)
+        u = t_orig.astype(jnp.float32) / float(self.num_original_timesteps)
+        return logsnr_schedule_cosine(
+            u, logsnr_min=self.logsnr_min, logsnr_max=self.logsnr_max
+        )
+
+
+def _tables_from_betas(betas: np.ndarray) -> dict:
+    alphas = 1.0 - betas
+    alphas_cumprod = np.cumprod(alphas, axis=0)
+    alphas_cumprod_prev = np.append(1.0, alphas_cumprod[:-1])
+    posterior_variance = (
+        betas * (1.0 - alphas_cumprod_prev) / (1.0 - alphas_cumprod)
+    )
+    # log clipped: t=0 posterior variance is 0, replace with t=1's value
+    # (standard DDPM practice; matches reference sampling.py:37-38).
+    posterior_log_variance_clipped = np.log(
+        np.append(posterior_variance[1], posterior_variance[1:])
+    )
+    return dict(
+        betas=betas,
+        alphas_cumprod=alphas_cumprod,
+        alphas_cumprod_prev=alphas_cumprod_prev,
+        sqrt_alphas_cumprod=np.sqrt(alphas_cumprod),
+        sqrt_one_minus_alphas_cumprod=np.sqrt(1.0 - alphas_cumprod),
+        sqrt_recip_alphas_cumprod=np.sqrt(1.0 / alphas_cumprod),
+        sqrt_recipm1_alphas_cumprod=np.sqrt(1.0 / alphas_cumprod - 1.0),
+        posterior_variance=posterior_variance,
+        posterior_log_variance_clipped=posterior_log_variance_clipped,
+        posterior_mean_coef1=(
+            betas * np.sqrt(alphas_cumprod_prev) / (1.0 - alphas_cumprod)
+        ),
+        posterior_mean_coef2=(
+            (1.0 - alphas_cumprod_prev) * np.sqrt(alphas) / (1.0 - alphas_cumprod)
+        ),
+    )
+
+
+def make_schedule(config: DiffusionConfig) -> DiffusionSchedule:
+    if config.schedule != "cosine":
+        raise ValueError(f"unknown schedule {config.schedule!r}")
+    betas = cosine_beta_schedule(config.timesteps, s=config.cosine_s)
+    tables = {k: jnp.asarray(v, dtype=jnp.float32) for k, v in _tables_from_betas(betas).items()}
+    return DiffusionSchedule(
+        **tables,
+        logsnr_min=config.logsnr_min,
+        logsnr_max=config.logsnr_max,
+        timestep_map=jnp.arange(config.timesteps, dtype=jnp.int32),
+        num_original_timesteps=config.timesteps,
+    )
+
+
+def respace(schedule_config: DiffusionConfig, num_steps: int) -> DiffusionSchedule:
+    """Respaced schedule for fast sampling (e.g. 256 of 1000 steps).
+
+    Selects an evenly-spaced subsequence of the original timesteps and
+    rebuilds β so that ᾱ over the subsequence matches the original ᾱ at the
+    kept timesteps (the standard DDPM-respacing construction). The returned
+    schedule's `timestep_map` lets `logsnr()` keep reporting original-time
+    values, which is what the model was conditioned on during training.
+    """
+    T = schedule_config.timesteps
+    if num_steps > T:
+        raise ValueError(f"cannot respace {T} steps to {num_steps}")
+    betas = cosine_beta_schedule(T, s=schedule_config.cosine_s)
+    acp = np.cumprod(1.0 - betas, axis=0)
+    use = np.linspace(0, T - 1, num_steps).round().astype(np.int64)
+    use = np.unique(use)
+    last = 1.0
+    new_betas = []
+    for t in use:
+        new_betas.append(1.0 - acp[t] / last)
+        last = acp[t]
+    new_betas = np.asarray(new_betas, dtype=np.float64)
+    tables = {k: jnp.asarray(v, dtype=jnp.float32) for k, v in _tables_from_betas(new_betas).items()}
+    return DiffusionSchedule(
+        **tables,
+        logsnr_min=schedule_config.logsnr_min,
+        logsnr_max=schedule_config.logsnr_max,
+        timestep_map=jnp.asarray(use, dtype=jnp.int32),
+        num_original_timesteps=T,
+    )

@@ -1,0 +1,5 @@
+from novel_view_synthesis_3d_tpu.models.rays import camera_rays  # noqa: F401
+from novel_view_synthesis_3d_tpu.models.xunet import (  # noqa: F401
+    ConditioningProcessor,
+    XUNet,
+)

@@ -1,0 +1,236 @@
+"""X-UNet building blocks (clean-room Flax, TPU-first layout).
+
+Capability-matches the blocks at /root/reference/model/xunet.py:46-140 with
+two deliberate layout changes for TPU:
+
+  1. All spatial convolutions operate on (B·F, H, W, C) via 2-D `nn.Conv`
+     instead of the reference's 3-D `Conv(kernel=(1,3,3))` over (B,F,H,W,C).
+     The math is identical (the frame-axis kernel is 1), but 2-D NHWC convs
+     hit XLA:TPU's well-tuned conv→MXU path and avoid degenerate-dim layouts.
+  2. GroupNorm defaults to **per-frame** statistics (reshape to (B·F,H,W,C)).
+     The reference shares statistics across frames (xunet.py:46-52 applies
+     flax GroupNorm over the full (B,2,H,W,C) view — SURVEY.md §2.2 quirk);
+     set `per_frame=False` for bit-faithful reference behavior.
+
+Frame count F is a free dimension (the reference hardcodes F=2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from novel_view_synthesis_3d_tpu.ops.resample import (
+    avgpool_downsample,
+    nearest_neighbor_upsample,
+)
+
+nonlinearity = nn.swish
+
+INV_SQRT2 = float(1.0 / np.sqrt(2.0))
+
+
+def out_init_scale():
+    """Zero-init for output convs (reference model/xunet.py:11-12)."""
+    return nn.initializers.variance_scaling(0.0, "fan_in", "truncated_normal")
+
+
+class FrameConv(nn.Module):
+    """k×k spatial conv applied independently to every frame."""
+
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    zero_init: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: jnp.ndarray) -> jnp.ndarray:
+        B, F = h.shape[:2]
+        h = h.reshape((B * F,) + h.shape[2:])
+        h = nn.Conv(
+            self.features,
+            kernel_size=(self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            kernel_init=out_init_scale() if self.zero_init else nn.linear.default_kernel_init,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(h)
+        return h.reshape((B, F) + h.shape[1:])
+
+
+class GroupNorm(nn.Module):
+    """32-group GroupNorm over (B, F, H, W, C)."""
+
+    per_frame: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: jnp.ndarray) -> jnp.ndarray:
+        B, F, H, W, C = h.shape
+        norm = nn.GroupNorm(num_groups=32, dtype=self.dtype)
+        if self.per_frame:
+            return norm(h.reshape(B * F, H, W, C)).reshape(B, F, H, W, C)
+        # Reference-compat: statistics reduce over (F, H, W) jointly.
+        return norm(h)
+
+
+class FiLM(nn.Module):
+    """Feature-wise linear modulation (reference model/xunet.py:54-61)."""
+
+    features: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: jnp.ndarray, emb: jnp.ndarray) -> jnp.ndarray:
+        emb = nn.Dense(2 * self.features, dtype=self.dtype,
+                       param_dtype=self.param_dtype)(nonlinearity(emb))
+        scale, shift = jnp.split(emb, 2, axis=-1)
+        return h * (1.0 + scale) + shift
+
+
+class ResnetBlock(nn.Module):
+    """BigGAN-style residual block with optional 2× up/down resampling.
+
+    Reference: model/xunet.py:63-92 — GN→swish→(resample)→conv→GN→FiLM→swish→
+    dropout→zero-init conv, Dense skip projection on channel change, output
+    scaled by 1/√2.
+    """
+
+    features: Optional[int] = None
+    dropout: float = 0.0
+    resample: Optional[str] = None
+    per_frame_gn: bool = True
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h_in: jnp.ndarray, emb: jnp.ndarray, *, train: bool) -> jnp.ndarray:
+        C = h_in.shape[-1]
+        features = C if self.features is None else self.features
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+
+        h = nonlinearity(GroupNorm(per_frame=self.per_frame_gn, dtype=self.dtype)(h_in))
+        if self.resample is not None:
+            updown = {
+                "up": nearest_neighbor_upsample,
+                "down": avgpool_downsample,
+            }[self.resample]
+            h = updown(h)
+            h_in = updown(h_in)
+        h = FrameConv(features, **kw)(h)
+        h = FiLM(features=features, **kw)(
+            GroupNorm(per_frame=self.per_frame_gn, dtype=self.dtype)(h), emb)
+        h = nonlinearity(h)
+        h = nn.Dropout(rate=self.dropout)(h, deterministic=not train)
+        h = FrameConv(features, zero_init=True, **kw)(h)
+        if C != features:
+            h_in = nn.Dense(features, **kw)(h_in)
+        return (h + h_in) * INV_SQRT2
+
+
+class AttnLayer(nn.Module):
+    """Multi-head dot-product attention over token sequences.
+
+    Reference: model/xunet.py:94-103. The reference's output projection is
+    commented out (xunet.py:126); `out_proj=True` enables a zero-init
+    projection for configs that want it.
+    """
+
+    attn_heads: int = 4
+    out_proj: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, *, q: jnp.ndarray, kv: jnp.ndarray) -> jnp.ndarray:
+        C = q.shape[-1]
+        head_dim = C // self.attn_heads
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        qh = nn.DenseGeneral((self.attn_heads, head_dim), **kw)(q)
+        kh = nn.DenseGeneral((self.attn_heads, head_dim), **kw)(kv)
+        vh = nn.DenseGeneral((self.attn_heads, head_dim), **kw)(kv)
+        out = nn.dot_product_attention(qh, kh, vh)  # (B, L, heads, head_dim)
+        if self.out_proj:
+            return nn.DenseGeneral(C, axis=(-2, -1), kernel_init=out_init_scale(),
+                                   **kw)(out)
+        return out.reshape(out.shape[:-2] + (C,))
+
+
+class AttnBlock(nn.Module):
+    """Self- or cross-frame attention over flattened H·W token sequences.
+
+    Reference: model/xunet.py:105-127. A single shared AttnLayer serves all
+    frames (shared q/k/v weights). 'self': each frame attends to itself —
+    batched over B·F in one call. 'cross': frame i attends to the
+    concatenation of all *other* frames' pre-update tokens (for F=2 this is
+    exactly the reference's frame0↔frame1 exchange). Residual scaled 1/√2.
+    """
+
+    attn_type: str
+    attn_heads: int = 4
+    out_proj: bool = False
+    per_frame_gn: bool = True
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h_in: jnp.ndarray) -> jnp.ndarray:
+        B, F, H, W, C = h_in.shape
+        h = GroupNorm(per_frame=self.per_frame_gn, dtype=self.dtype)(h_in)
+        tokens = h.reshape(B, F, H * W, C)
+        layer = AttnLayer(attn_heads=self.attn_heads, out_proj=self.out_proj,
+                          dtype=self.dtype, param_dtype=self.param_dtype)
+        if self.attn_type == "self":
+            out = layer(q=tokens.reshape(B * F, H * W, C),
+                        kv=tokens.reshape(B * F, H * W, C))
+            out = out.reshape(B, F, H * W, C)
+        elif self.attn_type == "cross":
+            if F < 2:
+                raise ValueError("cross-frame attention needs F >= 2")
+            outs = []
+            for i in range(F):
+                others = [tokens[:, j] for j in range(F) if j != i]
+                kv = jnp.concatenate(others, axis=1)  # (B, (F-1)·HW, C)
+                outs.append(layer(q=tokens[:, i], kv=kv))
+            out = jnp.stack(outs, axis=1)
+        else:
+            raise NotImplementedError(self.attn_type)
+        out = out.reshape(B, F, H, W, C)
+        return (out + h_in) * INV_SQRT2
+
+
+class XUNetBlock(nn.Module):
+    """ResnetBlock + optional (self-attn, cross-attn) pair.
+
+    Reference: model/xunet.py:129-140.
+    """
+
+    features: int
+    use_attn: bool = False
+    attn_heads: int = 4
+    attn_out_proj: bool = False
+    dropout: float = 0.0
+    train: bool = False  # attribute (not call arg) so nn.remat needs no statics
+    per_frame_gn: bool = True
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, emb: jnp.ndarray) -> jnp.ndarray:
+        kw = dict(per_frame_gn=self.per_frame_gn, dtype=self.dtype,
+                  param_dtype=self.param_dtype)
+        h = ResnetBlock(features=self.features, dropout=self.dropout,
+                        **kw)(x, emb, train=self.train)
+        if self.use_attn:
+            h = AttnBlock(attn_type="self", attn_heads=self.attn_heads,
+                          out_proj=self.attn_out_proj, **kw)(h)
+            if h.shape[1] >= 2:
+                h = AttnBlock(attn_type="cross", attn_heads=self.attn_heads,
+                              out_proj=self.attn_out_proj, **kw)(h)
+        return h

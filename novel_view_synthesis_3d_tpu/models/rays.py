@@ -1,0 +1,61 @@
+"""Pinhole-camera ray generation in pure jnp (replaces visu3d).
+
+The reference depends on visu3d 1.3.0 for camera rays
+(/root/reference/model/xunet.py:159-171): it builds
+`v3d.Camera(spec=PinholeCamera(resolution=(H, W), K), world_from_cam=
+Transform(R, t)).rays()`, whose semantics are:
+
+  - pixel centers at (u + 0.5, v + 0.5) for u ∈ [0, W), v ∈ [0, H)
+  - camera-frame direction  d_cam = K⁻¹ · [u+0.5, v+0.5, 1]ᵀ
+  - world direction         d = normalize(R · d_cam)
+  - origin                  o = t   (camera position, broadcast per pixel)
+
+This module implements exactly that in ~20 lines of jnp so it is trivially
+jit/shard_map-traceable, differentiable, and free of the visu3d dependency.
+K is assumed [[f, 0, cx], [0, f, cy], [0, 0, 1]] as produced by the SRN
+`intrinsics.txt` parser (see data/srn.py), and is inverted in closed form.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def camera_rays(R: jnp.ndarray, t: jnp.ndarray, K: jnp.ndarray,
+                resolution: Tuple[int, int],
+                normalize: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-pixel world-space rays for a batch of pinhole cameras.
+
+    Args:
+      R: (..., 3, 3) cam→world rotation.
+      t: (..., 3) camera position in world frame.
+      K: (..., 3, 3) intrinsics.
+      resolution: (H, W).
+
+    Returns:
+      (pos, dir): both (..., H, W, 3); `pos` is t broadcast per pixel,
+      `dir` the (optionally normalized) world-space ray direction.
+    """
+    H, W = resolution
+    dt = R.dtype
+    v, u = jnp.meshgrid(
+        jnp.arange(H, dtype=dt) + 0.5, jnp.arange(W, dtype=dt) + 0.5,
+        indexing="ij",
+    )
+    # Closed-form K⁻¹ for K = [[fx, 0, cx], [0, fy, cy], [0, 0, 1]]:
+    # d_cam = ((u − cx)/fx, (v − cy)/fy, 1).
+    fx = K[..., 0, 0][..., None, None]
+    fy = K[..., 1, 1][..., None, None]
+    cx = K[..., 0, 2][..., None, None]
+    cy = K[..., 1, 2][..., None, None]
+    x = (u - cx) / fx
+    y = (v - cy) / fy
+    d_cam = jnp.stack([x, y, jnp.ones_like(x)], axis=-1)  # (..., H, W, 3)
+
+    d_world = jnp.einsum("...ij,...hwj->...hwi", R, d_cam)
+    if normalize:
+        d_world = d_world / jnp.linalg.norm(d_world, axis=-1, keepdims=True)
+    pos = jnp.broadcast_to(t[..., None, None, :], d_world.shape)
+    return pos, d_world

@@ -1,0 +1,8 @@
+from novel_view_synthesis_3d_tpu.ops.posenc import (  # noqa: F401
+    posenc_ddpm,
+    posenc_nerf,
+)
+from novel_view_synthesis_3d_tpu.ops.resample import (  # noqa: F401
+    avgpool_downsample,
+    nearest_neighbor_upsample,
+)

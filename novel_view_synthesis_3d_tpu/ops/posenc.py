@@ -1,0 +1,42 @@
+"""Positional encodings (DDPM sinusoidal + NeRF frequency encoding).
+
+Behavior-matches /root/reference/model/xunet.py:23-44 (clean-room jnp
+implementation). Dimension contract (SURVEY.md §2.2): with min_deg=0,
+max_deg=15 a 3-vector encodes to 3 + 3·2·15 = 93 dims; with max_deg=8 to
+3 + 3·2·8 = 51 dims; concatenated ray (origin, direction) encoding = 144.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def posenc_ddpm(timesteps: jnp.ndarray, emb_ch: int, max_time: float = 1000.0,
+                dtype=jnp.float32) -> jnp.ndarray:
+    """DDPM sinusoidal embedding of (continuous) timesteps → (..., emb_ch).
+
+    Timesteps are normalized by `max_time` then scaled by the DDPM magic 1000;
+    frequencies are the transformer 10000-base geometric ladder.
+    """
+    timesteps = timesteps * (1000.0 / max_time)
+    half_dim = emb_ch // 2
+    emb = np.log(10000.0) / (half_dim - 1)
+    emb = jnp.exp(jnp.arange(half_dim, dtype=dtype) * -emb)
+    emb = emb.reshape((1,) * timesteps.ndim + (half_dim,))
+    emb = timesteps.astype(dtype)[..., None] * emb
+    return jnp.concatenate([jnp.sin(emb), jnp.cos(emb)], axis=-1)
+
+
+def posenc_nerf(x: jnp.ndarray, min_deg: int = 0, max_deg: int = 15) -> jnp.ndarray:
+    """NeRF frequency encoding, concatenating x with sin/cos of scaled x.
+
+    Output dim = D + D·2·(max_deg − min_deg) for input dim D. The cos half is
+    computed as sin(x + π/2), matching the reference's formulation exactly.
+    """
+    if min_deg == max_deg:
+        return x
+    scales = jnp.asarray([2.0 ** i for i in range(min_deg, max_deg)], dtype=x.dtype)
+    xb = jnp.reshape(x[..., None, :] * scales[:, None], x.shape[:-1] + (-1,))
+    emb = jnp.sin(jnp.concatenate([xb, xb + np.pi / 2.0], axis=-1))
+    return jnp.concatenate([x, emb], axis=-1)

@@ -1,0 +1,29 @@
+"""Spatial up/down-sampling for frame-stacked feature maps (B, F, H, W, C).
+
+Behavior-matches /root/reference/model/xunet.py:14-21: 2× nearest-neighbor
+upsampling via broadcast (no gather — XLA lowers this to a cheap reshape
+pattern on TPU) and 2×2 average-pool downsampling.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def nearest_neighbor_upsample(h: jnp.ndarray, k: int = 2) -> jnp.ndarray:
+    """(B, F, H, W, C) → (B, F, kH, kW, C) by nearest neighbor."""
+    B, F, H, W, C = h.shape
+    h = h.reshape(B, F, H, 1, W, 1, C)
+    h = jnp.broadcast_to(h, (B, F, H, k, W, k, C))
+    return h.reshape(B, F, H * k, W * k, C)
+
+
+def avgpool_downsample(h: jnp.ndarray, k: int = 2) -> jnp.ndarray:
+    """(B, F, H, W, C) → (B, F, H/k, W/k, C) by k×k mean pooling.
+
+    Implemented as a reshape + mean (not a conv): maps to a pure VPU
+    reduction on TPU with no MXU round-trip.
+    """
+    B, F, H, W, C = h.shape
+    h = h.reshape(B, F, H // k, k, W // k, k, C)
+    return h.mean(axis=(3, 5))

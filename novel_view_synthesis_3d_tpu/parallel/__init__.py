@@ -1,0 +1,11 @@
+from novel_view_synthesis_3d_tpu.parallel.dist import (  # noqa: F401
+    initialize_distributed,
+    local_batch_size,
+)
+from novel_view_synthesis_3d_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+)

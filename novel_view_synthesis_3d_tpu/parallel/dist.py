@@ -1,0 +1,52 @@
+"""Multi-host bring-up (SURVEY.md §2.3: the reference is single-host only —
+`jax.device_count()` over local GPUs, no process coordination).
+
+On TPU pods each host runs the same program; `jax.distributed.initialize`
+wires the processes together (DCN for control, ICI for collectives). On
+single-host (or under tests) this is a no-op.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Initialize multi-process JAX if we're in a multi-host environment.
+
+    On Cloud TPU VMs `jax.distributed.initialize()` auto-discovers the pod
+    topology from the metadata server; explicit args cover other clusters.
+    Safe to call unconditionally: single-process environments skip init.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    explicit = coordinator_address is not None
+    # Opt-in env gate (NVS3D_MULTIHOST=1) rather than sniffing TPU_* vars:
+    # single-host TPU containers may set TPU_WORKER_HOSTNAMES themselves.
+    auto_tpu = os.environ.get("NVS3D_MULTIHOST") == "1"
+    if explicit or auto_tpu:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+
+def process_shard(n: int) -> tuple[int, int]:
+    """(shard_index, shard_count) for per-host data sharding of n records."""
+    del n
+    return jax.process_index(), jax.process_count()
+
+
+def local_batch_size(global_batch_size: int) -> int:
+    count = jax.process_count()
+    if global_batch_size % count != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{count} processes")
+    return global_batch_size // count

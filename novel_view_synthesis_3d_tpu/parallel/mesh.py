@@ -1,0 +1,100 @@
+"""Device mesh + sharding helpers (the TPU-native distributed substrate).
+
+Replaces the reference's `jax.pmap` data-parallel path, which SURVEY.md §2.3
+shows to be degenerate: it replicates the SAME batch to every device
+(train.py:132-140), declares `axis_name='ensemble'` but never emits a
+collective (gradients are never averaged), and gives each device a different
+init (train.py:122-123) — an unsynchronized ensemble, not DP.
+
+Here:
+  - one global `Mesh` with axes ('data', 'model', 'seq');
+  - the batch is SHARDED over 'data' (per-device micro-batches);
+  - params/opt-state are replicated (NamedSharding(P())); under `jit`,
+    autodiff of the mean loss over the sharded batch makes XLA emit the
+    gradient all-reduce (psum) over ICI automatically;
+  - 'model' is reserved for tensor parallelism, 'seq' feeds ring attention
+    (parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from novel_view_synthesis_3d_tpu.config import MeshConfig
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the global device mesh.
+
+    `data=-1` absorbs all devices not claimed by the other axes. Works for
+    single chip (1×1×1), one host with N devices, and multi-host slices
+    (pass `jax.devices()` after `jax.distributed.initialize`).
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    model = max(1, config.model)
+    seq = max(1, config.seq)
+    data = config.data
+    if data == -1:
+        if n % (model * seq) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by model×seq = {model * seq}")
+        data = n // (model * seq)
+    if data * model * seq != n:
+        raise ValueError(
+            f"mesh {data}×{model}×{seq} != {n} available devices")
+    arr = np.asarray(devices).reshape(data, model, seq)
+    return Mesh(arr, axis_names=(DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis (batch) sharding over the 'data' mesh axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Move a host-side batch pytree onto the mesh, sharded over 'data'.
+
+    Single-process: a plain device_put with a NamedSharding. Multi-process:
+    each process contributes its LOCAL shard of the global batch via
+    `jax.make_array_from_process_local_data` (per-host Grain shards feed
+    this — SURVEY.md §2.3 "TPU-native equivalents").
+    """
+    sharding = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate a pytree (params/opt state) across the whole mesh."""
+    return jax.device_put(tree, replicated(mesh))
+
+
+def num_data_shards(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]
+
+
+def validate_global_batch(mesh: Mesh, global_batch_size: int) -> None:
+    n = num_data_shards(mesh)
+    if global_batch_size % n != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by data-axis "
+            f"size {n}")

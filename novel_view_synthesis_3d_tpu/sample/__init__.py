@@ -1,0 +1,5 @@
+from novel_view_synthesis_3d_tpu.sample.ddpm import (  # noqa: F401
+    autoregressive_generate,
+    make_sampler,
+    make_stochastic_sampler,
+)

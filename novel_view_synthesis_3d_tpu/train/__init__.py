@@ -1,0 +1,8 @@
+from novel_view_synthesis_3d_tpu.train.checkpoint import CheckpointManager  # noqa: F401
+from novel_view_synthesis_3d_tpu.train.state import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    make_optimizer,
+)
+from novel_view_synthesis_3d_tpu.train.step import make_train_step  # noqa: F401
+from novel_view_synthesis_3d_tpu.train.trainer import Trainer  # noqa: F401

@@ -1,0 +1,50 @@
+"""Orbax checkpointing with a tested save → restore → resume round-trip.
+
+The reference's checkpointing is save-only and broken in three ways
+(SURVEY.md §3.5): it saves pmap-replicated params (leading device axis
+baked into the file), restores with a mismatched prefix ('model0' vs
+'model<step>'), and has no training resume at all (train.py:159-167,
+sampling.py:104-114). Here: single logical (unreplicated) TrainState, async
+Orbax saves, restore-latest, and auto-resume in the Trainer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import orbax.checkpoint as ocp
+
+from novel_view_synthesis_3d_tpu.train.state import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: TrainState, force: bool = False) -> bool:
+        return self._mngr.save(step, args=ocp.args.StandardSave(state),
+                               force=force)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, template: TrainState,
+                step: Optional[int] = None) -> Optional[TrainState]:
+        """Restore into the structure of `template` (e.g. a freshly created
+        state); returns None when no checkpoint exists."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()

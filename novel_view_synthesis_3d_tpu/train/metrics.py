@@ -1,0 +1,71 @@
+"""Metrics: stdout + CSV + optional TensorBoard, with throughput counters.
+
+The reference's observability is a per-step print (train.py:157) and a dead
+tensorboard pin (SURVEY.md §5.5). These are the BASELINE metrics
+(imgs/sec/chip) so they are first-class here.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from typing import Optional
+
+import jax
+
+
+class MetricsLogger:
+    def __init__(self, results_folder: str, use_tensorboard: bool = False):
+        os.makedirs(results_folder, exist_ok=True)
+        self.csv_path = os.path.join(results_folder, "metrics.csv")
+        self._csv_file = open(self.csv_path, "a", newline="")
+        self._csv = csv.writer(self._csv_file)
+        if self._csv_file.tell() == 0:
+            self._csv.writerow([
+                "step", "loss", "grad_norm", "steps_per_sec",
+                "imgs_per_sec_per_chip"])
+        self._tb = None
+        if use_tensorboard:
+            try:
+                import tensorflow as tf
+
+                self._tb = tf.summary.create_file_writer(
+                    os.path.join(results_folder, "tb"))
+            except Exception:
+                self._tb = None
+        self._last_time: Optional[float] = None
+        self._last_step: Optional[int] = None
+
+    def log(self, step: int, metrics: dict, batch_size: int) -> dict:
+        now = time.perf_counter()
+        steps_per_sec = 0.0
+        if self._last_time is not None and step > self._last_step:
+            steps_per_sec = (step - self._last_step) / (now - self._last_time)
+        self._last_time = now
+        self._last_step = step
+        imgs_per_sec_per_chip = (
+            steps_per_sec * batch_size / max(1, jax.device_count()))
+
+        loss = float(metrics.get("loss", float("nan")))
+        gnorm = float(metrics.get("grad_norm", float("nan")))
+        self._csv.writerow([step, loss, gnorm, f"{steps_per_sec:.3f}",
+                            f"{imgs_per_sec_per_chip:.3f}"])
+        self._csv_file.flush()
+        if self._tb is not None:
+            import tensorflow as tf
+
+            with self._tb.as_default():
+                tf.summary.scalar("loss", loss, step=step)
+                tf.summary.scalar("grad_norm", gnorm, step=step)
+                tf.summary.scalar("imgs_per_sec_per_chip",
+                                  imgs_per_sec_per_chip, step=step)
+        return {
+            "loss": loss,
+            "grad_norm": gnorm,
+            "steps_per_sec": steps_per_sec,
+            "imgs_per_sec_per_chip": imgs_per_sec_per_chip,
+        }
+
+    def close(self) -> None:
+        self._csv_file.close()

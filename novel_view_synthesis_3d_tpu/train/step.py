@@ -1,0 +1,118 @@
+"""The jitted data-parallel train step.
+
+TPU-native redesign of the reference hot path (train.py:49-76 + the CPU-side
+noising at data_loader.py:92-110):
+
+  - forward noising (t, ε, z_t, logsnr) happens ON DEVICE inside the jit —
+    the data pipeline ships clean image pairs only. This both removes the
+    reference's float64 `z` / list-typed collate bug (SURVEY.md §3.4) and
+    keeps host→device traffic to 2 images per sample;
+  - fresh per-step PRNG keys via fold_in(state.rng, state.step) — dropout,
+    CFG mask, t and ε all differ every step (reference baked them at trace
+    time, SURVEY.md §3.1);
+  - batch arrives SHARDED over the mesh 'data' axis; the mean loss makes XLA
+    emit the gradient all-reduce over ICI (the psum the reference never had);
+  - state is donated (in-place buffer reuse in HBM).
+
+Batch contract (clean, from data/pipeline.py):
+  x (B,[Fc],H,W,3) cond view(s) · target (B,H,W,3) clean target view ·
+  R1,t1 cond pose(s) · R2,t2 target pose · K intrinsics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from novel_view_synthesis_3d_tpu.config import Config
+from novel_view_synthesis_3d_tpu.diffusion.schedules import DiffusionSchedule
+from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+from novel_view_synthesis_3d_tpu.train.state import TrainState, make_optimizer
+
+
+def compute_loss(eps_pred: jnp.ndarray, noise: jnp.ndarray, kind: str) -> jnp.ndarray:
+    if kind == "mse":
+        return jnp.mean(jnp.square(eps_pred - noise))
+    if kind == "frobenius":
+        # Reference parity (train.py:67): L2 norm of the whole flattened
+        # residual tensor (jnp.mean over a scalar is the identity).
+        return jnp.linalg.norm((eps_pred - noise).reshape(-1))
+    raise ValueError(f"unknown loss {kind!r}")
+
+
+def make_train_step(config: Config, model, schedule: DiffusionSchedule,
+                    mesh) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
+    """Build the jitted train step bound to a mesh.
+
+    Returns step(state, batch) -> (state, metrics); `batch` must already be
+    device-put with `parallel.mesh.shard_batch`.
+    """
+    tcfg = config.train
+    tx = make_optimizer(tcfg)
+
+    def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        k_t, k_noise, k_mask, k_dropout = jax.random.split(step_rng, 4)
+
+        target = batch["target"]
+        B = target.shape[0]
+        t = jax.random.randint(k_t, (B,), 0, schedule.num_timesteps)
+        noise = jax.random.normal(k_noise, target.shape, dtype=target.dtype)
+        z = schedule.q_sample(target, t, noise)
+        logsnr = schedule.logsnr(t)
+        cond_mask = (
+            jax.random.uniform(k_mask, (B,)) >= tcfg.cond_drop_prob
+        ).astype(jnp.float32)
+
+        model_batch = {
+            "x": batch["x"],
+            "z": z,
+            "logsnr": logsnr,
+            "R1": batch["R1"],
+            "t1": batch["t1"],
+            "R2": batch["R2"],
+            "t2": batch["t2"],
+            "K": batch["K"],
+        }
+
+        def loss_fn(params):
+            eps_pred = model.apply(
+                {"params": params}, model_batch, cond_mask=cond_mask,
+                train=True, rngs={"dropout": k_dropout})
+            return compute_loss(eps_pred, noise, tcfg.loss)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        ema_params = state.ema_params
+        if ema_params is not None:
+            d = tcfg.ema_decay
+            ema_params = jax.tree.map(
+                lambda e, p: e * d + p.astype(e.dtype) * (1.0 - d),
+                ema_params, params)
+
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            opt_state=opt_state,
+            rng=state.rng,
+            ema_params=ema_params,
+        )
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    repl = mesh_lib.replicated(mesh)
+    data = mesh_lib.batch_sharding(mesh)
+    return jax.jit(
+        train_step,
+        donate_argnums=(0,),
+        in_shardings=(repl, data),
+        out_shardings=(repl, repl),
+    )

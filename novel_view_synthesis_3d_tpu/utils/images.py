@@ -1,0 +1,34 @@
+"""Image output utilities (PNG files instead of the reference's blocking
+`cv2.imshow` window, sampling.py:153-154)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image
+
+
+def to_uint8(img: np.ndarray) -> np.ndarray:
+    """[-1, 1] float image → uint8 (the reference displays z/2 + 0.5)."""
+    img = np.asarray(img)
+    return np.clip((img / 2.0 + 0.5) * 255.0, 0, 255).astype(np.uint8)
+
+
+def save_image(img: np.ndarray, path: str) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    Image.fromarray(to_uint8(img)).save(path)
+
+
+def save_image_grid(imgs: np.ndarray, path: str, cols: int = 4) -> None:
+    """(N, H, W, 3) in [-1, 1] → one tiled PNG."""
+    imgs = np.asarray(imgs)
+    n, h, w, c = imgs.shape
+    cols = min(cols, n)
+    rows = (n + cols - 1) // cols
+    grid = np.full((rows * h, cols * w, c), 255, dtype=np.uint8)
+    for i in range(n):
+        r, col = divmod(i, cols)
+        grid[r * h:(r + 1) * h, col * w:(col + 1) * w] = to_uint8(imgs[i])
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    Image.fromarray(grid).save(path)

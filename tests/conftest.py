@@ -1,0 +1,43 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+SURVEY.md §4 "Distributed without a cluster": all distributed tests run on
+`--xla_force_host_platform_device_count=8` so sharding/collective logic is
+exercised without TPU hardware.
+"""
+
+import os
+import sys
+
+# Force CPU even if the ambient environment points at a TPU platform.
+# NOTE: the container's sitecustomize imports jax at interpreter start, so
+# env vars alone are too late — use jax.config.update too (effective until
+# the first backend is created, which hasn't happened at conftest time).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax as _jax  # noqa: E402
+
+_jax.config.update("jax_platforms", "cpu")
+assert _jax.device_count() == 8, (
+    f"test harness expected 8 virtual CPU devices, got "
+    f"{_jax.device_count()} on {_jax.default_backend()}")
+
+# Repo root on sys.path so `import novel_view_synthesis_3d_tpu` works from
+# any pytest invocation directory.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Persistent compilation cache: model tests compile several XUNet variants;
+# caching makes re-runs take seconds instead of minutes.
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/nvs3d_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+try:
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+except Exception:
+    pass

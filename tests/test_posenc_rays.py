@@ -1,0 +1,90 @@
+"""Posenc dimension contracts (93/51/144) and pinhole-ray correctness."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from novel_view_synthesis_3d_tpu.models.rays import camera_rays
+from novel_view_synthesis_3d_tpu.ops.posenc import posenc_ddpm, posenc_nerf
+
+
+def test_posenc_nerf_dims():
+    x = jnp.ones((2, 4, 4, 3))
+    # SURVEY.md §2.2: deg 15 → 3 + 3·2·15 = 93; deg 8 → 51; concat = 144.
+    assert posenc_nerf(x, 0, 15).shape == (2, 4, 4, 93)
+    assert posenc_nerf(x, 0, 8).shape == (2, 4, 4, 51)
+    assert posenc_nerf(x, 3, 3).shape == (2, 4, 4, 3)  # min==max → identity
+
+
+def test_posenc_nerf_values():
+    x = jnp.array([[0.5, -1.0, 2.0]])
+    out = np.asarray(posenc_nerf(x, 0, 2))
+    # layout: [x, sin(2⁰x), sin(2¹x), sin(2⁰x+π/2), sin(2¹x+π/2)] blocks of 3
+    np.testing.assert_allclose(out[0, :3], [0.5, -1.0, 2.0], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 3:6], np.sin([0.5, -1.0, 2.0]), rtol=1e-5)
+    np.testing.assert_allclose(out[0, 6:9], np.sin([1.0, -2.0, 4.0]), rtol=1e-5)
+    np.testing.assert_allclose(out[0, 9:12], np.cos([0.5, -1.0, 2.0]), rtol=1e-5)
+
+
+def test_posenc_ddpm_shape_and_values():
+    t = jnp.array([0.0, 0.5, 1.0])
+    emb = np.asarray(posenc_ddpm(t, emb_ch=32, max_time=1.0))
+    assert emb.shape == (3, 32)
+    # t=0 → sin part 0, cos part 1.
+    np.testing.assert_allclose(emb[0, :16], 0.0, atol=1e-7)
+    np.testing.assert_allclose(emb[0, 16:], 1.0, atol=1e-7)
+    # first frequency is 1.0 → emb[t][0] == sin(t·1000)
+    np.testing.assert_allclose(emb[1, 0], np.sin(500.0), rtol=1e-3)
+
+
+def _simple_K(f, c, dtype=np.float32):
+    return np.array([[f, 0, c], [0, f, c], [0, 0, 1]], dtype=dtype)
+
+
+def test_rays_identity_camera():
+    H = W = 4
+    f, c = 2.0, 2.0
+    K = jnp.asarray(_simple_K(f, c))[None]
+    R = jnp.eye(3)[None]
+    t = jnp.zeros((1, 3))
+    pos, d = camera_rays(R, t, K, (H, W))
+    assert pos.shape == (1, H, W, 3) and d.shape == (1, H, W, 3)
+    np.testing.assert_allclose(np.asarray(pos), 0.0)
+    # Hand-computed: pixel (v=0, u=0) center (0.5, 0.5):
+    # d_cam = ((0.5-2)/2, (0.5-2)/2, 1) = (-0.75, -0.75, 1), normalized.
+    expect = np.array([-0.75, -0.75, 1.0])
+    expect = expect / np.linalg.norm(expect)
+    np.testing.assert_allclose(np.asarray(d[0, 0, 0]), expect, rtol=1e-5)
+    # Principal-point pixel (v=1..2? center at (2,2) lies between pixels) —
+    # use pixel (u=1, v=1) center (1.5,1.5): d=((-0.25,-0.25,1))/‖·‖
+    expect2 = np.array([-0.25, -0.25, 1.0])
+    expect2 = expect2 / np.linalg.norm(expect2)
+    np.testing.assert_allclose(np.asarray(d[0, 1, 1]), expect2, rtol=1e-5)
+    # All directions unit norm.
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(d), axis=-1), 1.0, rtol=1e-6)
+
+
+def test_rays_rotation_and_translation():
+    H = W = 2
+    K = jnp.asarray(_simple_K(1.0, 1.0))[None]
+    # 90° rotation about z: x→y, y→−x ... R maps cam dirs into world.
+    Rz = np.array([[0, -1, 0], [1, 0, 0], [0, 0, 1]], dtype=np.float32)
+    t = np.array([[1.0, 2.0, 3.0]], dtype=np.float32)
+    pos, d = camera_rays(jnp.asarray(Rz)[None], jnp.asarray(t), K, (H, W))
+    np.testing.assert_allclose(np.asarray(pos[0, 0, 0]), [1, 2, 3], rtol=1e-6)
+    # pixel (0,0) center (0.5,0.5): d_cam = (-0.5,-0.5,1); world = R@d_cam =
+    # (0.5, -0.5, 1) normalized.
+    expect = np.array([0.5, -0.5, 1.0])
+    expect = expect / np.linalg.norm(expect)
+    np.testing.assert_allclose(np.asarray(d[0, 0, 0]), expect, rtol=1e-5)
+
+
+def test_rays_batched_frames_axis():
+    # (B, F, 3, 3) inputs produce (B, F, H, W, 3) rays — used by the model.
+    B, F, H, W = 2, 3, 8, 8
+    K = jnp.broadcast_to(jnp.asarray(_simple_K(4.0, 4.0)), (B, F, 3, 3))
+    R = jnp.broadcast_to(jnp.eye(3), (B, F, 3, 3))
+    t = jnp.zeros((B, F, 3))
+    pos, d = camera_rays(R, t, K, (H, W))
+    assert pos.shape == (B, F, H, W, 3)
+    assert d.shape == (B, F, H, W, 3)

@@ -1,0 +1,143 @@
+"""Unit tests for diffusion math vs closed forms (SURVEY.md §4 plan)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import DiffusionConfig
+from novel_view_synthesis_3d_tpu.diffusion import (
+    cosine_beta_schedule,
+    logsnr_schedule_cosine,
+    make_schedule,
+    respace,
+)
+
+
+def test_cosine_betas_closed_form():
+    T, s = 1000, 0.008
+    betas = cosine_beta_schedule(T, s)
+    assert betas.shape == (T,)
+    assert np.all(betas >= 0) and np.all(betas <= 0.9999)
+    # Closed form: ᾱ(t) = cos²(((t/T + s)/(1+s))·π/2) / ᾱ(0)
+    f = lambda t: np.cos(((t / T) + s) / (1 + s) * np.pi / 2) ** 2
+    acp = np.cumprod(1 - betas)
+    t = np.arange(1, T + 1, dtype=np.float64)
+    expected = f(t) / f(0.0)
+    # Early/mid timesteps match exactly; late ones are affected by clipping.
+    np.testing.assert_allclose(acp[: T // 2], expected[: T // 2], rtol=1e-10)
+    # Monotone decreasing signal.
+    assert np.all(np.diff(acp) < 0)
+
+
+def test_logsnr_schedule_endpoints_and_monotonicity():
+    # At t=0 the logsnr should be near logsnr_max, at t=1 near logsnr_min.
+    assert abs(logsnr_schedule_cosine(0.0) - 20.0) < 1e-6
+    assert abs(logsnr_schedule_cosine(1.0) - (-20.0)) < 1e-6
+    t = np.linspace(0, 1, 101)
+    vals = logsnr_schedule_cosine(t)
+    assert np.all(np.diff(vals) < 0)
+    # jnp (float32) path agrees with the float64 numpy path.
+    jvals = logsnr_schedule_cosine(jnp.asarray(t))
+    np.testing.assert_allclose(np.asarray(jvals), vals, rtol=1e-3, atol=5e-3)
+
+
+def test_schedule_tables_consistency():
+    cfg = DiffusionConfig(timesteps=1000)
+    sched = make_schedule(cfg)
+    acp = np.asarray(sched.alphas_cumprod, dtype=np.float64)
+    # Tables are f64-built then cast to f32; compare at f32 precision.
+    np.testing.assert_allclose(
+        np.asarray(sched.sqrt_alphas_cumprod), np.sqrt(acp), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sched.sqrt_one_minus_alphas_cumprod),
+        np.sqrt(1 - acp), rtol=1e-3, atol=1e-6)
+    # posterior mean coefficients sum to 1 at x0 = z_t fixpoint scale:
+    # c1·√ᾱ_{t} ≈ ... instead check βt̃ = βt (1−ᾱ_{t−1})/(1−ᾱ_t) directly.
+    betas = np.asarray(sched.betas, dtype=np.float64)
+    acp_prev = np.asarray(sched.alphas_cumprod_prev, dtype=np.float64)
+    np.testing.assert_allclose(
+        np.asarray(sched.posterior_variance),
+        betas * (1 - acp_prev) / (1 - acp), rtol=1e-3, atol=1e-8)
+
+
+def test_q_sample_statistics():
+    cfg = DiffusionConfig(timesteps=1000)
+    sched = make_schedule(cfg)
+    key = jax.random.PRNGKey(0)
+    x0 = jnp.ones((4, 8, 8, 3)) * 0.5
+    noise = jax.random.normal(key, x0.shape)
+    t = jnp.array([0, 100, 500, 999])
+    z = sched.q_sample(x0, t, noise)
+    # z = √ᾱ_t·x0 + √(1−ᾱ_t)·ε, check per-sample against table lookups.
+    for i, ti in enumerate([0, 100, 500, 999]):
+        expected = (
+            sched.sqrt_alphas_cumprod[ti] * x0[i]
+            + sched.sqrt_one_minus_alphas_cumprod[ti] * noise[i]
+        )
+        np.testing.assert_allclose(np.asarray(z[i]), np.asarray(expected),
+                                   rtol=1e-6)
+
+
+def test_predict_start_inverts_q_sample():
+    """x̂₀(q_sample(x₀, t, ε), t, ε) == x₀ exactly — the two maps are inverses."""
+    cfg = DiffusionConfig(timesteps=1000)
+    sched = make_schedule(cfg)
+    key = jax.random.PRNGKey(1)
+    x0 = jax.random.uniform(key, (2, 16, 16, 3), minval=-1, maxval=1)
+    noise = jax.random.normal(jax.random.PRNGKey(2), x0.shape)
+    t = jnp.array([3, 700])
+    z = sched.q_sample(x0, t, noise)
+    x0_hat = sched.predict_start_from_noise(z, t, noise)
+    np.testing.assert_allclose(np.asarray(x0_hat), np.asarray(x0), atol=2e-4)
+
+
+def test_q_posterior_at_t1_recovers_x0_mean_weighting():
+    cfg = DiffusionConfig(timesteps=10)
+    sched = make_schedule(cfg)
+    x0 = jnp.full((1, 4, 4, 3), 0.3)
+    z = jnp.full((1, 4, 4, 3), -0.2)
+    mean, var, logvar = sched.q_posterior(x0, z, jnp.array([5]))
+    c1 = sched.posterior_mean_coef1[5]
+    c2 = sched.posterior_mean_coef2[5]
+    np.testing.assert_allclose(np.asarray(mean),
+                               np.asarray(c1 * x0 + c2 * z), rtol=1e-6)
+    assert np.all(np.asarray(var) > 0)
+    np.testing.assert_allclose(np.asarray(jnp.exp(logvar))[0, 0, 0, 0],
+                               np.asarray(var)[0, 0, 0, 0], rtol=1e-5)
+
+
+def test_logsnr_uses_original_timesteps():
+    cfg = DiffusionConfig(timesteps=1000)
+    sched = make_schedule(cfg)
+    # logsnr at integer t must equal the continuous schedule at t/1000
+    # (reference data_loader.py:110, sampling.py:151).
+    for ti in [0, 250, 999]:
+        np.testing.assert_allclose(
+            float(sched.logsnr(jnp.array(ti))),
+            float(logsnr_schedule_cosine(ti / 1000.0)), rtol=1e-5)
+
+
+def test_respace_preserves_alphas_cumprod():
+    cfg = DiffusionConfig(timesteps=1000)
+    full = make_schedule(cfg)
+    fast = respace(cfg, 250)
+    assert fast.num_timesteps == 250
+    # ᾱ over the respaced subsequence equals the original ᾱ at kept steps.
+    kept = np.asarray(fast.timestep_map)
+    np.testing.assert_allclose(
+        np.asarray(fast.alphas_cumprod),
+        np.asarray(full.alphas_cumprod)[kept], rtol=1e-4)
+    # logsnr is evaluated at ORIGINAL t/T.
+    np.testing.assert_allclose(
+        float(fast.logsnr(jnp.array(0))),
+        float(logsnr_schedule_cosine(kept[0] / 1000.0)), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(fast.logsnr(jnp.array(249))),
+        float(logsnr_schedule_cosine(kept[249] / 1000.0)), rtol=1e-5)
+
+
+def test_respace_too_many_steps_raises():
+    cfg = DiffusionConfig(timesteps=100)
+    with pytest.raises(ValueError):
+        respace(cfg, 101)
