@@ -50,10 +50,12 @@ def make_mesh(config: Optional[MeshConfig] = None,
             raise ValueError(
                 f"{n} devices not divisible by model×seq = {model * seq}")
         data = n // (model * seq)
-    if data * model * seq != n:
+    if data * model * seq > n:
         raise ValueError(
-            f"mesh {data}×{model}×{seq} != {n} available devices")
-    arr = np.asarray(devices).reshape(data, model, seq)
+            f"mesh {data}×{model}×{seq} > {n} available devices")
+    # An explicit smaller mesh uses a device subset (handy for tests and for
+    # carving a slice out of a shared host).
+    arr = np.asarray(devices[: data * model * seq]).reshape(data, model, seq)
     return Mesh(arr, axis_names=(DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
 
 

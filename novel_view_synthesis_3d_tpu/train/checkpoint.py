@@ -28,6 +28,8 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: TrainState, force: bool = False) -> bool:
+        if step in self._mngr.all_steps():
+            return False  # already checkpointed (e.g. final step == save_every)
         return self._mngr.save(step, args=ocp.args.StandardSave(state),
                                force=force)
 
