@@ -1,0 +1,115 @@
+"""Offline dataset preparation utilities.
+
+Capability-parity with the reference's offline prep helpers
+(`/root/reference/dataset/data_util.py:75-142`): the SRN per-object
+train/val splitter and the ShapeNet CSV-driven train/val/test copier.
+Differences: stdlib `csv` instead of pandas, symlink option to avoid
+duplicating large datasets, and returned manifests for testability.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import shutil
+from glob import glob
+from typing import Dict, List, Tuple
+
+
+def _place(src: str, dst: str, symlink: bool) -> None:
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    if symlink:
+        if os.path.lexists(dst):
+            os.remove(dst)
+        os.symlink(os.path.abspath(src), dst)
+    else:
+        shutil.copy(src, dst)
+
+
+def train_val_split(object_dir: str, train_dir: str, val_dir: str,
+                    *, symlink: bool = False) -> Tuple[int, int]:
+    """Split one SRN object dir into train/val by 1-in-3 round-robin.
+
+    Reference semantics (data_util.py:75-98): every item with index % 3 == 0
+    goes to train, the rest to val (1:2 split); outputs are renumbered
+    %06d within each split; intrinsics.txt is copied to both. Handles the
+    pose/rgb/depth subdirs, tolerating a missing depth/ (many SRN dumps omit
+    it). Returns (num_train_views, num_val_views).
+    """
+    subdirs = [("pose", "*.txt", ".txt"), ("rgb", "*.png", ".png"),
+               ("depth", "*.png", ".png")]
+    n_train = n_val = 0
+    for split_dir in (train_dir, val_dir):
+        os.makedirs(split_dir, exist_ok=True)
+        _place(os.path.join(object_dir, "intrinsics.txt"),
+               os.path.join(split_dir, "intrinsics.txt"), symlink)
+
+    for name, pattern, ending in subdirs:
+        items = sorted(glob(os.path.join(object_dir, name, pattern)))
+        if not items and name == "depth":
+            continue
+        train_counter = val_counter = 0
+        for i, item in enumerate(items):
+            if i % 3 == 0:
+                dst = os.path.join(train_dir, name,
+                                   f"{train_counter:06d}{ending}")
+                train_counter += 1
+            else:
+                dst = os.path.join(val_dir, name, f"{val_counter:06d}{ending}")
+                val_counter += 1
+            _place(item, dst, symlink)
+        if name == "rgb":
+            n_train, n_val = train_counter, val_counter
+    return n_train, n_val
+
+
+def read_split_csv(csv_path: str, synset_id: str) -> Dict[str, List[str]]:
+    """ShapeNet split CSV → {'train'|'val'|'test': [modelId, ...]}.
+
+    Expects the official ShapeNet all.csv columns (id, synsetId, subSynsetId,
+    modelId, split).
+    """
+    out: Dict[str, List[str]] = {"train": [], "val": [], "test": []}
+    target = int(synset_id)
+    with open(csv_path, newline="") as fh:
+        for row in csv.DictReader(fh):
+            try:
+                # int-compare both sides: ShapeNet CSVs zero-pad synset IDs.
+                if int(row["synsetId"]) != target:
+                    continue
+            except (TypeError, ValueError):
+                continue
+            split = row["split"]
+            if split in out:
+                out[split].append(str(row["modelId"]))
+    return out
+
+
+def shapenet_train_test_split(shapenet_path: str, synset_id: str, name: str,
+                              csv_path: str, *, symlink: bool = False,
+                              verbose: bool = True) -> Dict[str, List[str]]:
+    """Copy ShapeNet instances into <synset>_<name>_{train,val,test} dirs per
+    the split CSV (reference data_util.py:115-142). Missing instance dirs are
+    skipped with a note. Returns the modelIds actually placed per split."""
+    splits = read_split_csv(csv_path, synset_id)
+    if verbose:
+        print(len(splits["train"]), len(splits["val"]), len(splits["test"]))
+    placed: Dict[str, List[str]] = {k: [] for k in splits}
+    for split, model_ids in splits.items():
+        trgt = os.path.join(shapenet_path, f"{synset_id}_{name}_{split}")
+        os.makedirs(trgt, exist_ok=True)
+        for model_id in model_ids:
+            src = os.path.join(shapenet_path, str(synset_id), model_id)
+            dst = os.path.join(trgt, model_id)
+            if not os.path.isdir(src):
+                if verbose:
+                    print(f"{model_id} does not exist")
+                continue
+            if symlink:
+                if os.path.lexists(dst):
+                    os.remove(dst)
+                os.symlink(os.path.abspath(src), dst)
+            else:
+                shutil.copytree(src, dst, dirs_exist_ok=True)
+            placed[split].append(model_id)
+    return placed

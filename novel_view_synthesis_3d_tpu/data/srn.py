@@ -111,6 +111,36 @@ def load_rgb(path: str, sidelength: Optional[int] = None) -> np.ndarray:
     return (img - 0.5) * 2.0
 
 
+def load_depth(path: str, sidelength: Optional[int] = None) -> np.ndarray:
+    """SRN depth map → (H, W, 1) float32 in meters.
+
+    Reference semantics (data_util.py:27-41): raw 16-bit PNG values × 1e-4,
+    nearest-neighbor resize (depth must not be averaged across edges). Layout
+    is HWC (TPU NHWC) instead of the reference's CHW.
+    """
+    raw = np.asarray(Image.open(path))
+    depth = raw.astype(np.float32)
+    if depth.ndim == 3:
+        depth = depth[:, :, 0]
+    if sidelength is not None and depth.shape[:2] != (sidelength, sidelength):
+        if _HAS_CV2:
+            depth = cv2.resize(depth, (sidelength, sidelength),
+                               interpolation=cv2.INTER_NEAREST)
+        else:
+            pil = Image.fromarray(depth)
+            depth = np.asarray(
+                pil.resize((sidelength, sidelength), Image.NEAREST),
+                dtype=np.float32)
+    return (depth * 1e-4)[:, :, None]
+
+
+def load_params(path: str) -> np.ndarray:
+    """First line of a params.txt as a float32 vector (data_util.py:55-59)."""
+    with open(path) as fh:
+        first = fh.readline()
+    return np.array([float(v) for v in first.split()], dtype=np.float32)
+
+
 @dataclasses.dataclass
 class SRNInstance:
     """One object instance; intrinsics parsed once and cached."""

@@ -54,7 +54,13 @@ def make_mesh(config: Optional[MeshConfig] = None,
         raise ValueError(
             f"mesh {data}×{model}×{seq} > {n} available devices")
     # An explicit smaller mesh uses a device subset (handy for tests and for
-    # carving a slice out of a shared host).
+    # carving a slice out of a shared host) — but only single-process: on a
+    # multi-host slice the trailing hosts' devices would be silently dropped
+    # and their shard_batch calls would target a mesh they aren't part of.
+    if data * model * seq < n and jax.process_count() > 1:
+        raise ValueError(
+            f"mesh {data}×{model}×{seq} uses a subset of the {n} devices, "
+            "which is not supported in multi-process runs")
     arr = np.asarray(devices[: data * model * seq]).reshape(data, model, seq)
     return Mesh(arr, axis_names=(DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
 

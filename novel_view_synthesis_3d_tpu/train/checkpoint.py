@@ -29,7 +29,15 @@ class CheckpointManager:
 
     def save(self, step: int, state: TrainState, force: bool = False) -> bool:
         if step in self._mngr.all_steps():
-            return False  # already checkpointed (e.g. final step == save_every)
+            if not force:
+                return False  # already checkpointed (final step == save_every)
+            # Orbax refuses to overwrite an existing step even with
+            # force=True (force only bypasses the save-interval policy), so a
+            # forced save of a stale step (e.g. left by a previous run with
+            # resume=False) must delete it first — after draining any
+            # in-flight async save of that same step.
+            self._mngr.wait_until_finished()
+            self._mngr.delete(step)
         return self._mngr.save(step, args=ocp.args.StandardSave(state),
                                force=force)
 

@@ -1,0 +1,108 @@
+"""Camera/rotation geometry helpers.
+
+Capability-parity with the geometry utilities of the reference
+(`/root/reference/dataset/data_util.py:145-201` — `euler2mat`, `look_at`,
+`transform_viewpoint`), plus pose-trajectory generators the reference lacks
+but sampling needs: the reference's sampler can only re-use dataset poses,
+while novel-view *generation* wants arbitrary camera orbits.
+
+All functions are plain numpy (host-side pose preparation); the on-device
+geometry (ray generation) lives in models/rays.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def euler2mat(z: float = 0.0, y: float = 0.0, x: float = 0.0) -> np.ndarray:
+    """Rotation matrix from Euler angles, composed as Rx @ Ry @ Rz.
+
+    Matches the reference semantics (data_util.py:155-180, which reduces the
+    [Rz, Ry, Rx] list reversed): angles are radians, zero angles contribute
+    identity, and the z rotation is applied first (returned matrix Rx·Ry·Rz).
+    """
+    cz, sz = np.cos(z), np.sin(z)
+    cy, sy = np.cos(y), np.sin(y)
+    cx, sx = np.cos(x), np.sin(x)
+    Rz = np.array([[cz, -sz, 0.0], [sz, cz, 0.0], [0.0, 0.0, 1.0]])
+    Ry = np.array([[cy, 0.0, sy], [0.0, 1.0, 0.0], [-sy, 0.0, cy]])
+    Rx = np.array([[1.0, 0.0, 0.0], [0.0, cx, -sx], [0.0, sx, cx]])
+    return Rx @ Ry @ Rz
+
+
+def look_at(position: np.ndarray, target: np.ndarray,
+            up: Optional[np.ndarray] = None) -> np.ndarray:
+    """cam→world rotation whose columns are the camera's (x, y, z) axes.
+
+    z points from `position` toward `target`; x = z × up; y = x × z
+    (reference data_util.py:183-199 uses up = +Y).
+    """
+    position = np.asarray(position, dtype=np.float64)
+    target = np.asarray(target, dtype=np.float64)
+    up = np.array([0.0, 1.0, 0.0]) if up is None else np.asarray(up, float)
+
+    z = target - position
+    z = z / np.linalg.norm(z)
+    x = np.cross(z, up)
+    x = x / np.linalg.norm(x)
+    y = np.cross(x, z)
+    y = y / np.linalg.norm(y)
+    return np.stack([x, y, z], axis=1)
+
+
+def pose_from_look_at(position: np.ndarray, target: np.ndarray,
+                      up: Optional[np.ndarray] = None) -> np.ndarray:
+    """4×4 cam→world pose (rotation from `look_at`, translation = position)."""
+    pose = np.eye(4, dtype=np.float32)
+    pose[:3, :3] = look_at(position, target, up)
+    pose[:3, 3] = np.asarray(position, dtype=np.float32)
+    return pose
+
+
+def spherical_position(radius: float, azimuth: float,
+                       elevation: float) -> np.ndarray:
+    """Point on a sphere (Y-up convention: azimuth about +Y, elevation from
+    the horizontal plane)."""
+    ce = np.cos(elevation)
+    return np.array([
+        radius * ce * np.sin(azimuth),
+        radius * np.sin(elevation),
+        radius * ce * np.cos(azimuth),
+    ])
+
+
+def orbit_poses(num: int, radius: float, elevation: float = 0.0,
+                target: Sequence[float] = (0.0, 0.0, 0.0),
+                full_turns: float = 1.0) -> np.ndarray:
+    """(num, 4, 4) cam→world poses on a circular orbit around `target`.
+
+    The canonical novel-view sampling trajectory (the reference has no pose
+    generator — its sampler only replays dataset poses). Azimuths are evenly
+    spaced over `full_turns` revolutions at constant `elevation`.
+    """
+    target = np.asarray(target, dtype=np.float64)
+    azimuths = np.linspace(0.0, 2.0 * np.pi * full_turns, num, endpoint=False)
+    poses = [pose_from_look_at(target + spherical_position(radius, az, elevation),
+                               target)
+             for az in azimuths]
+    return np.stack(poses).astype(np.float32)
+
+
+def transform_viewpoint(v: np.ndarray) -> np.ndarray:
+    """(N, 5) [x, y, z, yaw, pitch] → (N, 7) [x, y, z, cos/sin yaw, cos/sin
+    pitch] — the consistent viewpoint representation of data_util.py:145-152."""
+    v = np.asarray(v)
+    return np.concatenate([
+        v[:, :3],
+        np.cos(v[:, 3:4]), np.sin(v[:, 3:4]),
+        np.cos(v[:, 4:5]), np.sin(v[:, 4:5]),
+    ], axis=1)
+
+
+def rotation_angle(Ra: np.ndarray, Rb: np.ndarray) -> float:
+    """Geodesic angle (radians) between two rotation matrices."""
+    cos = (np.trace(Ra.T @ Rb) - 1.0) / 2.0
+    return float(np.arccos(np.clip(cos, -1.0, 1.0)))

@@ -12,7 +12,27 @@ from PIL import Image
 def to_uint8(img: np.ndarray) -> np.ndarray:
     """[-1, 1] float image → uint8 (the reference displays z/2 + 0.5)."""
     img = np.asarray(img)
-    return np.clip((img / 2.0 + 0.5) * 255.0, 0, 255).astype(np.uint8)
+    return np.clip(np.round((img / 2.0 + 0.5) * 255.0), 0, 255).astype(np.uint8)
+
+
+def convert_image(img: np.ndarray) -> np.ndarray:
+    """Model-space image (any layout, [-1, 1]) → displayable uint8 HWC RGB.
+
+    Capability-parity with the reference's `convert_image`
+    (dataset/util.py:26-37), minus its torch/BGR round-trip: squeezes batch
+    dims and moves CHW to HWC if needed; range mapping via `to_uint8`.
+    """
+    img = np.asarray(img, dtype=np.float32).squeeze()
+    if img.ndim == 3 and img.shape[0] in (1, 3) and img.shape[-1] not in (1, 3):
+        img = img.transpose(1, 2, 0)
+    return to_uint8(img)
+
+
+def normalize01(img: np.ndarray) -> np.ndarray:
+    """Min-max normalize to [0, 1] (reference util.py:108-109)."""
+    img = np.asarray(img, dtype=np.float32)
+    lo, hi = img.min(), img.max()
+    return (img - lo) / (hi - lo) if hi > lo else np.zeros_like(img)
 
 
 def save_image(img: np.ndarray, path: str) -> None:

@@ -1,0 +1,178 @@
+"""Tests for geometry helpers, dataset prep splitters, and image utils
+(capability-parity with reference dataset/util.py + data_util.py)."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from novel_view_synthesis_3d_tpu.data.prep import (
+    read_split_csv,
+    shapenet_train_test_split,
+    train_val_split,
+)
+from novel_view_synthesis_3d_tpu.data.srn import load_depth, load_params
+from novel_view_synthesis_3d_tpu.utils.geometry import (
+    euler2mat,
+    look_at,
+    orbit_poses,
+    pose_from_look_at,
+    rotation_angle,
+    spherical_position,
+    transform_viewpoint,
+)
+from novel_view_synthesis_3d_tpu.utils.images import convert_image, normalize01
+
+
+# ---------------------------------------------------------------------------
+# geometry
+# ---------------------------------------------------------------------------
+def test_euler2mat_identity_and_orthonormal():
+    assert np.allclose(euler2mat(), np.eye(3))
+    R = euler2mat(z=0.3, y=-0.7, x=1.1)
+    assert np.allclose(R @ R.T, np.eye(3), atol=1e-12)
+    assert np.isclose(np.linalg.det(R), 1.0)
+
+
+def test_euler2mat_single_axis():
+    # Pure z-rotation by 90°: x-axis maps to y-axis.
+    R = euler2mat(z=np.pi / 2)
+    assert np.allclose(R @ np.array([1.0, 0, 0]), [0, 1, 0], atol=1e-12)
+    # Composition order is Rx @ Ry @ Rz (z applied first), matching the
+    # reference's reduce(dot, [Rz, Ry, Rx][::-1]) at data_util.py:176-179.
+    Rc = euler2mat(z=0.2, y=0.3, x=0.4)
+    assert np.allclose(Rc, euler2mat(x=0.4) @ euler2mat(y=0.3) @ euler2mat(z=0.2))
+
+
+def test_look_at_z_axis_points_at_target():
+    pos = np.array([0.0, 0.0, 4.0])
+    R = look_at(pos, np.zeros(3))
+    # Column 2 (camera z / viewing direction) points from pos toward target.
+    assert np.allclose(R[:, 2], [0, 0, -1], atol=1e-12)
+    assert np.allclose(R @ R.T, np.eye(3), atol=1e-12)
+
+
+def test_pose_from_look_at_and_orbit():
+    poses = orbit_poses(8, radius=2.0, elevation=0.3)
+    assert poses.shape == (8, 4, 4)
+    for pose in poses:
+        # Camera sits on the sphere and looks at the origin.
+        assert np.isclose(np.linalg.norm(pose[:3, 3]), 2.0, atol=1e-5)
+        view_dir = pose[:3, 2]
+        to_origin = -pose[:3, 3] / np.linalg.norm(pose[:3, 3])
+        assert np.allclose(view_dir, to_origin, atol=1e-5)
+    # Distinct azimuths → distinct rotations.
+    assert rotation_angle(poses[0][:3, :3], poses[4][:3, :3]) > 1.0
+
+
+def test_spherical_position_poles():
+    p = spherical_position(1.0, 0.0, np.pi / 2)
+    assert np.allclose(p, [0, 1, 0], atol=1e-12)
+
+
+def test_transform_viewpoint():
+    v = np.array([[1.0, 2.0, 3.0, 0.0, np.pi / 2]])
+    out = transform_viewpoint(v)
+    assert out.shape == (1, 7)
+    assert np.allclose(out[0], [1, 2, 3, 1, 0, 0, 1], atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# image utils
+# ---------------------------------------------------------------------------
+def test_convert_image_chw_and_hwc():
+    hwc = np.zeros((4, 4, 3), np.float32)
+    assert convert_image(hwc).shape == (4, 4, 3)
+    chw = np.zeros((3, 4, 4), np.float32)
+    assert convert_image(chw).shape == (4, 4, 3)
+    assert convert_image(np.ones((2, 2, 3)))[0, 0, 0] == 255
+    assert convert_image(-np.ones((2, 2, 3)))[0, 0, 0] == 0
+
+
+def test_normalize01():
+    x = np.array([2.0, 4.0])
+    assert np.allclose(normalize01(x), [0.0, 1.0])
+    assert np.allclose(normalize01(np.ones(3)), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# depth / params IO
+# ---------------------------------------------------------------------------
+def test_load_depth_scaling_and_resize(tmp_path):
+    raw = (np.arange(16, dtype=np.uint16).reshape(4, 4)) * 1000
+    p = tmp_path / "d.png"
+    Image.fromarray(raw).save(p)
+    d = load_depth(str(p))
+    assert d.shape == (4, 4, 1)
+    assert np.allclose(d[..., 0], raw.astype(np.float32) * 1e-4)
+    d2 = load_depth(str(p), sidelength=2)
+    assert d2.shape == (2, 2, 1)
+    # Nearest-neighbor: every output value exists in the input.
+    assert np.isin(d2.ravel(), d.ravel()).all()
+
+
+def test_load_params(tmp_path):
+    p = tmp_path / "params.txt"
+    p.write_text("0.5 1.5 -2.0\n")
+    out = load_params(str(p))
+    assert out.dtype == np.float32
+    assert np.allclose(out, [0.5, 1.5, -2.0])
+
+
+# ---------------------------------------------------------------------------
+# dataset prep
+# ---------------------------------------------------------------------------
+def _make_srn_object(root, n_views=7):
+    for sub in ("pose", "rgb", "depth"):
+        os.makedirs(os.path.join(root, sub), exist_ok=True)
+    with open(os.path.join(root, "intrinsics.txt"), "w") as fh:
+        fh.write("100. 32. 32. 0.\n0. 0. 0.\n1.\n64. 64.\n")
+    for i in range(n_views):
+        with open(os.path.join(root, "pose", f"{i:06d}.txt"), "w") as fh:
+            fh.write(" ".join(["1 0 0 0", "0 1 0 0", "0 0 1 2", "0 0 0 1"]))
+        img = Image.fromarray(np.full((8, 8, 3), i * 30, np.uint8))
+        img.save(os.path.join(root, "rgb", f"{i:06d}.png"))
+        Image.fromarray(np.full((8, 8), i, np.uint16)).save(
+            os.path.join(root, "depth", f"{i:06d}.png"))
+
+
+def test_train_val_split(tmp_path):
+    obj = tmp_path / "obj"
+    _make_srn_object(str(obj), n_views=7)
+    n_train, n_val = train_val_split(str(obj), str(tmp_path / "train"),
+                                     str(tmp_path / "val"))
+    # 1-in-3 round-robin (reference data_util.py:89-98): 0,3,6 → train.
+    assert (n_train, n_val) == (3, 4)
+    for split, n in (("train", 3), ("val", 4)):
+        d = tmp_path / split
+        assert os.path.exists(d / "intrinsics.txt")
+        for sub in ("pose", "rgb", "depth"):
+            names = sorted(os.listdir(d / sub))
+            assert len(names) == n
+            # Renumbered consecutively from 000000.
+            assert names[0].startswith("000000")
+
+
+def test_shapenet_split(tmp_path):
+    shapenet = tmp_path / "shapenet"
+    synset = "2958343"
+    for mid in ("aaa", "bbb", "ccc"):
+        os.makedirs(shapenet / synset / mid)
+        (shapenet / synset / mid / "marker.txt").write_text(mid)
+    csv_path = tmp_path / "all.csv"
+    csv_path.write_text(
+        "id,synsetId,subSynsetId,modelId,split\n"
+        f"1,{synset},0,aaa,train\n"
+        f"2,{synset},0,bbb,val\n"
+        f"3,{synset},0,ccc,test\n"
+        f"4,{synset},0,missing,train\n"
+        "5,999,0,other,train\n")
+    splits = read_split_csv(str(csv_path), synset)
+    assert splits == {"train": ["aaa", "missing"], "val": ["bbb"],
+                      "test": ["ccc"]}
+    placed = shapenet_train_test_split(str(shapenet), synset, "cars",
+                                       str(csv_path), verbose=False)
+    assert placed == {"train": ["aaa"], "val": ["bbb"], "test": ["ccc"]}
+    assert os.path.exists(shapenet / f"{synset}_cars_train" / "aaa" /
+                          "marker.txt")
