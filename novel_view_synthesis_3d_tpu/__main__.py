@@ -1,0 +1,4 @@
+from novel_view_synthesis_3d_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,336 @@
+"""Command-line entry points: train / sample / eval / prep / config.
+
+The reference's entry points are two hardwired scripts with zero flags
+(`/root/reference/train.py:174-176` — dataset path literal 'cars_train_val';
+`/root/reference/sampling.py` — a flat script with an infinite cv2.imshow
+loop). Here every capability is a subcommand of
+
+    python -m novel_view_synthesis_3d_tpu <command> [options] [key=value ...]
+
+with config presets (BASELINE.json ladder) + dotted-key overrides, PNG output
+instead of GUI display, and checkpoint restore that actually matches what
+training saves (the reference's prefixes don't — SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from novel_view_synthesis_3d_tpu.config import (
+    Config, PRESET_NAMES, get_preset)
+
+
+def build_config(args, overrides: Sequence[str]) -> Config:
+    """preset → optional JSON file → dotted CLI overrides, later wins."""
+    if getattr(args, "config", None):
+        with open(args.config) as fh:
+            cfg = Config.from_json(fh.read())
+        if getattr(args, "preset", None):
+            raise SystemExit("--preset and --config are mutually exclusive")
+    else:
+        cfg = get_preset(args.preset or "tiny64")
+    if overrides:
+        try:
+            cfg = cfg.apply_cli(overrides)
+        except KeyError as e:
+            raise SystemExit(f"config error: {e.args[0]}") from e
+    return cfg
+
+
+def _split_overrides(rest: List[str]) -> List[str]:
+    bad = [a for a in rest if "=" not in a]
+    if bad:
+        raise SystemExit(f"unrecognized arguments: {' '.join(bad)} "
+                         "(overrides look like model.ch=64)")
+    return rest
+
+
+# ---------------------------------------------------------------------------
+# train
+# ---------------------------------------------------------------------------
+def cmd_train(args, overrides: List[str]) -> int:
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+    cfg = build_config(args, overrides)
+    if args.folder:
+        cfg = cfg.override(**{"data.root_dir": args.folder})
+    trainer = Trainer(config=cfg, use_grain=not args.no_grain)
+    trainer.train()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# sample
+# ---------------------------------------------------------------------------
+def _restore_params(cfg: Config, model, sample_batch: dict, step: Optional[int]):
+    """Latest (or `step`) checkpoint → params (EMA if trained with EMA)."""
+    import jax
+
+    from novel_view_synthesis_3d_tpu.train.checkpoint import CheckpointManager
+    from novel_view_synthesis_3d_tpu.train.state import create_train_state
+
+    template = create_train_state(cfg.train, model, sample_batch)
+    ckpt = CheckpointManager(cfg.train.checkpoint_dir)
+    if ckpt.latest_step() is None:
+        raise FileNotFoundError(
+            f"no checkpoint under {cfg.train.checkpoint_dir!r} — train first "
+            "(the reference fails the same way: sampling.py:111-112)")
+    state = ckpt.restore(template, step=step)
+    ckpt.close()
+    params = state.ema_params if state.ema_params is not None else state.params
+    return jax.device_get(params), int(jax.device_get(state.step))
+
+
+def cmd_sample(args, overrides: List[str]) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.sample.ddpm import (
+        autoregressive_generate, make_sampler)
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+    from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+    from novel_view_synthesis_3d_tpu.utils.images import (
+        save_image, save_image_grid)
+
+    cfg = build_config(args, overrides)
+    dcfg = cfg.diffusion
+    ds = SRNDataset(args.folder or cfg.data.root_dir,
+                    img_sidelength=cfg.data.img_sidelength)
+    inst = ds.instances[args.instance % ds.num_instances]
+    x, pose1 = inst.view(args.cond_view % len(inst))
+
+    # Target poses: dataset ground-truth poses or a synthetic orbit.
+    if args.poses == "dataset":
+        idcs = [v for v in range(len(inst))
+                if v != args.cond_view % len(inst)][:args.num_views]
+        poses2 = np.stack([inst.view(v)[1] for v in idcs])
+    else:
+        radius = float(np.linalg.norm(pose1[:3, 3]))
+        poses2 = orbit_poses(args.num_views, radius=radius,
+                             elevation=args.elevation)
+
+    model = XUNet(cfg.model)
+    first_view = {
+        "x": jnp.asarray(x)[None],
+        "R1": jnp.asarray(pose1[:3, :3])[None],
+        "t1": jnp.asarray(pose1[:3, 3])[None],
+        "K": jnp.asarray(inst.K)[None],
+    }
+    sample_batch = _sample_model_batch({
+        "x": x[None], "target": x[None],
+        "R1": pose1[None, :3, :3], "t1": pose1[None, :3, 3],
+        "R2": poses2[0][None, :3, :3], "t2": poses2[0][None, :3, 3],
+        "K": inst.K[None],
+    })
+    params, step = _restore_params(cfg, model, sample_batch, args.step)
+    print(f"restored checkpoint at step {step}")
+
+    schedule = sampling_schedule(dcfg, args.sample_steps)
+    key = jax.random.PRNGKey(args.seed)
+
+    if args.stochastic:
+        # Autoregressive 3DiM sampling: each generated view joins the
+        # conditioning pool for the next (sample/ddpm.py).
+        target_poses = {
+            "R2": jnp.asarray(poses2[None, :, :3, :3]),
+            "t2": jnp.asarray(poses2[None, :, :3, 3]),
+        }
+        imgs = autoregressive_generate(
+            model, schedule, dcfg, params, key, first_view, target_poses)
+        imgs = np.asarray(jax.device_get(imgs))[0]  # (N, H, W, 3)
+    else:
+        # One batched reverse process: the conditioning view broadcasts over
+        # all N target poses (same pattern as eval/evaluate.py).
+        sampler = make_sampler(model, schedule, dcfg)
+        N = len(poses2)
+        cond = {k: jnp.broadcast_to(v, (N,) + v.shape[1:])
+                for k, v in first_view.items()}
+        cond["R2"] = jnp.asarray(poses2[:, :3, :3])
+        cond["t2"] = jnp.asarray(poses2[:, :3, 3])
+        imgs = np.asarray(jax.device_get(sampler(params, key, cond)))
+
+    os.makedirs(args.out, exist_ok=True)
+    for i, img in enumerate(imgs):
+        save_image(img, os.path.join(args.out, f"view_{i:03d}.png"))
+    save_image_grid(imgs, os.path.join(args.out, "grid.png"))
+    save_image(x, os.path.join(args.out, "cond.png"))
+    print(f"wrote {len(imgs)} views to {args.out}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# eval
+# ---------------------------------------------------------------------------
+def cmd_eval(args, overrides: List[str]) -> int:
+    import jax
+
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+    from novel_view_synthesis_3d_tpu.eval.evaluate import evaluate_dataset
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    cfg = build_config(args, overrides)
+    ds = SRNDataset(args.folder or cfg.data.root_dir,
+                    img_sidelength=cfg.data.img_sidelength)
+    model = XUNet(cfg.model)
+
+    rec = ds.pair(0, np.random.default_rng(0))
+    sample_batch = _sample_model_batch(
+        {k: v[None] for k, v in rec.items()})
+    params, step = _restore_params(cfg, model, sample_batch, args.step)
+    print(f"restored checkpoint at step {step}")
+
+    result = evaluate_dataset(
+        cfg, model, params, ds,
+        key=jax.random.PRNGKey(args.seed),
+        num_instances=args.num_instances,
+        views_per_instance=args.views_per_instance,
+        cond_view=args.cond_view,
+        sample_steps=args.sample_steps,
+        batch_size=args.batch_size,
+    )
+    print(json.dumps(dict(result.to_dict(), checkpoint_step=step)))
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w") as fh:
+            json.dump(dict(result.to_dict(), checkpoint_step=step,
+                           per_view_psnr=result.per_view_psnr.tolist(),
+                           per_view_ssim=result.per_view_ssim.tolist()), fh)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# prep / config
+# ---------------------------------------------------------------------------
+def cmd_prep(args, overrides: List[str]) -> int:
+    del overrides
+    from novel_view_synthesis_3d_tpu.data import prep
+
+    if args.prep_command == "split-object":
+        n_train, n_val = prep.train_val_split(
+            args.object_dir, args.train_dir, args.val_dir,
+            symlink=args.symlink)
+        print(f"{n_train} train / {n_val} val views")
+    elif args.prep_command == "shapenet":
+        placed = prep.shapenet_train_test_split(
+            args.shapenet_path, args.synset_id, args.name, args.csv_path,
+            symlink=args.symlink)
+        print(json.dumps({k: len(v) for k, v in placed.items()}))
+    else:
+        raise SystemExit(f"unknown prep command {args.prep_command!r}")
+    return 0
+
+
+def cmd_config(args, overrides: List[str]) -> int:
+    print(build_config(args, overrides).to_json())
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--preset", default=None, choices=PRESET_NAMES,
+                   help="config preset")
+    p.add_argument("--config", default=None, help="config JSON file")
+
+
+def make_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m novel_view_synthesis_3d_tpu",
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("train", help="train the X-UNet (reference train.py)")
+    _add_common(p)
+    p.add_argument("folder", nargs="?", default=None,
+                   help="SRN dataset root (overrides data.root_dir)")
+    p.add_argument("--no-grain", action="store_true",
+                   help="in-process data loading (no worker processes)")
+
+    p = sub.add_parser("sample",
+                       help="sample novel views (reference sampling.py, PNGs "
+                            "instead of cv2 windows)")
+    _add_common(p)
+    p.add_argument("folder", nargs="?", default=None)
+    p.add_argument("--out", default="./samples")
+    p.add_argument("--instance", type=int, default=0)
+    p.add_argument("--cond-view", type=int, default=0)
+    p.add_argument("--num-views", type=int, default=8)
+    p.add_argument("--poses", choices=("dataset", "orbit"), default="dataset")
+    p.add_argument("--elevation", type=float, default=0.3,
+                   help="orbit elevation (radians), --poses orbit only")
+    p.add_argument("--stochastic", action="store_true",
+                   help="3DiM autoregressive stochastic conditioning")
+    p.add_argument("--sample-steps", type=int, default=None,
+                   help="respaced DDPM steps (default: config)")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: latest)")
+    p.add_argument("--seed", type=int, default=0)
+
+    p = sub.add_parser("eval", help="PSNR/SSIM over held-out views")
+    _add_common(p)
+    p.add_argument("folder", nargs="?", default=None)
+    p.add_argument("--out", default=None, help="write result JSON here")
+    p.add_argument("--num-instances", type=int, default=None)
+    p.add_argument("--views-per-instance", type=int, default=1)
+    p.add_argument("--cond-view", type=int, default=0)
+    p.add_argument("--sample-steps", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = sub.add_parser("prep", help="offline dataset preparation")
+    prep_sub = p.add_subparsers(dest="prep_command", required=True)
+    q = prep_sub.add_parser("split-object",
+                            help="SRN per-object 1-in-3 train/val split")
+    q.add_argument("object_dir")
+    q.add_argument("train_dir")
+    q.add_argument("val_dir")
+    q.add_argument("--symlink", action="store_true")
+    q = prep_sub.add_parser("shapenet", help="CSV-driven ShapeNet split")
+    q.add_argument("shapenet_path")
+    q.add_argument("synset_id")
+    q.add_argument("name")
+    q.add_argument("csv_path")
+    q.add_argument("--symlink", action="store_true")
+
+    p = sub.add_parser("config", help="print the resolved config JSON")
+    _add_common(p)
+
+    return parser
+
+
+_COMMANDS = {
+    "train": cmd_train,
+    "sample": cmd_sample,
+    "eval": cmd_eval,
+    "prep": cmd_prep,
+    "config": cmd_config,
+}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = make_parser()
+    args, rest = parser.parse_known_args(argv)
+    # The optional positional `folder` would otherwise swallow the first
+    # key=value override when no folder is given.
+    if getattr(args, "folder", None) and "=" in args.folder:
+        rest.insert(0, args.folder)
+        args.folder = None
+    overrides = _split_overrides(rest)
+    return _COMMANDS[args.command](args, overrides)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

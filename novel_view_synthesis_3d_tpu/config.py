@@ -193,6 +193,9 @@ class Config:
 # ----------------------------------------------------------------------
 # Config ladder presets (BASELINE.json "configs")
 # ----------------------------------------------------------------------
+PRESET_NAMES = ("reference", "tiny64", "base128", "paper256")
+
+
 def get_preset(name: str) -> Config:
     """Presets for the BASELINE.json config ladder.
 

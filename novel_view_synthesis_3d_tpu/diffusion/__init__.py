@@ -4,4 +4,5 @@ from novel_view_synthesis_3d_tpu.diffusion.schedules import (  # noqa: F401
     logsnr_schedule_cosine,
     make_schedule,
     respace,
+    sampling_schedule,
 )

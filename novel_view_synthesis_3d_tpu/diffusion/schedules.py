@@ -22,6 +22,8 @@ Math (DDPM, Nichol & Dhariwal cosine schedule, T=1000):
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.struct
 import jax.numpy as jnp
 import numpy as np
@@ -176,6 +178,17 @@ def make_schedule(config: DiffusionConfig) -> DiffusionSchedule:
         timestep_map=jnp.arange(config.timesteps, dtype=jnp.int32),
         num_original_timesteps=config.timesteps,
     )
+
+
+def sampling_schedule(config: DiffusionConfig,
+                      num_steps: Optional[int] = None) -> DiffusionSchedule:
+    """Schedule for sampling: respaced to `num_steps` (default
+    config.sample_timesteps) unless that equals the training timestep count,
+    in which case the full schedule is built directly."""
+    num_steps = num_steps or config.sample_timesteps
+    if num_steps == config.timesteps:
+        return make_schedule(config)
+    return respace(config, num_steps)
 
 
 def respace(schedule_config: DiffusionConfig, num_steps: int) -> DiffusionSchedule:

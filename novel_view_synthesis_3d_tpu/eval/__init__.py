@@ -1,0 +1,1 @@
+from novel_view_synthesis_3d_tpu.eval.metrics import psnr, ssim  # noqa: F401

@@ -1,0 +1,70 @@
+"""Image quality metrics: PSNR and SSIM, pure jnp (jit/vmap-able).
+
+The reference repo computes NO quality metrics anywhere (SURVEY.md §6); the
+3DiM paper (arXiv 2210.04628, linked at /root/reference/README.md:2) reports
+PSNR/SSIM on SRN ShapeNet cars — these are the paper-parity implementations:
+PSNR over the full image, SSIM per Wang et al. 2004 with the standard 11×11
+Gaussian window (σ=1.5), K1=0.01, K2=0.03.
+
+Images are NHWC; `data_range` defaults to 2.0 (model space [-1, 1]).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def psnr(pred: jnp.ndarray, target: jnp.ndarray,
+         data_range: float = 2.0) -> jnp.ndarray:
+    """Peak signal-to-noise ratio in dB, per batch element.
+
+    pred/target: (..., H, W, C); reduces over the last three axes.
+    """
+    mse = jnp.mean(jnp.square(pred - target), axis=(-3, -2, -1))
+    return 10.0 * jnp.log10((data_range ** 2) / jnp.maximum(mse, 1e-20))
+
+
+def _gaussian_kernel(size: int, sigma: float) -> np.ndarray:
+    x = np.arange(size, dtype=np.float64) - (size - 1) / 2.0
+    g = np.exp(-(x ** 2) / (2.0 * sigma ** 2))
+    g = g / g.sum()
+    return np.outer(g, g)
+
+
+def _filter2d(img: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """Depthwise VALID 2-D filter on (B, H, W, C)."""
+    C = img.shape[-1]
+    k = jnp.broadcast_to(kernel[:, :, None, None], kernel.shape + (1, C))
+    # NHWC, HWIO, depthwise via feature_group_count=C.
+    return jax.lax.conv_general_dilated(
+        img, k.astype(img.dtype), window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=C)
+
+
+def ssim(pred: jnp.ndarray, target: jnp.ndarray, data_range: float = 2.0,
+         window_size: int = 11, sigma: float = 1.5,
+         k1: float = 0.01, k2: float = 0.03) -> jnp.ndarray:
+    """Mean structural similarity per batch element (Wang et al. 2004).
+
+    pred/target: (B, H, W, C) with H, W ≥ window_size. Gaussian-windowed
+    means/variances, VALID padding (edge pixels excluded, as in the standard
+    implementation).
+    """
+    if pred.ndim == 3:
+        pred, target = pred[None], target[None]
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    kernel = jnp.asarray(_gaussian_kernel(window_size, sigma))
+
+    mu_x = _filter2d(pred, kernel)
+    mu_y = _filter2d(target, kernel)
+    mu_x2, mu_y2, mu_xy = mu_x * mu_x, mu_y * mu_y, mu_x * mu_y
+    sigma_x2 = _filter2d(pred * pred, kernel) - mu_x2
+    sigma_y2 = _filter2d(target * target, kernel) - mu_y2
+    sigma_xy = _filter2d(pred * target, kernel) - mu_xy
+
+    ssim_map = ((2.0 * mu_xy + c1) * (2.0 * sigma_xy + c2)) / (
+        (mu_x2 + mu_y2 + c1) * (sigma_x2 + sigma_y2 + c2))
+    return jnp.mean(ssim_map, axis=(-3, -2, -1))

@@ -24,7 +24,10 @@ from novel_view_synthesis_3d_tpu.data.pipeline import (
     make_dataset,
     make_grain_loader,
 )
-from novel_view_synthesis_3d_tpu.diffusion.schedules import make_schedule, respace
+from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+    make_schedule,
+    sampling_schedule,
+)
 from novel_view_synthesis_3d_tpu.models.xunet import XUNet
 from novel_view_synthesis_3d_tpu.parallel import dist, mesh as mesh_lib
 from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
@@ -171,10 +174,8 @@ class Trainer:
                      sample_steps: Optional[int] = None) -> str:
         """Sample novel views for the first records and write a PNG grid."""
         dcfg = self.config.diffusion
-        sample_steps = sample_steps or dcfg.sample_timesteps
-        sched = (respace(dcfg, sample_steps)
-                 if sample_steps != dcfg.timesteps else self.schedule)
-        sampler = make_sampler(self.model, sched, dcfg)
+        sampler = make_sampler(self.model, sampling_schedule(dcfg, sample_steps),
+                               dcfg)
         batch = self._held_batch if self._held_batch is not None else next(self.data_iter)
         self._held_batch = batch
         cond = {k: jnp.asarray(batch[k][:num])

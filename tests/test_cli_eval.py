@@ -1,0 +1,177 @@
+"""CLI entry points + eval metrics (PSNR/SSIM) tests."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.cli import main, make_parser
+from novel_view_synthesis_3d_tpu.config import get_preset
+from novel_view_synthesis_3d_tpu.data.synthetic import write_synthetic_srn
+from novel_view_synthesis_3d_tpu.eval.metrics import psnr, ssim
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+def test_psnr_known_value():
+    a = jnp.zeros((1, 16, 16, 3))
+    b = jnp.full((1, 16, 16, 3), 0.2)
+    # mse = 0.04, data_range 2 → 10·log10(4 / 0.04) = 20 dB.
+    assert np.allclose(np.asarray(psnr(a, b)), 20.0, atol=1e-4)
+    # Identical images → very large (finite, eps-guarded) PSNR.
+    assert np.asarray(psnr(a, a))[0] > 100.0
+
+
+def test_psnr_batch_shape():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.uniform(-1, 1, (4, 8, 8, 3)))
+    b = jnp.asarray(rng.uniform(-1, 1, (4, 8, 8, 3)))
+    assert psnr(a, b).shape == (4,)
+
+
+def test_ssim_self_is_one():
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.uniform(-1, 1, (2, 16, 16, 3)))
+    assert np.allclose(np.asarray(ssim(a, a)), 1.0, atol=1e-5)
+
+
+def test_ssim_constant_images_closed_form():
+    # Flat images: variances vanish, SSIM = (2ab + C1) / (a² + b² + C1).
+    va, vb = 0.3, -0.5
+    a = jnp.full((1, 16, 16, 1), va)
+    b = jnp.full((1, 16, 16, 1), vb)
+    c1 = (0.01 * 2.0) ** 2
+    expected = (2 * va * vb + c1) / (va ** 2 + vb ** 2 + c1)
+    assert np.allclose(np.asarray(ssim(a, b))[0], expected, atol=1e-5)
+
+
+def test_ssim_degrades_with_noise_and_symmetric():
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.uniform(-1, 1, (1, 24, 24, 3)))
+    small = a + 0.05 * jnp.asarray(rng.normal(size=a.shape))
+    big = a + 0.5 * jnp.asarray(rng.normal(size=a.shape))
+    s_small = float(np.asarray(ssim(a, small))[0])
+    s_big = float(np.asarray(ssim(a, big))[0])
+    assert s_small > s_big
+    assert s_small < 1.0
+    assert np.allclose(np.asarray(ssim(a, big)), np.asarray(ssim(big, a)),
+                       atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# CLI parsing / config
+# ---------------------------------------------------------------------------
+def test_cli_config_roundtrip(capsys):
+    assert main(["config", "--preset", "base128"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["model"]["ch"] == 128
+    assert out["data"]["img_sidelength"] == 128
+
+
+def test_cli_config_overrides(capsys):
+    main(["config", "--preset", "tiny64", "model.ch=64",
+          "model.ch_mult=[1,2,4]", "train.lr=0.001"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["model"]["ch"] == 64
+    assert out["model"]["ch_mult"] == [1, 2, 4]
+    assert out["train"]["lr"] == 0.001
+
+
+def test_cli_rejects_bad_override():
+    with pytest.raises(SystemExit):
+        main(["config", "--preset", "tiny64", "not-an-override"])
+    with pytest.raises(SystemExit):
+        main(["config", "--preset", "tiny64", "model.nonexistent=3"])
+
+
+def test_cli_config_file(tmp_path, capsys):
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(get_preset("tiny64").override(**{"model.ch": 96}).to_json())
+    main(["config", "--config", str(cfg_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert out["model"]["ch"] == 96
+    with pytest.raises(SystemExit):
+        main(["config", "--config", str(cfg_path), "--preset", "tiny64"])
+
+
+def test_cli_prep_split(tmp_path, capsys):
+    root = tmp_path / "srn"
+    write_synthetic_srn(str(root), num_instances=1, views_per_instance=6,
+                        image_size=8)
+    obj = os.path.join(str(root), "inst_00")
+    assert main(["prep", "split-object", obj, str(tmp_path / "tr"),
+                 str(tmp_path / "va")]) == 0
+    out = capsys.readouterr().out
+    assert "2 train / 4 val" in out
+
+
+def test_parser_help_lists_commands():
+    parser = make_parser()
+    help_text = parser.format_help()
+    for cmd in ("train", "sample", "eval", "prep", "config"):
+        assert cmd in help_text
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end: train → sample → eval on a tiny synthetic dataset
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def cli_workspace(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cli_e2e")
+    root = tmp / "srn"
+    write_synthetic_srn(str(root), num_instances=2, views_per_instance=4,
+                        image_size=16)
+    return tmp
+
+
+_TINY = [
+    "model.ch=32", "model.ch_mult=[1,2]", "model.emb_ch=32",
+    "model.num_res_blocks=1", "model.attn_resolutions=[4]",
+    "diffusion.timesteps=8", "diffusion.sample_timesteps=2",
+    "data.img_sidelength=16", "train.batch_size=8", "train.num_steps=2",
+    "train.save_every=2", "train.log_every=1",
+]
+
+
+def _tiny_overrides(tmp):
+    return _TINY + [
+        f"train.checkpoint_dir={tmp}/ckpt",
+        f"train.results_folder={tmp}/results",
+    ]
+
+
+def test_cli_train_sample_eval_e2e(cli_workspace, capsys):
+    tmp = cli_workspace
+    root = str(tmp / "srn")
+
+    assert main(["train", root, "--no-grain"] + _tiny_overrides(tmp)) == 0
+    assert os.path.isdir(str(tmp / "ckpt"))
+
+    out_dir = str(tmp / "samples")
+    assert main(["sample", root, "--out", out_dir, "--num-views", "2",
+                 "--sample-steps", "2"] + _tiny_overrides(tmp)) == 0
+    assert os.path.exists(os.path.join(out_dir, "view_000.png"))
+    assert os.path.exists(os.path.join(out_dir, "grid.png"))
+    assert os.path.exists(os.path.join(out_dir, "cond.png"))
+
+    eval_json = str(tmp / "eval.json")
+    assert main(["eval", root, "--out", eval_json, "--num-instances", "1",
+                 "--sample-steps", "2", "--batch-size", "2"]
+                + _tiny_overrides(tmp)) == 0
+    with open(eval_json) as fh:
+        result = json.load(fh)
+    assert np.isfinite(result["psnr"])
+    assert -1.0 <= result["ssim"] <= 1.0
+    assert result["num_views"] == 1
+    assert result["checkpoint_step"] == 2
+
+
+def test_cli_sample_without_checkpoint_fails(cli_workspace, tmp_path):
+    root = str(cli_workspace / "srn")
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        main(["sample", root, "--out", str(tmp_path / "s")] + _TINY +
+             [f"train.checkpoint_dir={tmp_path}/empty_ckpt"])
