@@ -63,7 +63,7 @@ def bench_framework(state, step, device_batch, steps: int) -> float:
     return (time.perf_counter() - t0) / steps
 
 
-def bench_reference_style(cfg, model, schedule, state, batch,
+def bench_reference_style(cfg, model, schedule, params, batch,
                           steps: int) -> float:
     """Reference-structure step: CPU float64 noising per batch + eager
     (jit-per-call overhead avoided, but no donation, host round-trips for
@@ -73,7 +73,6 @@ def bench_reference_style(cfg, model, schedule, state, batch,
     from novel_view_synthesis_3d_tpu.train.step import compute_loss
 
     tx = make_optimizer(cfg.train)
-    params = jax.device_get(state.params)
     opt_state = tx.init(params)
     sqrt_acp = np.sqrt(np.cumprod(1 - np.asarray(schedule.betas, np.float64)))
     sqrt_1macp = np.sqrt(1 - np.cumprod(1 - np.asarray(schedule.betas, np.float64)))
@@ -134,10 +133,14 @@ def main():
     n_chips = max(1, len(jax.devices()))
     B = cfg.train.batch_size
 
+    # Snapshot params to host BEFORE bench_framework: the jitted step donates
+    # `state`, so its device buffers are deleted after the first call.
+    host_params = jax.device_get(state.params)
+
     sec_fw = bench_framework(state, step, device_batch, steps)
     imgs_per_sec_chip = B / sec_fw / n_chips
 
-    sec_ref = bench_reference_style(cfg, model, schedule, state, batch,
+    sec_ref = bench_reference_style(cfg, model, schedule, host_params, batch,
                                     max(5, steps // 3))
     ref_imgs_per_sec_chip = B / sec_ref / n_chips
 
