@@ -1,0 +1,86 @@
+/* nvs3d_io — native IO runtime for the TPU novel-view-synthesis framework.
+ *
+ * TPU-native replacement for the reference's native data-path dependencies
+ * (SURVEY.md §2.4: torch DataLoader worker processes, OpenCV resize, imageio
+ * PNG decode). Everything here runs on the host CPU feeding the TPU input
+ * pipeline:
+ *
+ *   - zlib-based PNG decoder (8/16-bit; gray / RGB / palette / +alpha)
+ *   - square-center-crop + area resize + [-1,1] normalize
+ *     (semantics of reference dataset/data_util.py:12-24)
+ *   - SRN pose / intrinsics text parsers (reference dataset/util.py:46-81)
+ *   - a threaded, shuffling, prefetching batch loader (bounded queue +
+ *     worker pool) — the native equivalent of the reference's torch
+ *     DataLoader (reference train.py:108-113)
+ *
+ * All functions return 0 on success, nonzero on failure;
+ * nvs3d_last_error() describes the most recent failure in that thread.
+ */
+#ifndef NVS3D_IO_H
+#define NVS3D_IO_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Most recent error message for the calling thread ("" if none). */
+const char *nvs3d_last_error(void);
+
+/* Decode a PNG file into RGB8. *w and *h receive the dimensions; the pixel
+ * buffer (3*w*h bytes, row-major RGB) is written to out, which must hold
+ * at least max_bytes. Fails if the decoded image would not fit. */
+int nvs3d_decode_png_rgb(const char *path, int *w, int *h,
+                         uint8_t *out, size_t max_bytes);
+
+/* Full reference load_rgb: decode PNG -> RGB -> /255 -> square center crop
+ * -> area resize to sidelength x sidelength -> (x-0.5)*2.
+ * out must hold sidelength*sidelength*3 floats. */
+int nvs3d_load_rgb(const char *path, int sidelength, float *out);
+
+/* Batched nvs3d_load_rgb over a worker-thread pool.
+ * out must hold n*sidelength*sidelength*3 floats. */
+int nvs3d_load_rgb_batch(const char **paths, int n, int sidelength,
+                         int n_threads, float *out);
+
+/* 4x4 cam->world pose from txt (4 rows of 4 or one flat row of 16+). */
+int nvs3d_parse_pose(const char *path, float *out16);
+
+/* SRN intrinsics.txt: f cx cy _ / barycenter(3) / scale / height width /
+ * [world2cam]. K (row-major 3x3) is rescaled to `sidelength` when > 0
+ * (cx*S/W, cy*S/H, f*S/H). */
+int nvs3d_parse_intrinsics(const char *path, int sidelength,
+                           float *K9, float *barycenter3, float *scale,
+                           int *world2cam);
+
+/* ------------------------------------------------------------------ */
+/* Threaded prefetching pair loader                                    */
+/* ------------------------------------------------------------------ */
+/* Creates a loader over n_records observations. rgb_paths[i]/pose_paths[i]
+ * describe observation i; instance_ids[i] (non-decreasing) groups
+ * observations into object instances. Each produced record pairs the
+ * conditioning view i with a uniformly random target view of the SAME
+ * instance (reference dataset/data_loader.py:85-90). Worker threads decode
+ * and fill whole batches into a bounded prefetch queue. Returns NULL on
+ * failure. */
+void *nvs3d_loader_create(const char **rgb_paths, const char **pose_paths,
+                          const int32_t *instance_ids, int n_records,
+                          int sidelength, int batch_size, int n_threads,
+                          int prefetch_depth, uint64_t seed,
+                          int shard_index, int shard_count);
+
+/* Blocks until the next batch is ready, then copies it out.
+ * x, target: batch*S*S*3 floats.  pose1, pose2: batch*16 floats (4x4).
+ * record_idx: batch int32 flat record indices (conditioning views). */
+int nvs3d_loader_next(void *loader, float *x, float *target,
+                      float *pose1, float *pose2, int32_t *record_idx);
+
+void nvs3d_loader_destroy(void *loader);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NVS3D_IO_H */

@@ -1,0 +1,688 @@
+// nvs3d_io: native host-side IO runtime (see include/nvs3d_io.h).
+//
+// Clean-room implementation. PNG decoding follows the public PNG
+// specification (RFC 2083) over zlib inflate; resize semantics follow the
+// area-averaging definition used by the reference data path
+// (dataset/data_util.py:12-24: square crop + INTER_AREA + [-1,1] scale).
+
+#include "../include/nvs3d_io.h"
+
+#include <zlib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+int fail(const std::string &msg) {
+  g_error = msg;
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// PNG decoding
+// ---------------------------------------------------------------------------
+struct Image {
+  int w = 0, h = 0, channels = 0;  // channels of the DECODED buffer
+  std::vector<uint8_t> rgb;        // always 3*w*h after to_rgb
+};
+
+uint32_t be32(const uint8_t *p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+int paeth(int a, int b, int c) {
+  int p = a + b - c;
+  int pa = std::abs(p - a), pb = std::abs(p - b), pc = std::abs(p - c);
+  if (pa <= pb && pa <= pc) return a;
+  if (pb <= pc) return b;
+  return c;
+}
+
+bool zlib_inflate(const std::vector<uint8_t> &in, std::vector<uint8_t> &out,
+                  std::string &err) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (inflateInit(&zs) != Z_OK) {
+    err = "inflateInit failed";
+    return false;
+  }
+  zs.next_in = const_cast<Bytef *>(in.data());
+  zs.avail_in = static_cast<uInt>(in.size());
+  zs.next_out = out.data();
+  zs.avail_out = static_cast<uInt>(out.size());
+  int rc = inflate(&zs, Z_FINISH);
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END || zs.avail_out != 0) {
+    err = "zlib inflate failed or size mismatch";
+    return false;
+  }
+  return true;
+}
+
+bool decode_png_rgb(const std::vector<uint8_t> &buf, Image &img,
+                    std::string &err) {
+  static const uint8_t SIG[8] = {137, 80, 78, 71, 13, 10, 26, 10};
+  if (buf.size() < 8 || std::memcmp(buf.data(), SIG, 8) != 0) {
+    err = "not a PNG file";
+    return false;
+  }
+  size_t pos = 8;
+  int w = 0, h = 0, depth = 0, color = 0, interlace = 0;
+  std::vector<uint8_t> idat;
+  std::vector<uint8_t> palette;  // 3 bytes per entry
+  bool saw_ihdr = false, saw_iend = false;
+
+  while (pos + 8 <= buf.size() && !saw_iend) {
+    uint32_t len = be32(&buf[pos]);
+    if (pos + 12 + len > buf.size()) {
+      err = "truncated PNG chunk";
+      return false;
+    }
+    const uint8_t *type = &buf[pos + 4];
+    const uint8_t *data = &buf[pos + 8];
+    if (!std::memcmp(type, "IHDR", 4)) {
+      if (len != 13) {
+        err = "bad IHDR";
+        return false;
+      }
+      w = int(be32(data));
+      h = int(be32(data + 4));
+      depth = data[8];
+      color = data[9];
+      interlace = data[12];
+      saw_ihdr = true;
+    } else if (!std::memcmp(type, "PLTE", 4)) {
+      palette.assign(data, data + len);
+    } else if (!std::memcmp(type, "IDAT", 4)) {
+      idat.insert(idat.end(), data, data + len);
+    } else if (!std::memcmp(type, "IEND", 4)) {
+      saw_iend = true;
+    }
+    pos += 12 + len;  // len + type + data + crc
+  }
+  if (!saw_ihdr || w <= 0 || h <= 0) {
+    err = "missing IHDR";
+    return false;
+  }
+  if (interlace != 0) {
+    err = "interlaced PNG not supported";
+    return false;
+  }
+  if (depth != 8 && depth != 16) {
+    err = "unsupported PNG bit depth " + std::to_string(depth);
+    return false;
+  }
+  int samples;  // per pixel, in the coded stream
+  switch (color) {
+    case 0: samples = 1; break;  // gray
+    case 2: samples = 3; break;  // rgb
+    case 3: samples = 1; break;  // palette (depth must be 8 here)
+    case 4: samples = 2; break;  // gray+alpha
+    case 6: samples = 4; break;  // rgba
+    default:
+      err = "unsupported PNG color type " + std::to_string(color);
+      return false;
+  }
+  if (color == 3 && depth != 8) {
+    err = "palette PNG with depth != 8 not supported";
+    return false;
+  }
+  const int bps = depth / 8;               // bytes per sample
+  const int bpp = samples * bps;           // bytes per pixel
+  const size_t stride = size_t(w) * bpp;   // bytes per scanline (no filter)
+  std::vector<uint8_t> raw(size_t(h) * (stride + 1));
+  if (!zlib_inflate(idat, raw, err)) return false;
+
+  // Unfilter in place into `flat` (filter types 0..4, RFC 2083 §6).
+  std::vector<uint8_t> flat(size_t(h) * stride);
+  for (int y = 0; y < h; ++y) {
+    const uint8_t *src = &raw[size_t(y) * (stride + 1)];
+    uint8_t filter = src[0];
+    const uint8_t *line = src + 1;
+    uint8_t *dst = &flat[size_t(y) * stride];
+    const uint8_t *up = y > 0 ? &flat[size_t(y - 1) * stride] : nullptr;
+    for (size_t i = 0; i < stride; ++i) {
+      int a = i >= size_t(bpp) ? dst[i - bpp] : 0;       // left
+      int b = up ? up[i] : 0;                            // above
+      int c = (up && i >= size_t(bpp)) ? up[i - bpp] : 0;  // above-left
+      int x = line[i];
+      switch (filter) {
+        case 0: break;
+        case 1: x += a; break;
+        case 2: x += b; break;
+        case 3: x += (a + b) / 2; break;
+        case 4: x += paeth(a, b, c); break;
+        default:
+          err = "bad PNG filter type";
+          return false;
+      }
+      dst[i] = uint8_t(x & 0xff);
+    }
+  }
+
+  // Convert to RGB8; alpha dropped, matching PIL convert("RGB") semantics of
+  // the Python path. 16-bit gray opens in PIL as mode I/I;16 whose RGB
+  // conversion CLIPS the raw value at 255 — mirror that; 16-bit color keeps
+  // the high byte (PIL reads 48-bit PNGs as 8-bit per channel).
+  img.w = w;
+  img.h = h;
+  img.channels = 3;
+  img.rgb.resize(size_t(w) * h * 3);
+  auto gray16 = [&](const uint8_t *p) -> uint8_t {
+    unsigned v = (unsigned(p[0]) << 8) | p[1];
+    return uint8_t(std::min(255u, v));
+  };
+  for (size_t px = 0; px < size_t(w) * h; ++px) {
+    const uint8_t *p = &flat[px * bpp];
+    uint8_t r, g, b;
+    switch (color) {
+      case 0:
+        r = g = b = (depth == 16) ? gray16(p) : p[0];
+        break;
+      case 2: r = p[0]; g = p[bps]; b = p[2 * bps]; break;
+      case 3: {
+        size_t idx = size_t(p[0]) * 3;
+        if (idx + 2 >= palette.size()) {
+          err = "palette index out of range";
+          return false;
+        }
+        r = palette[idx]; g = palette[idx + 1]; b = palette[idx + 2];
+        break;
+      }
+      case 4:
+        r = g = b = (depth == 16) ? gray16(p) : p[0];
+        break;
+      case 6: r = p[0]; g = p[bps]; b = p[2 * bps]; break;
+      default: r = g = b = 0; break;
+    }
+    img.rgb[px * 3] = r;
+    img.rgb[px * 3 + 1] = g;
+    img.rgb[px * 3 + 2] = b;
+  }
+  return true;
+}
+
+bool read_file(const char *path, std::vector<uint8_t> &buf, std::string &err) {
+  std::ifstream f(path, std::ios::binary | std::ios::ate);
+  if (!f) {
+    err = std::string("cannot open ") + path;
+    return false;
+  }
+  std::streamsize size = f.tellg();
+  f.seekg(0);
+  buf.resize(size_t(size));
+  if (!f.read(reinterpret_cast<char *>(buf.data()), size)) {
+    err = std::string("cannot read ") + path;
+    return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Image ops: crop, resize, normalize
+// ---------------------------------------------------------------------------
+// Area-average resize (box filter over the exact fractional source region of
+// each destination pixel) — the downscale semantics of INTER_AREA. For
+// upscale falls back to bilinear.
+void resize_area(const float *src, int sh, int sw, float *dst, int dh, int dw,
+                 int c) {
+  const double sy = double(sh) / dh, sx = double(sw) / dw;
+  if (sy >= 1.0 && sx >= 1.0) {
+    for (int i = 0; i < dh; ++i) {
+      double y0 = i * sy, y1 = (i + 1) * sy;
+      int iy0 = int(std::floor(y0)), iy1 = std::min(sh, int(std::ceil(y1)));
+      for (int j = 0; j < dw; ++j) {
+        double x0 = j * sx, x1 = (j + 1) * sx;
+        int ix0 = int(std::floor(x0)), ix1 = std::min(sw, int(std::ceil(x1)));
+        for (int ch = 0; ch < c; ++ch) {
+          double acc = 0.0, wsum = 0.0;
+          for (int y = iy0; y < iy1; ++y) {
+            double wy = std::min(y1, double(y + 1)) - std::max(y0, double(y));
+            for (int x = ix0; x < ix1; ++x) {
+              double wx =
+                  std::min(x1, double(x + 1)) - std::max(x0, double(x));
+              acc += wy * wx * src[(size_t(y) * sw + x) * c + ch];
+              wsum += wy * wx;
+            }
+          }
+          dst[(size_t(i) * dw + j) * c + ch] = float(acc / wsum);
+        }
+      }
+    }
+  } else {  // bilinear for upscale
+    for (int i = 0; i < dh; ++i) {
+      double fy = (i + 0.5) * sy - 0.5;
+      int y0 = std::max(0, std::min(sh - 1, int(std::floor(fy))));
+      int y1 = std::min(sh - 1, y0 + 1);
+      double wy = fy - y0;
+      for (int j = 0; j < dw; ++j) {
+        double fx = (j + 0.5) * sx - 0.5;
+        int x0 = std::max(0, std::min(sw - 1, int(std::floor(fx))));
+        int x1 = std::min(sw - 1, x0 + 1);
+        double wx = fx - x0;
+        for (int ch = 0; ch < c; ++ch) {
+          double v00 = src[(size_t(y0) * sw + x0) * c + ch];
+          double v01 = src[(size_t(y0) * sw + x1) * c + ch];
+          double v10 = src[(size_t(y1) * sw + x0) * c + ch];
+          double v11 = src[(size_t(y1) * sw + x1) * c + ch];
+          dst[(size_t(i) * dw + j) * c + ch] =
+              float((1 - wy) * ((1 - wx) * v00 + wx * v01) +
+                    wy * ((1 - wx) * v10 + wx * v11));
+        }
+      }
+    }
+  }
+}
+
+// load_rgb semantics of the Python path (data/srn.py:98-111): decode ->
+// /255 -> square center crop (even size) -> area resize -> (x-0.5)*2.
+int load_rgb_impl(const char *path, int sidelength, float *out,
+                  std::string &err) {
+  std::vector<uint8_t> buf;
+  if (!read_file(path, buf, err)) return 1;
+  Image img;
+  if (!decode_png_rgb(buf, img, err)) return 1;
+
+  int h = img.h, w = img.w;
+  int m = std::min(h, w);
+  int half = m / 2;
+  int side = 2 * half;  // matches numpy [c-m//2 : c+m//2]
+  int ch = h / 2, cw = w / 2;
+  int r0 = ch - half, c0 = cw - half;
+
+  std::vector<float> cropped(size_t(side) * side * 3);
+  for (int y = 0; y < side; ++y)
+    for (int x = 0; x < side; ++x)
+      for (int k = 0; k < 3; ++k)
+        cropped[(size_t(y) * side + x) * 3 + k] =
+            img.rgb[(size_t(y + r0) * w + (x + c0)) * 3 + k] / 255.0f;
+
+  std::vector<float> resized;
+  const float *final_px = cropped.data();
+  if (side != sidelength) {
+    resized.resize(size_t(sidelength) * sidelength * 3);
+    resize_area(cropped.data(), side, side, resized.data(), sidelength,
+                sidelength, 3);
+    final_px = resized.data();
+  }
+  size_t n = size_t(sidelength) * sidelength * 3;
+  for (size_t i = 0; i < n; ++i) out[i] = (final_px[i] - 0.5f) * 2.0f;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Text parsers
+// ---------------------------------------------------------------------------
+int parse_pose_impl(const char *path, float *out16, std::string &err) {
+  std::ifstream f(path);
+  if (!f) {
+    err = std::string("cannot open ") + path;
+    return 1;
+  }
+  int i = 0;
+  double v;
+  while (i < 16 && (f >> v)) out16[i++] = float(v);
+  if (i < 16) {
+    err = std::string("pose file has fewer than 16 values: ") + path;
+    return 1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *nvs3d_last_error(void) { return g_error.c_str(); }
+
+int nvs3d_decode_png_rgb(const char *path, int *w, int *h, uint8_t *out,
+                         size_t max_bytes) {
+  std::vector<uint8_t> buf;
+  std::string err;
+  if (!read_file(path, buf, err)) return fail(err);
+  Image img;
+  if (!decode_png_rgb(buf, img, err)) return fail(err);
+  size_t need = size_t(img.w) * img.h * 3;
+  if (need > max_bytes)
+    return fail("output buffer too small for " + std::to_string(need) +
+                " bytes");
+  *w = img.w;
+  *h = img.h;
+  std::memcpy(out, img.rgb.data(), need);
+  return 0;
+}
+
+int nvs3d_load_rgb(const char *path, int sidelength, float *out) {
+  std::string err;
+  if (load_rgb_impl(path, sidelength, out, err)) return fail(err);
+  return 0;
+}
+
+int nvs3d_load_rgb_batch(const char **paths, int n, int sidelength,
+                         int n_threads, float *out) {
+  if (n <= 0) return 0;
+  n_threads = std::max(1, std::min(n_threads, n));
+  std::atomic<int> failed{-1};
+  std::vector<std::string> errs;
+  errs.resize(size_t(n_threads));
+  std::vector<std::thread> pool;
+  const size_t per = size_t(sidelength) * sidelength * 3;
+  for (int t = 0; t < n_threads; ++t) {
+    pool.emplace_back([&, t]() {
+      for (int i = t; i < n; i += n_threads) {
+        if (failed.load(std::memory_order_relaxed) >= 0) return;
+        std::string err;
+        if (load_rgb_impl(paths[i], sidelength, out + per * i, err)) {
+          errs[t] = err;
+          failed.store(i);
+          return;
+        }
+      }
+    });
+  }
+  for (auto &th : pool) th.join();
+  if (failed.load() >= 0) {
+    for (auto &e : errs)
+      if (!e.empty()) return fail(e);
+    return fail("batch decode failed");
+  }
+  return 0;
+}
+
+int nvs3d_parse_pose(const char *path, float *out16) {
+  std::string err;
+  if (parse_pose_impl(path, out16, err)) return fail(err);
+  return 0;
+}
+
+int nvs3d_parse_intrinsics(const char *path, int sidelength, float *K9,
+                           float *barycenter3, float *scale, int *world2cam) {
+  std::ifstream f(path);
+  if (!f) return fail(std::string("cannot open ") + path);
+  std::string line;
+  double fx, cx, cy, skip;
+  if (!std::getline(f, line)) return fail("intrinsics: missing line 1");
+  {
+    std::istringstream ss(line);
+    if (!(ss >> fx >> cx >> cy >> skip))
+      return fail("intrinsics: bad line 1");
+  }
+  if (!std::getline(f, line)) return fail("intrinsics: missing barycenter");
+  {
+    std::istringstream ss(line);
+    double a = 0, b = 0, c = 0;
+    ss >> a >> b >> c;
+    barycenter3[0] = float(a);
+    barycenter3[1] = float(b);
+    barycenter3[2] = float(c);
+  }
+  if (!std::getline(f, line)) return fail("intrinsics: missing scale");
+  *scale = float(std::atof(line.c_str()));
+  if (!std::getline(f, line)) return fail("intrinsics: missing height/width");
+  double height, width;
+  {
+    std::istringstream ss(line);
+    if (!(ss >> height >> width)) return fail("intrinsics: bad height/width");
+  }
+  *world2cam = 0;
+  if (std::getline(f, line)) {
+    std::istringstream ss(line);
+    int flag;
+    if (ss >> flag) *world2cam = flag ? 1 : 0;
+  }
+  if (sidelength > 0) {
+    cx = cx / width * sidelength;
+    cy = cy / height * sidelength;
+    fx = sidelength / height * fx;
+  }
+  K9[0] = float(fx); K9[1] = 0.0f;      K9[2] = float(cx);
+  K9[3] = 0.0f;      K9[4] = float(fx); K9[5] = float(cy);
+  K9[6] = 0.0f;      K9[7] = 0.0f;      K9[8] = 1.0f;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Threaded prefetching pair loader
+// ---------------------------------------------------------------------------
+namespace {
+
+struct Batch {
+  uint64_t serial = 0;  // global batch sequence number (delivery order)
+  std::vector<float> x, target, pose1, pose2;
+  std::vector<int32_t> record_idx;
+};
+
+struct Loader {
+  std::vector<std::string> rgb_paths, pose_paths;
+  std::vector<int32_t> instance_of;            // record -> instance
+  std::vector<std::vector<int32_t>> members;   // instance -> records
+  int sidelength, batch_size, prefetch_depth;
+  int shard_index, shard_count;
+  uint64_t seed;
+
+  std::vector<int32_t> shard_records;  // records this shard may emit
+
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  // Completed batches keyed by serial; delivered strictly in serial order so
+  // the output stream is deterministic in (seed, shard) regardless of thread
+  // count or scheduling.
+  std::deque<std::unique_ptr<Batch>> queue;
+  uint64_t next_serial_out = 0;
+  std::vector<std::thread> workers;
+  bool stop = false;
+  std::string error;
+
+  // Work distribution: a global epoch permutation carved into batches;
+  // workers claim batch slots (with a global serial) under epoch_mu.
+  std::vector<int32_t> order;
+  size_t cursor = 0;
+  std::mutex epoch_mu;
+  uint64_t epoch = 0;
+  uint64_t serial_counter = 0;
+
+  void reshuffle_locked() {
+    std::mt19937_64 rng(seed ^ (0x9e3779b97f4a7c15ULL * (epoch + 1)));
+    order = shard_records;
+    std::shuffle(order.begin(), order.end(), rng);
+    size_t usable = (order.size() / batch_size) * batch_size;
+    order.resize(usable);  // drop remainder (reference DataLoader drop_last)
+    cursor = 0;
+    ++epoch;
+  }
+
+  bool claim(std::vector<int32_t> &batch_records, uint64_t &batch_tag,
+             uint64_t &serial) {
+    std::lock_guard<std::mutex> lk(epoch_mu);
+    if (cursor + batch_size > order.size()) {
+      reshuffle_locked();
+      if (cursor + batch_size > order.size()) return false;  // tiny dataset
+    }
+    size_t start = cursor;
+    cursor += size_t(batch_size);
+    batch_records.assign(order.begin() + start,
+                         order.begin() + start + batch_size);
+    // Tag depends only on (epoch, position): the target-view choice is
+    // deterministic in (seed, shard) no matter which thread runs the batch.
+    batch_tag = epoch * (uint64_t(1) << 32) + start;
+    serial = serial_counter++;
+    return true;
+  }
+
+  void worker_main() {
+    const size_t img = size_t(sidelength) * sidelength * 3;
+    std::vector<int32_t> records;
+    uint64_t tag = 0, serial = 0;
+    while (true) {
+      {
+        // Claim-then-wait: the serial is reserved first, and the worker
+        // blocks until its serial is inside the delivery window. This keeps
+        // at most prefetch_depth batches in flight with no deadlock (the
+        // lowest outstanding serial is always admitted).
+        std::unique_lock<std::mutex> lk(mu);
+        if (stop) return;
+      }
+      if (!claim(records, tag, serial)) {
+        std::lock_guard<std::mutex> lk(mu);
+        error = "dataset smaller than one batch";
+        stop = true;
+        cv_get.notify_all();
+        return;
+      }
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] {
+          return stop ||
+                 serial < next_serial_out + uint64_t(prefetch_depth);
+        });
+        if (stop) return;
+      }
+      auto b = std::make_unique<Batch>();
+      b->serial = serial;
+      b->x.resize(img * batch_size);
+      b->target.resize(img * batch_size);
+      b->pose1.resize(16 * size_t(batch_size));
+      b->pose2.resize(16 * size_t(batch_size));
+      b->record_idx.assign(records.begin(), records.end());
+      std::mt19937_64 rng(seed ^ (tag * 0xda942042e4dd58b5ULL));
+      std::string err;
+      for (int i = 0; i < batch_size; ++i) {
+        int32_t rec = records[i];
+        const auto &sibs = members[size_t(instance_of[size_t(rec)])];
+        std::uniform_int_distribution<size_t> pick(0, sibs.size() - 1);
+        int32_t rec2 = sibs[pick(rng)];
+        if (load_rgb_impl(rgb_paths[size_t(rec)].c_str(), sidelength,
+                          b->x.data() + img * i, err) ||
+            load_rgb_impl(rgb_paths[size_t(rec2)].c_str(), sidelength,
+                          b->target.data() + img * i, err) ||
+            parse_pose_impl(pose_paths[size_t(rec)].c_str(),
+                            b->pose1.data() + 16 * i, err) ||
+            parse_pose_impl(pose_paths[size_t(rec2)].c_str(),
+                            b->pose2.data() + 16 * i, err)) {
+          std::lock_guard<std::mutex> lk(mu);
+          error = err;
+          stop = true;
+          cv_get.notify_all();
+          return;
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        // Insert ordered by serial (queue is tiny: ≤ prefetch_depth).
+        auto it = queue.begin();
+        while (it != queue.end() && (*it)->serial < b->serial) ++it;
+        queue.insert(it, std::move(b));
+        cv_get.notify_all();
+      }
+    }
+  }
+};
+
+}  // namespace
+
+void *nvs3d_loader_create(const char **rgb_paths, const char **pose_paths,
+                          const int32_t *instance_ids, int n_records,
+                          int sidelength, int batch_size, int n_threads,
+                          int prefetch_depth, uint64_t seed, int shard_index,
+                          int shard_count) {
+  if (n_records <= 0 || batch_size <= 0 || sidelength <= 0) {
+    g_error = "invalid loader arguments";
+    return nullptr;
+  }
+  auto L = std::make_unique<Loader>();
+  L->sidelength = sidelength;
+  L->batch_size = batch_size;
+  L->prefetch_depth = std::max(1, prefetch_depth);
+  L->seed = seed;
+  L->shard_index = std::max(0, shard_index);
+  L->shard_count = std::max(1, shard_count);
+  L->rgb_paths.reserve(size_t(n_records));
+  L->pose_paths.reserve(size_t(n_records));
+  int32_t max_inst = -1;
+  for (int i = 0; i < n_records; ++i) {
+    L->rgb_paths.emplace_back(rgb_paths[i]);
+    L->pose_paths.emplace_back(pose_paths[i]);
+    L->instance_of.push_back(instance_ids[i]);
+    max_inst = std::max(max_inst, instance_ids[i]);
+  }
+  L->members.resize(size_t(max_inst) + 1);
+  for (int i = 0; i < n_records; ++i)
+    L->members[size_t(instance_ids[i])].push_back(i);
+  for (auto &m : L->members)
+    if (m.empty()) {
+      g_error = "instance with no observations";
+      return nullptr;
+    }
+  for (int i = L->shard_index; i < n_records; i += L->shard_count)
+    L->shard_records.push_back(i);
+  if (int(L->shard_records.size()) < batch_size) {
+    g_error = "shard smaller than one batch";
+    return nullptr;
+  }
+  {
+    std::lock_guard<std::mutex> lk(L->epoch_mu);
+    L->reshuffle_locked();
+  }
+  int nt = std::max(1, n_threads);
+  for (int t = 0; t < nt; ++t)
+    L->workers.emplace_back(&Loader::worker_main, L.get());
+  return L.release();
+}
+
+int nvs3d_loader_next(void *loader, float *x, float *target, float *pose1,
+                      float *pose2, int32_t *record_idx) {
+  auto *L = static_cast<Loader *>(loader);
+  std::unique_ptr<Batch> b;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_get.wait(lk, [&] {
+      return L->stop || (!L->queue.empty() &&
+                         L->queue.front()->serial == L->next_serial_out);
+    });
+    if (L->queue.empty() ||
+        L->queue.front()->serial != L->next_serial_out)
+      return fail(L->error.empty() ? "loader stopped" : L->error);
+    b = std::move(L->queue.front());
+    L->queue.pop_front();
+    ++L->next_serial_out;
+    L->cv_put.notify_all();
+  }
+  std::memcpy(x, b->x.data(), b->x.size() * sizeof(float));
+  std::memcpy(target, b->target.data(), b->target.size() * sizeof(float));
+  std::memcpy(pose1, b->pose1.data(), b->pose1.size() * sizeof(float));
+  std::memcpy(pose2, b->pose2.data(), b->pose2.size() * sizeof(float));
+  std::memcpy(record_idx, b->record_idx.data(),
+              b->record_idx.size() * sizeof(int32_t));
+  return 0;
+}
+
+void nvs3d_loader_destroy(void *loader) {
+  auto *L = static_cast<Loader *>(loader);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop = true;
+  }
+  L->cv_put.notify_all();
+  L->cv_get.notify_all();
+  for (auto &t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"

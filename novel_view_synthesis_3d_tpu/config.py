@@ -44,6 +44,9 @@ class ModelConfig:
     dtype: str = "float32"  # compute dtype: "float32" | "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint each UNet block (memory for FLOPs)
+    # Fused Pallas attention kernel (ops/flash_attention.py) instead of the
+    # XLA dot_product_attention path. Interpreted (slow but exact) off-TPU.
+    use_flash_attention: bool = False
 
     @property
     def num_frames(self) -> int:
@@ -75,7 +78,10 @@ class DataConfig:
     max_observations_per_instance: int = 50
     specific_observation_idcs: Optional[Tuple[int, ...]] = None
     samples_per_instance: int = 1
-    # Pipeline
+    # Pipeline backend: 'native' = C++ threaded loader (native/libnvs3d_io.so,
+    # falls back to grain if the library can't build), 'grain' = Grain worker
+    # processes, 'python' = in-process iterator.
+    loader: str = "native"
     num_workers: int = 8
     prefetch: int = 4
     shuffle_seed: int = 0

@@ -1,0 +1,244 @@
+"""ctypes bindings for the native IO runtime (native/libnvs3d_io.so).
+
+The C++ library replaces the reference's native data-path dependencies
+(SURVEY.md §2.4: torch DataLoader workers, OpenCV resize, imageio decode)
+with a first-party host runtime: zlib PNG decode, area resize, SRN text
+parsers, and a threaded shuffling prefetch loader.
+
+Everything here degrades gracefully: if the shared library is missing it is
+built on demand with `make`; if that fails, `available()` returns False and
+callers fall back to the pure-Python path (data/srn.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libnvs3d_io.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, _load_failed
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _load_failed = True
+            return None
+        c_char_pp = ctypes.POINTER(ctypes.c_char_p)
+        f32_p = ctypes.POINTER(ctypes.c_float)
+        i32_p = ctypes.POINTER(ctypes.c_int32)
+
+        lib.nvs3d_last_error.restype = ctypes.c_char_p
+        lib.nvs3d_decode_png_rgb.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t]
+        lib.nvs3d_load_rgb.argtypes = [ctypes.c_char_p, ctypes.c_int, f32_p]
+        lib.nvs3d_load_rgb_batch.argtypes = [
+            c_char_pp, ctypes.c_int, ctypes.c_int, ctypes.c_int, f32_p]
+        lib.nvs3d_parse_pose.argtypes = [ctypes.c_char_p, f32_p]
+        lib.nvs3d_parse_intrinsics.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, f32_p, f32_p, f32_p,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.nvs3d_loader_create.restype = ctypes.c_void_p
+        lib.nvs3d_loader_create.argtypes = [
+            c_char_pp, c_char_pp, i32_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int]
+        lib.nvs3d_loader_next.argtypes = [
+            ctypes.c_void_p, f32_p, f32_p, f32_p, f32_p, i32_p]
+        lib.nvs3d_loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _err(lib) -> str:
+    return lib.nvs3d_last_error().decode("utf-8", "replace")
+
+
+def _paths_array(paths: Sequence[str]):
+    arr = (ctypes.c_char_p * len(paths))()
+    arr[:] = [p.encode() for p in paths]
+    return arr
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def load_rgb(path: str, sidelength: int) -> np.ndarray:
+    """Native load_rgb → (S, S, 3) float32 in [-1, 1]."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native IO library unavailable")
+    out = np.empty((sidelength, sidelength, 3), dtype=np.float32)
+    if lib.nvs3d_load_rgb(path.encode(), sidelength, _f32p(out)):
+        raise RuntimeError(f"nvs3d_load_rgb: {_err(lib)}")
+    return out
+
+
+def load_rgb_batch(paths: Sequence[str], sidelength: int,
+                   n_threads: int = 8) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native IO library unavailable")
+    out = np.empty((len(paths), sidelength, sidelength, 3), dtype=np.float32)
+    if lib.nvs3d_load_rgb_batch(_paths_array(paths), len(paths), sidelength,
+                                n_threads, _f32p(out)):
+        raise RuntimeError(f"nvs3d_load_rgb_batch: {_err(lib)}")
+    return out
+
+
+def parse_pose(path: str) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native IO library unavailable")
+    out = np.empty(16, dtype=np.float32)
+    if lib.nvs3d_parse_pose(path.encode(), _f32p(out)):
+        raise RuntimeError(f"nvs3d_parse_pose: {_err(lib)}")
+    return out.reshape(4, 4)
+
+
+def parse_intrinsics(path: str, sidelength: Optional[int] = None
+                     ) -> Tuple[np.ndarray, np.ndarray, float, bool]:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native IO library unavailable")
+    K = np.empty(9, dtype=np.float32)
+    bary = np.empty(3, dtype=np.float32)
+    scale = ctypes.c_float()
+    w2c = ctypes.c_int()
+    if lib.nvs3d_parse_intrinsics(path.encode(),
+                                  sidelength if sidelength else 0,
+                                  _f32p(K), _f32p(bary),
+                                  ctypes.byref(scale), ctypes.byref(w2c)):
+        raise RuntimeError(f"nvs3d_parse_intrinsics: {_err(lib)}")
+    return K.reshape(3, 3), bary, float(scale.value), bool(w2c.value)
+
+
+class NativePairLoader:
+    """Threaded shuffling pair loader backed by the C++ runtime.
+
+    Yields the same batch dict as data/pipeline.iter_batches — clean image
+    pairs + 4×4 poses decomposed into R/t, plus per-record intrinsics —
+    but with decode, shuffle, pairing, and prefetch all in native worker
+    threads (the reference's torch-DataLoader role, train.py:108-113).
+    """
+
+    def __init__(self, rgb_paths: Sequence[str], pose_paths: Sequence[str],
+                 instance_ids: Sequence[int], Ks: np.ndarray, *,
+                 sidelength: int, batch_size: int, n_threads: int = 8,
+                 prefetch_depth: int = 4, seed: int = 0,
+                 shard_index: int = 0, shard_count: int = 1):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native IO library unavailable")
+        assert len(rgb_paths) == len(pose_paths) == len(instance_ids)
+        self._lib = lib
+        self._B = batch_size
+        self._S = sidelength
+        # Keep path arrays alive for the loader's lifetime (the C++ side
+        # copies at create time, but be conservative about GC ordering).
+        self._rgb_arr = _paths_array(rgb_paths)
+        self._pose_arr = _paths_array(pose_paths)
+        inst = np.ascontiguousarray(np.asarray(instance_ids, dtype=np.int32))
+        self._inst = inst
+        self._Ks = np.asarray(Ks, dtype=np.float32)  # (n_records, 3, 3)
+        assert self._Ks.shape == (len(rgb_paths), 3, 3)
+        self._handle = lib.nvs3d_loader_create(
+            self._rgb_arr, self._pose_arr,
+            inst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(rgb_paths), sidelength, batch_size, n_threads,
+            prefetch_depth, seed, shard_index, shard_count)
+        if not self._handle:
+            raise RuntimeError(f"nvs3d_loader_create: {_err(lib)}")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        B, S = self._B, self._S
+        x = np.empty((B, S, S, 3), dtype=np.float32)
+        target = np.empty((B, S, S, 3), dtype=np.float32)
+        pose1 = np.empty((B, 4, 4), dtype=np.float32)
+        pose2 = np.empty((B, 4, 4), dtype=np.float32)
+        idx = np.empty((B,), dtype=np.int32)
+        rc = self._lib.nvs3d_loader_next(
+            self._handle, _f32p(x), _f32p(target), _f32p(pose1), _f32p(pose2),
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc:
+            raise RuntimeError(f"nvs3d_loader_next: {_err(self._lib)}")
+        return {
+            "x": x,
+            "target": target,
+            "R1": pose1[:, :3, :3].copy(),
+            "t1": pose1[:, :3, 3].copy(),
+            "R2": pose2[:, :3, :3].copy(),
+            "t2": pose2[:, :3, 3].copy(),
+            "K": self._Ks[idx],
+        }
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.nvs3d_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_native_loader(dataset, batch_size: int, *, n_threads: int = 8,
+                       prefetch_depth: int = 4, seed: int = 0,
+                       shard_index: int = 0,
+                       shard_count: int = 1) -> NativePairLoader:
+    """Build a NativePairLoader from a data/srn.SRNDataset."""
+    rgb: List[str] = []
+    pose: List[str] = []
+    inst: List[int] = []
+    Ks: List[np.ndarray] = []
+    for i, instance in enumerate(dataset.instances):
+        for c, p in zip(instance.color_paths, instance.pose_paths):
+            rgb.append(c)
+            pose.append(p)
+            inst.append(i)
+            Ks.append(instance.K)
+    return NativePairLoader(
+        rgb, pose, inst, np.stack(Ks), sidelength=dataset.img_sidelength,
+        batch_size=batch_size, n_threads=n_threads,
+        prefetch_depth=prefetch_depth, seed=seed,
+        shard_index=shard_index, shard_count=shard_count)

@@ -144,6 +144,7 @@ class AttnLayer(nn.Module):
 
     attn_heads: int = 4
     out_proj: bool = False
+    use_flash: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -155,7 +156,12 @@ class AttnLayer(nn.Module):
         qh = nn.DenseGeneral((self.attn_heads, head_dim), **kw)(q)
         kh = nn.DenseGeneral((self.attn_heads, head_dim), **kw)(kv)
         vh = nn.DenseGeneral((self.attn_heads, head_dim), **kw)(kv)
-        out = nn.dot_product_attention(qh, kh, vh)  # (B, L, heads, head_dim)
+        if self.use_flash:
+            from novel_view_synthesis_3d_tpu.ops.flash_attention import (
+                flash_attention)
+            out = flash_attention(qh, kh, vh)
+        else:
+            out = nn.dot_product_attention(qh, kh, vh)  # (B, L, heads, hd)
         if self.out_proj:
             return nn.DenseGeneral(C, axis=(-2, -1), kernel_init=out_init_scale(),
                                    **kw)(out)
@@ -175,6 +181,7 @@ class AttnBlock(nn.Module):
     attn_type: str
     attn_heads: int = 4
     out_proj: bool = False
+    use_flash: bool = False
     per_frame_gn: bool = True
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
@@ -185,6 +192,7 @@ class AttnBlock(nn.Module):
         h = GroupNorm(per_frame=self.per_frame_gn, dtype=self.dtype)(h_in)
         tokens = h.reshape(B, F, H * W, C)
         layer = AttnLayer(attn_heads=self.attn_heads, out_proj=self.out_proj,
+                          use_flash=self.use_flash,
                           dtype=self.dtype, param_dtype=self.param_dtype)
         if self.attn_type == "self":
             out = layer(q=tokens.reshape(B * F, H * W, C),
@@ -215,6 +223,7 @@ class XUNetBlock(nn.Module):
     use_attn: bool = False
     attn_heads: int = 4
     attn_out_proj: bool = False
+    attn_use_flash: bool = False
     dropout: float = 0.0
     train: bool = False  # attribute (not call arg) so nn.remat needs no statics
     per_frame_gn: bool = True
@@ -225,12 +234,12 @@ class XUNetBlock(nn.Module):
     def __call__(self, x: jnp.ndarray, emb: jnp.ndarray) -> jnp.ndarray:
         kw = dict(per_frame_gn=self.per_frame_gn, dtype=self.dtype,
                   param_dtype=self.param_dtype)
+        attn_kw = dict(attn_heads=self.attn_heads, out_proj=self.attn_out_proj,
+                       use_flash=self.attn_use_flash, **kw)
         h = ResnetBlock(features=self.features, dropout=self.dropout,
                         **kw)(x, emb, train=self.train)
         if self.use_attn:
-            h = AttnBlock(attn_type="self", attn_heads=self.attn_heads,
-                          out_proj=self.attn_out_proj, **kw)(h)
+            h = AttnBlock(attn_type="self", **attn_kw)(h)
             if h.shape[1] >= 2:
-                h = AttnBlock(attn_type="cross", attn_heads=self.attn_heads,
-                              out_proj=self.attn_out_proj, **kw)(h)
+                h = AttnBlock(attn_type="cross", **attn_kw)(h)
         return h

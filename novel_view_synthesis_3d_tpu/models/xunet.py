@@ -170,6 +170,7 @@ class XUNet(nn.Module):
                 use_attn=use_attn,
                 attn_heads=cfg.attn_heads,
                 attn_out_proj=cfg.attn_out_proj,
+                attn_use_flash=cfg.use_flash_attention,
                 dropout=cfg.dropout,
                 train=train,
                 **blk_kw,

@@ -1,0 +1,189 @@
+"""Fused multi-head attention as a Pallas TPU kernel.
+
+The reference computes attention with `flax.nn.dot_product_attention`
+(/root/reference/model/xunet.py:101), which materializes the (L, L) score
+matrix in HBM between ops. This kernel keeps the whole
+score→softmax→weighted-sum chain in VMEM, streaming one query block at a
+time against the full key/value sequence (which for one (batch, head) pair
+fits comfortably in VMEM at every config in the ladder — L ≤ 65k would not,
+but attention only runs at coarse resolutions {8,16,32} ⇒ L ≤ 1024 tokens,
+and cross-frame attention at k+1 frames tops out at a few thousand).
+
+Layout notes (pallas_guide.md "Tiling Constraints"):
+  - lanes (last dim) padded to a multiple of 128; sublanes to the dtype
+    minimum. Padding is applied in the wrapper, masked inside the kernel
+    with a statically-known length, and sliced off afterwards.
+  - matmuls request `preferred_element_type=float32` so the MXU accumulates
+    in f32 even for bf16 inputs; softmax runs in f32.
+
+The backward pass is a custom VJP using the standard flash-attention
+residuals (out, logsumexp): probabilities are recomputed from q·k and lse —
+no (L, L) tensor is saved between forward and backward. The backward
+contraction itself is left to XLA (einsums fuse well on the MXU and the
+sequence lengths here keep the rematerialized scores in the same size class
+as the activations).
+
+Falls back to interpreter mode off-TPU so the same code path is unit-tested
+on the CPU mesh (tests/test_flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-capable jaxlibs; interpret mode needs pl only
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_INF = -1e30
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                 kv_len: int):
+    """One query block vs. the full key/value sequence, entirely in VMEM.
+
+    q_ref (1, Bq, D) · k_ref/v_ref (1, Lk_pad, D) · o_ref (1, Bq, D) ·
+    lse_ref (1, Bq, 128) — lse broadcast across the lane dim to satisfy the
+    TPU (sublane, lane) tiling constraint on output blocks.
+    `kv_len` is the true (unpadded) kv length — static.
+    """
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if kv_len < k.shape[0]:  # mask padded kv columns (static condition)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < kv_len, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+    lse = m + jnp.log(l)  # (Bq, 1)
+    lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], lse_ref.shape[-1]))
+
+
+def _flash_fwd_padded(q, k, v, *, scale: float, kv_len: int, block_q: int,
+                      interpret: bool):
+    """q (N, Lq_pad, Dp) · k,v (N, Lk_pad, Dp) → (out, lse)."""
+    N, Lq, D = q.shape
+    Lk = k.shape[1]
+    grid = (N, Lq // block_q)
+    kernel = functools.partial(_attn_kernel, scale=scale, kv_len=kv_len)
+    mem = {} if _VMEM is None or interpret else {"memory_space": _VMEM}
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda n, i: (n, i, 0), **mem),
+            pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0), **mem),
+            pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0), **mem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda n, i: (n, i, 0), **mem),
+            pl.BlockSpec((1, block_q, 128), lambda n, i: (n, i, 0), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((N, Lq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[:, :, 0]
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, scale: float, block_q: int):
+    out, _ = _flash_fwd_core(q, k, v, scale, block_q)
+    return out
+
+
+def _flash_fwd_core(q, k, v, scale: float, block_q: int):
+    """(B, L, H, D) inputs → padded kernel call → unpadded (out, lse)."""
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    interpret = _use_interpret()
+    # (B, L, H, D) → (B·H, L, D): heads become independent grid rows.
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+    # Query block: a multiple of 16 sublanes (covers the f32 and bf16 tile
+    # minima) no larger than the padded query length. User-supplied block_q
+    # is rounded up so any value Mosaic-compiles on hardware.
+    block_q = ((block_q + 15) // 16) * 16
+    bq = min(block_q, max(16, ((Lq + 15) // 16) * 16))
+    qt = _pad_to(qt, 1, bq)
+    kt = _pad_to(kt, 1, 128)
+    vt = _pad_to(vt, 1, 128)
+    if not interpret:  # lane alignment for the MXU
+        qt = _pad_to(qt, 2, 128)
+        kt = _pad_to(kt, 2, 128)
+        vt = _pad_to(vt, 2, 128)
+    out, lse = _flash_fwd_padded(qt, kt, vt, scale=scale, kv_len=Lk,
+                                 block_q=bq, interpret=interpret)
+    out = out[:, :Lq, :D].reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
+    lse = lse[:, :Lq].reshape(B, H, Lq)
+    return out, lse
+
+
+def _flash_vjp_fwd(q, k, v, scale: float, block_q: int):
+    out, lse = _flash_fwd_core(q, k, v, scale, block_q)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(scale: float, block_q: int, res, g):
+    q, k, v, out, lse = res
+    # Recompute probabilities from the saved logsumexp (no (L,L) residual).
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    p = jnp.exp(s - lse[..., None])                      # (B,H,Lq,Lk)
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, g32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", g32, v.astype(jnp.float32))
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)  # (B,Lq,H)
+    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    scale: Optional[float] = None,
+                    block_q: int = 256) -> jnp.ndarray:
+    """Fused softmax(q·kᵀ/√D)·v. q (B, Lq, H, D), k/v (B, Lk, H, D).
+
+    Drop-in for `flax.linen.dot_product_attention` (same layout/scaling).
+    """
+    D = q.shape[-1]
+    scale = float(D ** -0.5) if scale is None else float(scale)
+    return _flash_attention(q, k, v, scale, int(block_q))

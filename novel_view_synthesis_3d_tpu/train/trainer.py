@@ -88,6 +88,7 @@ class Trainer:
         mesh_lib.validate_global_batch(self.mesh, tcfg.batch_size)
 
         # --- data ---
+        self._native_loader = None
         if data_iter is not None:
             self.data_iter = data_iter
             self.dataset = None
@@ -95,13 +96,27 @@ class Trainer:
             self.dataset = make_dataset(config.data)
             assert len(self.dataset) > 0
             local_bs = dist.local_batch_size(tcfg.batch_size)
-            if use_grain and config.data.num_workers > 0:
+            backend = config.data.loader if use_grain else "python"
+            if backend == "native":
+                from novel_view_synthesis_3d_tpu.data import native_io
+                if native_io.available():
+                    self._native_loader = native_io.make_native_loader(
+                        self.dataset, local_bs,
+                        n_threads=config.data.num_workers,
+                        prefetch_depth=config.data.prefetch,
+                        seed=config.data.shuffle_seed,
+                        shard_index=jax.process_index(),
+                        shard_count=jax.process_count())
+                    self.data_iter = iter(self._native_loader)
+                else:
+                    backend = "grain"  # graceful fallback
+            if backend == "grain" and config.data.num_workers > 0:
                 loader = make_grain_loader(
                     self.dataset, local_bs,
                     seed=config.data.shuffle_seed,
                     num_workers=config.data.num_workers)
                 self.data_iter = cycle(loader)
-            else:
+            elif self._native_loader is None:
                 self.data_iter = iter_batches(
                     self.dataset, local_bs, seed=config.data.shuffle_seed,
                     shard_index=jax.process_index(),
