@@ -47,6 +47,11 @@ class ModelConfig:
     # Fused Pallas attention kernel (ops/flash_attention.py) instead of the
     # XLA dot_product_attention path. Interpreted (slow but exact) off-TPU.
     use_flash_attention: bool = False
+    # Sequence parallelism: shard the H·W token axis of every attention over
+    # the mesh 'seq' axis and run ring attention (parallel/ring_attention.py,
+    # ppermute over ICI). Requires mesh.seq > 1 and token counts divisible
+    # by it; a no-op when the mesh has seq=1.
+    sequence_parallel: bool = False
 
     @property
     def num_frames(self) -> int:
@@ -108,10 +113,21 @@ class TrainConfig:
     optimizer: str = "adam"
     grad_clip: float = 0.0  # 0 = off
     warmup_steps: int = 0
+    # ZeRO/FSDP: shard params + optimizer state over the mesh 'data' axis
+    # (parallel/mesh.fsdp_spec). The reference replicates everything per
+    # device (train.py:46).
+    fsdp: bool = False
     ema_decay: float = 0.0  # 0 = off; 3DiM paper uses EMA for sampling
     results_folder: str = "./results"
     checkpoint_dir: str = "./checkpoints"
     resume: bool = True  # auto-resume from latest checkpoint (ref: absent)
+    # --- observability (SURVEY.md §5.1-5.2: the reference has none) ---
+    # jax.profiler trace window: [profile_from, profile_from+profile_steps).
+    # Traces land in <results_folder>/profile; 0 steps disables.
+    profile_from: int = 10
+    profile_steps: int = 0
+    # Debug mode: jax_debug_nans (NaN source localization in jitted code).
+    debug_nans: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

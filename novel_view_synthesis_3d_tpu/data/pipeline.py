@@ -60,7 +60,8 @@ def make_grain_loader(dataset: SRNDataset, batch_size: int,
                       num_epochs: Optional[int] = None,
                       shard_index: Optional[int] = None,
                       shard_count: Optional[int] = None,
-                      drop_remainder: bool = True):
+                      drop_remainder: bool = True,
+                      num_cond: int = 1):
     """Grain DataLoader yielding batched numpy dicts (per-host shard)."""
     import grain.python as pygrain
     import jax
@@ -72,7 +73,7 @@ def make_grain_loader(dataset: SRNDataset, batch_size: int,
 
     class PairTransform(pygrain.RandomMapTransform):
         def random_map(self, idx, rng: np.random.Generator):
-            return ds_ref.pair(int(idx), rng)
+            return ds_ref.pair(int(idx), rng, num_cond=num_cond)
 
     sampler = pygrain.IndexSampler(
         num_records=len(dataset),
@@ -98,7 +99,8 @@ def make_grain_loader(dataset: SRNDataset, batch_size: int,
 # In-process fallback iterator (tests, debugging, tiny datasets)
 # ---------------------------------------------------------------------------
 def iter_batches(dataset: SRNDataset, batch_size: int, *, seed: int = 0,
-                 shard_index: int = 0, shard_count: int = 1) -> Iterator[dict]:
+                 shard_index: int = 0, shard_count: int = 1,
+                 num_cond: int = 1) -> Iterator[dict]:
     """Infinite shuffled batch iterator without worker processes."""
     rng = np.random.default_rng(seed + shard_index)
     n = len(dataset)
@@ -106,7 +108,7 @@ def iter_batches(dataset: SRNDataset, batch_size: int, *, seed: int = 0,
     while True:
         order = rng.permutation(local)
         for start in range(0, len(order) - batch_size + 1, batch_size):
-            records = [dataset.pair(int(i), rng)
+            records = [dataset.pair(int(i), rng, num_cond=num_cond)
                        for i in order[start:start + batch_size]]
             yield {k: np.stack([r[k] for r in records]) for k in records[0]}
 

@@ -223,25 +223,41 @@ class SRNDataset:
         obj = int(np.searchsorted(self._offsets, flat_idx, side="right") - 1)
         return obj, int(flat_idx - self._offsets[obj])
 
-    def pair(self, flat_idx: int,
-             rng: np.random.Generator) -> dict:
-        """One training record: clean cond view (the indexed one) + a random
-        clean target view of the same instance, with poses + intrinsics.
+    def pair(self, flat_idx: int, rng: np.random.Generator,
+             num_cond: int = 1) -> dict:
+        """One training record: clean cond view(s) + a random clean target
+        view of the same instance, with poses + intrinsics.
 
-        Matches the reference's per-item semantics (data_loader.py:80-113:
-        item idx = conditioning view, uniformly random second view = target)
-        minus the CPU-side noising, which lives on device now.
+        num_cond=1 matches the reference's per-item semantics
+        (data_loader.py:80-113: item idx = conditioning view, uniformly
+        random second view = target) minus the CPU-side noising, which lives
+        on device now. num_cond>1 (3DiM k>1 training) keeps the indexed view
+        as the first conditioning frame and draws the rest uniformly; frames
+        are stacked on a leading axis (x (Fc,H,W,3), R1 (Fc,3,3), t1 (Fc,3)).
         """
         obj, view = self.locate(flat_idx)
         inst = self.instances[obj]
-        x, pose1 = inst.view(view)
         view2 = int(rng.integers(len(inst)))
         target, pose2 = inst.view(view2)
+        cond_views = [view] + [int(rng.integers(len(inst)))
+                               for _ in range(num_cond - 1)]
+        xs, R1s, t1s = [], [], []
+        for v in cond_views:
+            x, pose1 = inst.view(v)
+            xs.append(x.astype(np.float32))
+            R1s.append(pose1[:3, :3])
+            t1s.append(pose1[:3, 3])
+        if num_cond == 1:
+            x_out, R1_out, t1_out = xs[0], R1s[0], t1s[0]
+        else:
+            x_out = np.stack(xs)
+            R1_out = np.stack(R1s)
+            t1_out = np.stack(t1s)
         return {
-            "x": x.astype(np.float32),
+            "x": x_out,
             "target": target.astype(np.float32),
-            "R1": pose1[:3, :3],
-            "t1": pose1[:3, 3],
+            "R1": R1_out,
+            "t1": t1_out,
             "R2": pose2[:3, :3],
             "t2": pose2[:3, 3],
             "K": inst.K,

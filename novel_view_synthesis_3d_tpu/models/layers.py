@@ -145,6 +145,7 @@ class AttnLayer(nn.Module):
     attn_heads: int = 4
     out_proj: bool = False
     use_flash: bool = False
+    mesh: Optional[object] = None  # jax Mesh → ring attention over 'seq'
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -156,7 +157,15 @@ class AttnLayer(nn.Module):
         qh = nn.DenseGeneral((self.attn_heads, head_dim), **kw)(q)
         kh = nn.DenseGeneral((self.attn_heads, head_dim), **kw)(kv)
         vh = nn.DenseGeneral((self.attn_heads, head_dim), **kw)(kv)
-        if self.use_flash:
+        if self.mesh is not None:
+            # Sequence-parallel exact attention: tokens sharded over 'seq',
+            # batch riding the 'data' axis, k/v blocks rotating via ppermute.
+            from novel_view_synthesis_3d_tpu.parallel.mesh import DATA_AXIS
+            from novel_view_synthesis_3d_tpu.parallel.ring_attention import (
+                ring_self_attention)
+            out = ring_self_attention(qh, kh, vh, self.mesh,
+                                      batch_axis=DATA_AXIS)
+        elif self.use_flash:
             from novel_view_synthesis_3d_tpu.ops.flash_attention import (
                 flash_attention)
             out = flash_attention(qh, kh, vh)
@@ -182,6 +191,7 @@ class AttnBlock(nn.Module):
     attn_heads: int = 4
     out_proj: bool = False
     use_flash: bool = False
+    mesh: Optional[object] = None
     per_frame_gn: bool = True
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
@@ -192,7 +202,7 @@ class AttnBlock(nn.Module):
         h = GroupNorm(per_frame=self.per_frame_gn, dtype=self.dtype)(h_in)
         tokens = h.reshape(B, F, H * W, C)
         layer = AttnLayer(attn_heads=self.attn_heads, out_proj=self.out_proj,
-                          use_flash=self.use_flash,
+                          use_flash=self.use_flash, mesh=self.mesh,
                           dtype=self.dtype, param_dtype=self.param_dtype)
         if self.attn_type == "self":
             out = layer(q=tokens.reshape(B * F, H * W, C),
@@ -224,6 +234,7 @@ class XUNetBlock(nn.Module):
     attn_heads: int = 4
     attn_out_proj: bool = False
     attn_use_flash: bool = False
+    attn_mesh: Optional[object] = None
     dropout: float = 0.0
     train: bool = False  # attribute (not call arg) so nn.remat needs no statics
     per_frame_gn: bool = True
@@ -235,7 +246,8 @@ class XUNetBlock(nn.Module):
         kw = dict(per_frame_gn=self.per_frame_gn, dtype=self.dtype,
                   param_dtype=self.param_dtype)
         attn_kw = dict(attn_heads=self.attn_heads, out_proj=self.attn_out_proj,
-                       use_flash=self.attn_use_flash, **kw)
+                       use_flash=self.attn_use_flash, mesh=self.attn_mesh,
+                       **kw)
         h = ResnetBlock(features=self.features, dropout=self.dropout,
                         **kw)(x, emb, train=self.train)
         if self.use_attn:

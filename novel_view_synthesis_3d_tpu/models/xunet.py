@@ -131,9 +131,15 @@ class ConditioningProcessor(nn.Module):
 
 
 class XUNet(nn.Module):
-    """The X-UNet (reference model/xunet.py:205-280), config-driven."""
+    """The X-UNet (reference model/xunet.py:205-280), config-driven.
+
+    `mesh` activates sequence-parallel ring attention when
+    config.sequence_parallel is set (tokens sharded over the mesh 'seq'
+    axis; parallel/ring_attention.py).
+    """
 
     config: ModelConfig = ModelConfig()
+    mesh: object = None
 
     @nn.compact
     def __call__(self, batch: dict, *, cond_mask: jnp.ndarray, train: bool) -> jnp.ndarray:
@@ -171,6 +177,7 @@ class XUNet(nn.Module):
                 attn_heads=cfg.attn_heads,
                 attn_out_proj=cfg.attn_out_proj,
                 attn_use_flash=cfg.use_flash_attention,
+                attn_mesh=(self.mesh if cfg.sequence_parallel else None),
                 dropout=cfg.dropout,
                 train=train,
                 **blk_kw,

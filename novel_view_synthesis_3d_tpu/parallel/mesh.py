@@ -96,6 +96,48 @@ def replicate(mesh: Mesh, tree):
     return jax.device_put(tree, replicated(mesh))
 
 
+# ---------------------------------------------------------------------------
+# FSDP / ZeRO-style parameter sharding
+# ---------------------------------------------------------------------------
+# The reference replicates the full params + optimizer state on every device
+# (train.py:46 — SURVEY.md §2.3 "FSDP: No"). Here large tensors are sharded
+# over the 'data' axis: under jit, XLA inserts the all-gather before use and
+# the reduce-scatter on the gradient — the standard JAX FSDP recipe
+# (sharding-annotation-driven, no hand-written collectives).
+
+def fsdp_spec(mesh: Mesh, shape, min_elems: int = 2 ** 15) -> P:
+    """PartitionSpec sharding the largest 'data'-divisible axis of `shape`.
+
+    Small tensors (biases, norm scales, scalars) stay replicated — sharding
+    them costs more in collective latency than it saves in HBM.
+    """
+    n = mesh.shape[DATA_AXIS]
+    if n <= 1 or int(np.prod(shape or (1,))) < min_elems:
+        return P()
+    best = -1
+    for i, d in enumerate(shape):
+        if d % n == 0 and (best == -1 or d > shape[best]):
+            best = i
+    if best == -1:
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = DATA_AXIS
+    return P(*spec)
+
+
+def state_shardings(mesh: Mesh, state, fsdp: bool):
+    """Sharding pytree for a TrainState: fsdp=False → fully replicated;
+    fsdp=True → per-leaf largest-axis sharding over 'data'."""
+    if not fsdp:
+        return replicated(mesh)
+    return jax.tree.map(
+        lambda x: NamedSharding(mesh, fsdp_spec(mesh, jnp_shape(x))), state)
+
+
+def jnp_shape(x):
+    return tuple(getattr(x, "shape", ()) or ())
+
+
 def num_data_shards(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS]
 

@@ -71,13 +71,16 @@ def ring_self_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS,
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ_AXIS,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        batch_axis: Optional[str] = None):
     """Exact attention with the token axis sharded over `axis_name`.
 
     q, k, v: GLOBAL (B, L, H, D) arrays (sharded or shardable); returns the
-    attention output with the same global shape/sharding.
+    attention output with the same global shape/sharding. `batch_axis`
+    additionally shards the batch dim (composes SP with DP inside one
+    shard_map — the train-step layout where batch rides the 'data' axis).
     """
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, None, None)
     fn = jax.shard_map(
         partial(ring_self_attention_local, axis_name=axis_name, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -36,6 +36,10 @@ from novel_view_synthesis_3d_tpu.train.metrics import MetricsLogger
 from novel_view_synthesis_3d_tpu.train.state import create_train_state
 from novel_view_synthesis_3d_tpu.train.step import make_train_step
 from novel_view_synthesis_3d_tpu.utils.images import save_image_grid
+from novel_view_synthesis_3d_tpu.utils.profiling import (
+    StepTimer,
+    enable_nan_checks,
+)
 
 
 def _sample_model_batch(batch: dict) -> dict:
@@ -96,7 +100,10 @@ class Trainer:
             self.dataset = make_dataset(config.data)
             assert len(self.dataset) > 0
             local_bs = dist.local_batch_size(tcfg.batch_size)
+            num_cond = config.model.num_cond_frames
             backend = config.data.loader if use_grain else "python"
+            if backend == "native" and num_cond > 1:
+                backend = "grain"  # native loader is k=1; grain handles k>1
             if backend == "native":
                 from novel_view_synthesis_3d_tpu.data import native_io
                 if native_io.available():
@@ -114,35 +121,45 @@ class Trainer:
                 loader = make_grain_loader(
                     self.dataset, local_bs,
                     seed=config.data.shuffle_seed,
-                    num_workers=config.data.num_workers)
+                    num_workers=config.data.num_workers,
+                    num_cond=num_cond)
                 self.data_iter = cycle(loader)
             elif self._native_loader is None:
                 self.data_iter = iter_batches(
                     self.dataset, local_bs, seed=config.data.shuffle_seed,
                     shard_index=jax.process_index(),
-                    shard_count=jax.process_count())
+                    shard_count=jax.process_count(),
+                    num_cond=num_cond)
 
         # --- model / schedule / state ---
         self.schedule = make_schedule(config.diffusion)
-        self.model = XUNet(config.model)
+        self.model = XUNet(
+            config.model,
+            mesh=self.mesh if config.model.sequence_parallel else None)
         first_batch = next(self.data_iter)
         self._held_batch = first_batch
         self.state = create_train_state(
             tcfg, self.model, _sample_model_batch(first_batch))
-        self.state = mesh_lib.replicate(self.mesh, self.state)
+        self._state_sharding = mesh_lib.state_shardings(
+            self.mesh, self.state, tcfg.fsdp)
+        self.state = jax.device_put(self.state, self._state_sharding)
         self.train_step = make_train_step(
-            config, self.model, self.schedule, self.mesh)
+            config, self.model, self.schedule, self.mesh,
+            state_sharding=self._state_sharding)
 
         # --- checkpointing / metrics ---
         self.ckpt = CheckpointManager(tcfg.checkpoint_dir)
         if tcfg.resume:
             restored = self.ckpt.restore(self.state)
             if restored is not None:
-                self.state = mesh_lib.replicate(self.mesh, restored)
+                self.state = jax.device_put(restored, self._state_sharding)
                 print(f"resumed from checkpoint at step {int(self.state.step)}")
         self.metrics = MetricsLogger(tcfg.results_folder)
         self.results_folder = tcfg.results_folder
         os.makedirs(self.results_folder, exist_ok=True)
+        self.timer = StepTimer()
+        if tcfg.debug_nans:
+            enable_nan_checks()
 
     # ------------------------------------------------------------------
     @property
@@ -158,12 +175,30 @@ class Trainer:
     def train(self) -> None:
         tcfg = self.config.train
         last_metrics = None
+        profiling = False
         while self.step < tcfg.num_steps:
+            if tcfg.profile_steps:
+                at = self.step
+                end = tcfg.profile_from + tcfg.profile_steps
+                if profiling and at >= end:
+                    jax.profiler.stop_trace()
+                    profiling = False
+                elif not profiling and tcfg.profile_from <= at < end:
+                    # Range check (not equality) so the window still fires
+                    # when resuming into or past profile_from.
+                    jax.profiler.start_trace(
+                        os.path.join(self.results_folder, "profile"))
+                    profiling = True
             batch = self._next_batch()
             batch = {k: v for k, v in batch.items() if k != "noise"}
-            device_batch = mesh_lib.shard_batch(self.mesh, batch)
-            self.state, step_metrics = self.train_step(self.state, device_batch)
-            step_now = self.step  # device sync once per step (loss fetch below)
+            with self.timer.measure():
+                device_batch = mesh_lib.shard_batch(self.mesh, batch)
+                self.state, step_metrics = self.train_step(self.state,
+                                                           device_batch)
+                # Dispatch is async; the step read below device_gets
+                # state.step, which syncs on the whole step — keep it inside
+                # the timed region so timings reflect real device time.
+                step_now = self.step
 
             if step_now % tcfg.log_every == 0 or step_now == 1:
                 logged = self.metrics.log(
@@ -173,24 +208,41 @@ class Trainer:
                 last_metrics = logged
 
             if tcfg.save_every and step_now % tcfg.save_every == 0:
-                self.ckpt.save(step_now, jax.device_get(self.state))
+                # Pass the (possibly FSDP-sharded) device state directly:
+                # Orbax gathers per-shard across hosts; device_get would
+                # crash on non-fully-addressable arrays in multi-host runs.
+                self.ckpt.save(step_now, self.state)
 
             if tcfg.sample_every and step_now % tcfg.sample_every == 0:
                 self.dump_samples(step_now)
 
-        self.ckpt.save(self.step, jax.device_get(self.state), force=True)
+        if profiling:
+            jax.profiler.stop_trace()
+        self.ckpt.save(self.step, self.state, force=True)
         self.ckpt.wait()
         print("training completed")
         if last_metrics is not None:
             print(f"final: {last_metrics}")
+        timing = self.timer.summary()
+        if timing:
+            print(f"step timing: {timing}")
 
     # ------------------------------------------------------------------
     def dump_samples(self, step: int, num: int = 4,
                      sample_steps: Optional[int] = None) -> str:
         """Sample novel views for the first records and write a PNG grid."""
         dcfg = self.config.diffusion
-        sampler = make_sampler(self.model, sampling_schedule(dcfg, sample_steps),
-                               dcfg)
+        # Sample with dense (non-sequence-parallel) attention: identical math
+        # and identical params, but free of the batch/'data'-axis
+        # divisibility constraint the ring path imposes (num=4 here need not
+        # divide the mesh).
+        sample_model = self.model
+        if self.config.model.sequence_parallel:
+            import dataclasses
+            sample_model = XUNet(dataclasses.replace(
+                self.config.model, sequence_parallel=False))
+        sampler = make_sampler(sample_model,
+                               sampling_schedule(dcfg, sample_steps), dcfg)
         batch = self._held_batch if self._held_batch is not None else next(self.data_iter)
         self._held_batch = batch
         cond = {k: jnp.asarray(batch[k][:num])

@@ -1,0 +1,95 @@
+"""Profiling + debug instrumentation (SURVEY.md §5.1-5.2).
+
+The reference has zero instrumentation (one print at train.py:157, an unused
+tqdm import). Here:
+
+  - `trace_window`: jax.profiler trace of a step window, viewable in
+    TensorBoard/XProf (device + host timelines, HLO cost analysis);
+  - `StepTimer`: lightweight wall-clock step timing with percentile summary
+    (no profiler overhead, always-on capable);
+  - `enable_nan_checks` / `check_finite`: jax_debug_nans config plus an
+    explicit in-jit finite-check via `jax.debug` error checking for debug
+    runs (the "sanitizer" role — the reference has no native code to TSAN,
+    its failure mode is silent NaNs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace_window(log_dir: str, enabled: bool = True) -> Iterator[None]:
+    """jax.profiler trace context; no-op when disabled."""
+    if not enabled:
+        yield
+        return
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock per-step timing with summary statistics."""
+
+    def __init__(self):
+        self._times: list = []
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        assert self._t0 is not None, "start() not called"
+        dt = time.perf_counter() - self._t0
+        self._times.append(dt)
+        self._t0 = None
+        return dt
+
+    @contextlib.contextmanager
+    def measure(self) -> Iterator[None]:
+        self.start()
+        try:
+            yield
+        finally:
+            self.stop()
+
+    def summary(self) -> dict:
+        if not self._times:
+            return {}
+        arr = np.asarray(self._times)
+        return {
+            "steps": int(arr.size),
+            "mean_s": float(arr.mean()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p90_s": float(np.percentile(arr, 90)),
+            "p99_s": float(np.percentile(arr, 99)),
+        }
+
+
+def enable_nan_checks(enabled: bool = True) -> None:
+    """Turn on jax_debug_nans: any NaN-producing jitted op re-runs op-by-op
+    and raises with the originating primitive — the debug-mode default for
+    this framework's tests and repro runs."""
+    jax.config.update("jax_debug_nans", enabled)
+
+
+def check_finite(tree, name: str = "tree") -> None:
+    """Host-side finite assertion over a pytree (checkpoint/debug guard)."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad.append(jax.tree_util.keystr(path))
+    if bad:
+        raise FloatingPointError(
+            f"non-finite values in {name}: {', '.join(bad[:8])}")

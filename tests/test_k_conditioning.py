@@ -1,0 +1,67 @@
+"""k>1 conditioning frames: data records, train step, trainer e2e.
+
+The reference hardcodes k=1 (frame axis F=2 throughout model/xunet.py);
+here k is ModelConfig.num_cond_frames and flows data→model→sampler.
+"""
+
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import (
+    Config, DataConfig, DiffusionConfig, ModelConfig, TrainConfig)
+from novel_view_synthesis_3d_tpu.data.pipeline import iter_batches
+from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+from novel_view_synthesis_3d_tpu.data.synthetic import write_synthetic_srn
+
+
+@pytest.fixture(scope="module")
+def srn_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("srn_k")
+    write_synthetic_srn(str(root), num_instances=2, views_per_instance=6,
+                        image_size=16)
+    return str(root)
+
+
+def test_pair_record_k2(srn_root):
+    ds = SRNDataset(srn_root, img_sidelength=16)
+    rng = np.random.default_rng(0)
+    rec = ds.pair(0, rng, num_cond=2)
+    assert rec["x"].shape == (2, 16, 16, 3)
+    assert rec["R1"].shape == (2, 3, 3)
+    assert rec["t1"].shape == (2, 3)
+    assert rec["target"].shape == (16, 16, 3)
+    # First conditioning frame is the indexed view (deterministic).
+    rec1 = ds.pair(0, np.random.default_rng(1), num_cond=2)
+    np.testing.assert_array_equal(rec["x"][0], rec1["x"][0])
+
+
+def test_iter_batches_k2(srn_root):
+    ds = SRNDataset(srn_root, img_sidelength=16)
+    batch = next(iter_batches(ds, 4, seed=0, num_cond=2))
+    assert batch["x"].shape == (4, 2, 16, 16, 3)
+    assert batch["R1"].shape == (4, 2, 3, 3)
+    assert batch["t1"].shape == (4, 2, 3)
+
+
+def test_trainer_e2e_k2(srn_root, tmp_path):
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+    cfg = Config(
+        model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
+                          attn_resolutions=(16,), num_cond_frames=2),
+        diffusion=DiffusionConfig(timesteps=10),
+        data=DataConfig(root_dir=srn_root, img_sidelength=16,
+                        loader="native", num_workers=0),
+        train=TrainConfig(batch_size=8, num_steps=2, save_every=0,
+                          log_every=1,
+                          checkpoint_dir=str(tmp_path / "ckpt"),
+                          results_folder=str(tmp_path / "results")))
+    tr = Trainer(config=cfg)
+    # Native loader is k=1-only; trainer must have fallen back.
+    assert tr._native_loader is None
+    tr.train()
+    assert tr.step == 2
+    # Sampling with a k=2 conditioning pool through the same model.
+    path = tr.dump_samples(2, num=2, sample_steps=4)
+    import os
+    assert os.path.exists(path)
