@@ -126,7 +126,75 @@ def bench_reference_style(cfg, model, schedule, params, batch,
     return (time.perf_counter() - t0) / steps
 
 
+def bench_sample(preset_name: str, sample_steps: int = 256) -> None:
+    """DDPM sample sec/view (BASELINE.md metric 2): the on-device lax.scan
+    sampler vs the reference's host loop (sampling.py:116-167 — per-step
+    un-jitted applies, 2 CFG forwards each; measured over a short prefix and
+    scaled linearly, which favors the baseline by excluding its dispatch
+    warm-up)."""
+    from novel_view_synthesis_3d_tpu.config import get_preset
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        sampling_schedule)
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
+    from novel_view_synthesis_3d_tpu.train.state import create_train_state
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    cfg = get_preset(preset_name).override(
+        **{"diffusion.sample_timesteps": sample_steps})
+    raw = make_example_batch(batch_size=1,
+                             sidelength=cfg.data.img_sidelength, seed=0)
+    model = XUNet(cfg.model)
+    state = create_train_state(cfg.train, model, _sample_model_batch(raw))
+    params = state.params
+    cond = {k: jnp.asarray(raw[k]) for k in ("x", "R1", "t1", "R2", "t2", "K")}
+
+    schedule = sampling_schedule(cfg.diffusion, sample_steps)
+    sampler = make_sampler(model, schedule, cfg.diffusion)
+    img = jax.block_until_ready(sampler(params, jax.random.PRNGKey(0), cond))
+    t0 = time.perf_counter()
+    reps = 3
+    for i in range(reps):
+        img = sampler(params, jax.random.PRNGKey(i + 1), cond)
+    jax.block_until_ready(img)
+    sec_view = (time.perf_counter() - t0) / reps
+
+    # Reference-style: per-step host loop, two separate un-jitted applies.
+    z = jnp.asarray(np.random.default_rng(0).standard_normal(
+        raw["target"].shape), jnp.float32)
+    probe = 4
+
+    def ref_step(z, t):
+        batch = dict(cond, z=z, logsnr=jnp.full((1,), schedule.logsnr(t)))
+        e_c = model.apply({"params": params}, batch,
+                          cond_mask=jnp.ones((1,)), train=False)
+        e_u = model.apply({"params": params}, batch,
+                          cond_mask=jnp.zeros((1,)), train=False)
+        eps = 4.0 * e_c - 3.0 * e_u
+        return z - 0.01 * eps  # shape-preserving update; cost is the fwds
+
+    z = jax.block_until_ready(ref_step(z, 0))  # warm caches
+    t0 = time.perf_counter()
+    for t in range(probe):
+        z = ref_step(z, t)
+    jax.block_until_ready(z)
+    ref_sec_view = (time.perf_counter() - t0) / probe * sample_steps
+
+    print(json.dumps({
+        "metric": f"ddpm_{sample_steps}step_sample_sec_per_view_{preset_name}",
+        "value": round(sec_view, 3),
+        "unit": "sec/view",
+        "vs_baseline": round(ref_sec_view / sec_view, 3),
+    }))
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "sample":
+        preset = sys.argv[2] if len(sys.argv) > 2 else "tiny64"
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+        bench_sample(preset, steps)
+        return
     preset = sys.argv[1] if len(sys.argv) > 1 else "tiny64"
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 30
     cfg, mesh, model, schedule, state, step, batch, device_batch = build(preset)
