@@ -45,8 +45,11 @@ class ModelConfig:
     param_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint each UNet block (memory for FLOPs)
     # Fused Pallas attention kernel (ops/flash_attention.py) instead of the
-    # XLA dot_product_attention path. Interpreted (slow but exact) off-TPU.
-    use_flash_attention: bool = False
+    # XLA dot_product_attention path. "auto" (default) enables it on TPU
+    # backends only (measured +26-35% train step on v5e at tiny64) and keeps
+    # the XLA path elsewhere; True forces the kernel (interpret mode off-TPU,
+    # slow but exact); False forces the XLA path.
+    use_flash_attention: Any = "auto"
     # Sequence parallelism: shard the H·W token axis of every attention over
     # the mesh 'seq' axis and run ring attention (parallel/ring_attention.py,
     # ppermute over ICI). Requires mesh.seq > 1 and token counts divisible
@@ -229,7 +232,11 @@ def get_preset(name: str) -> Config:
     """
     if name == "reference":
         return Config(
-            model=ModelConfig(groupnorm_per_frame=False),
+            # Pin the XLA attention path too: this preset exists for parity
+            # checks against the reference, and the fused kernel matches it
+            # only approximately on TPU.
+            model=ModelConfig(groupnorm_per_frame=False,
+                              use_flash_attention=False),
             train=TrainConfig(loss="frobenius"),
         )
     if name == "tiny64":

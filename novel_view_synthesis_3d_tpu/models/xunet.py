@@ -36,6 +36,7 @@ from novel_view_synthesis_3d_tpu.models.layers import (
     nonlinearity,
 )
 from novel_view_synthesis_3d_tpu.models.rays import camera_rays
+from novel_view_synthesis_3d_tpu.ops.flash_attention import resolve_flash
 from novel_view_synthesis_3d_tpu.ops.posenc import posenc_ddpm, posenc_nerf
 
 
@@ -44,6 +45,8 @@ def _as_frames(arr: jnp.ndarray, frame_rank: int) -> jnp.ndarray:
     if arr.ndim == frame_rank:
         return arr[:, None]
     return arr
+
+
 
 
 class ConditioningProcessor(nn.Module):
@@ -176,7 +179,7 @@ class XUNet(nn.Module):
                 use_attn=use_attn,
                 attn_heads=cfg.attn_heads,
                 attn_out_proj=cfg.attn_out_proj,
-                attn_use_flash=cfg.use_flash_attention,
+                attn_use_flash=resolve_flash(cfg.use_flash_attention),
                 attn_mesh=(self.mesh if cfg.sequence_parallel else None),
                 dropout=cfg.dropout,
                 train=train,

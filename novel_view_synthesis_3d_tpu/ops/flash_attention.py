@@ -119,6 +119,23 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def resolve_flash(flag) -> bool:
+    """Resolve a use_flash_attention config value.
+
+    'auto' → the Pallas kernel on TPU backends (where it's compiled and
+    faster), the XLA attention path elsewhere (where the kernel would run in
+    the interpreter). Booleans pass through; anything else is an error —
+    CLI overrides arrive as raw strings, and silently coercing a typo like
+    'False' to truthy would force interpret-mode Pallas on CPU.
+    """
+    if flag == "auto":
+        return not _use_interpret()
+    if isinstance(flag, bool):
+        return flag
+    raise ValueError(
+        f"use_flash_attention must be True, False, or 'auto'; got {flag!r}")
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention(q, k, v, scale: float, block_q: int):
     out, _ = _flash_fwd_core(q, k, v, scale, block_q)
