@@ -131,6 +131,9 @@ class TrainConfig:
     profile_steps: int = 0
     # Debug mode: jax_debug_nans (NaN source localization in jitted code).
     debug_nans: bool = False
+    # Checkpoint + clean exit on SIGTERM (TPU preemption); with resume=True
+    # the rescheduled run continues from the last step.
+    handle_preemption: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

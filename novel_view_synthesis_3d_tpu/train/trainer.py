@@ -11,6 +11,7 @@ sample dumps, and optional jax.profiler traces.
 from __future__ import annotations
 
 import os
+import signal
 from typing import Iterator, Optional
 
 import jax
@@ -161,6 +162,39 @@ class Trainer:
         if tcfg.debug_nans:
             enable_nan_checks()
 
+        # Preemption handling (SURVEY.md §5.3 — the reference has none):
+        # TPU VMs receive SIGTERM on maintenance/preemption. Flag it and let
+        # the step loop checkpoint + exit cleanly; combined with
+        # resume=True the run continues from the last step after reschedule.
+        self._preempted = False
+        if tcfg.handle_preemption:
+            try:
+                signal.signal(signal.SIGTERM, self._on_preempt)
+            except ValueError:
+                pass  # not the main thread (e.g. under some test runners)
+
+    def _on_preempt(self, signum, frame) -> None:
+        self._preempted = True
+
+    def _preempt_agreed(self) -> bool:
+        """Cross-host agreement on the preemption flag.
+
+        SIGTERM can land at different step boundaries on different hosts; if
+        one host broke into the (collective) checkpoint save while another
+        entered the next train step's psum, the mismatched collectives would
+        hang the slice. Every host therefore joins an allgather each step
+        and all of them break together iff any host saw the signal. The
+        per-step allgather is a few µs over ICI — negligible next to a
+        train step.
+        """
+        if jax.process_count() == 1:
+            return self._preempted
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(self._preempted))
+        return bool(np.any(flags))
+
     # ------------------------------------------------------------------
     @property
     def step(self) -> int:
@@ -215,6 +249,11 @@ class Trainer:
 
             if tcfg.sample_every and step_now % tcfg.sample_every == 0:
                 self.dump_samples(step_now)
+
+            if self._preempt_agreed():
+                print(f"preemption signal received at step {step_now}: "
+                      "checkpointing and exiting")
+                break
 
         if profiling:
             jax.profiler.stop_trace()

@@ -57,6 +57,29 @@ def test_gradients_match_xla():
                                    atol=1e-5, rtol=1e-4)
 
 
+def test_gradients_block_size_not_dividing_128():
+    """Regression: bk ∤ 128 once left a partial trailing kv block unwritten
+    in the dk/dv grid (kv padding must be a common multiple of bk and 128)."""
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    B, L, H, D = 1, 120, 2, 64  # D ≥ 64 → Pallas backward path
+    q = jax.random.normal(ks[0], (B, L, H, D))
+    k = jax.random.normal(ks[1], (B, L, H, D))
+    v = jax.random.normal(ks[2], (B, L, H, D))
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=112) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        assert np.isfinite(np.asarray(gf)).all()
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=1e-4, rtol=1e-3)
+
+
 def test_jit_and_vmap_compatible():
     ks = jax.random.split(jax.random.PRNGKey(2), 3)
     B, L, H, D = 2, 32, 2, 8

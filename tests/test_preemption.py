@@ -1,0 +1,67 @@
+"""Preemption: SIGTERM mid-training → checkpoint + clean exit → resume.
+
+SURVEY.md §5.3: the reference has no failure handling at all; on TPU,
+preemption is routine and resume must be exact.
+"""
+
+import os
+import signal
+
+import numpy as np
+
+from novel_view_synthesis_3d_tpu.config import (
+    Config, DataConfig, DiffusionConfig, ModelConfig, TrainConfig)
+from novel_view_synthesis_3d_tpu.data.pipeline import iter_batches
+from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+from novel_view_synthesis_3d_tpu.data.synthetic import write_synthetic_srn
+from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+
+def _cfg(tmp_path, num_steps):
+    return Config(
+        model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
+                          attn_resolutions=()),
+        diffusion=DiffusionConfig(timesteps=10),
+        train=TrainConfig(batch_size=8, num_steps=num_steps, save_every=100,
+                          log_every=100,
+                          checkpoint_dir=str(tmp_path / "ckpt"),
+                          results_folder=str(tmp_path / "results")))
+
+
+def test_sigterm_checkpoints_and_resumes(tmp_path):
+    root = str(tmp_path / "srn")
+    write_synthetic_srn(root, num_instances=2, views_per_instance=4,
+                        image_size=16)
+    ds = SRNDataset(root, img_sidelength=16)
+
+    cfg = _cfg(tmp_path, num_steps=50)
+    tr = Trainer(config=cfg, data_iter=iter_batches(ds, 8, seed=0))
+
+    # Deliver SIGTERM to ourselves after 3 steps by hooking the data fetch.
+    orig_next = tr._next_batch
+    count = {"n": 0}
+
+    def counting_next():
+        count["n"] += 1
+        if count["n"] == 4:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return orig_next()
+
+    tr._next_batch = counting_next
+    tr.train()  # returns instead of running all 50 steps
+    stopped_at = tr.step
+    assert 0 < stopped_at < 50, f"expected early stop, ran to {stopped_at}"
+
+    # A fresh Trainer resumes from the checkpoint written on exit.
+    tr2 = Trainer(config=cfg, data_iter=iter_batches(ds, 8, seed=1))
+    assert tr2.step == stopped_at
+    params_a = jax_leaves(tr.state.params)
+    params_b = jax_leaves(tr2.state.params)
+    for a, b in zip(params_a, params_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def jax_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
