@@ -147,7 +147,10 @@ def bench_sample(preset_name: str, sample_steps: int = 256) -> None:
                              sidelength=cfg.data.img_sidelength, seed=0)
     model = XUNet(cfg.model)
     state = create_train_state(cfg.train, model, _sample_model_batch(raw))
-    params = state.params
+    # Commit params to the default device: host-side init leaves them on
+    # CPU, and timing the sampler with uncommitted params would re-upload
+    # the full parameter set every rep.
+    params = jax.device_put(state.params, jax.devices()[0])
     cond = {k: jnp.asarray(raw[k]) for k in ("x", "R1", "t1", "R2", "t2", "K")}
 
     schedule = sampling_schedule(cfg.diffusion, sample_steps)

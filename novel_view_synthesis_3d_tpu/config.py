@@ -221,7 +221,7 @@ class Config:
 # ----------------------------------------------------------------------
 # Config ladder presets (BASELINE.json "configs")
 # ----------------------------------------------------------------------
-PRESET_NAMES = ("reference", "tiny64", "base128", "paper256")
+PRESET_NAMES = ("reference", "tiny64", "base128", "paper256", "pod64")
 
 
 def get_preset(name: str) -> Config:
@@ -260,4 +260,17 @@ def get_preset(name: str) -> Config:
             train=TrainConfig(batch_size=8, ema_decay=0.9999),
             diffusion=DiffusionConfig(sample_timesteps=256),
         )
+    if name == "pod64":
+        # BASELINE ladder step 5: v5e-64 pod-scale DP pretrain of the
+        # paper256 model (derived from that preset so the model can't
+        # drift). 'data=-1' absorbs all chips of the slice; each of the
+        # pod's hosts feeds its local shard (Grain/native loader per
+        # process); FSDP shards params+Adam state so the 256-ch UNet leaves
+        # HBM room for batch; run with NVS3D_MULTIHOST=1 (parallel/dist.py).
+        return get_preset("paper256").override(**{
+            "data.num_workers": 16,
+            "data.prefetch": 8,
+            "train.batch_size": 256,
+            "train.fsdp": True,
+        })
     raise KeyError(f"unknown preset {name!r}")

@@ -43,25 +43,58 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
 
 
 def create_train_state(cfg: TrainConfig, model, sample_batch: dict,
-                       seed: Optional[int] = None) -> TrainState:
+                       seed: Optional[int] = None,
+                       on_cpu: Optional[bool] = None) -> TrainState:
     """Initialize params ONCE (same everywhere — the reference initialized
-    each device differently, train.py:122-123) and build the state."""
+    each device differently, train.py:122-123) and build the state.
+
+    `on_cpu` (default: automatically True off the CPU backend) runs the init
+    forward on the host: flax init dispatches thousands of small eager ops,
+    which over a remote-accelerator link takes minutes for large models,
+    while the threefry PRNG makes the resulting params bitwise identical on
+    every backend. The init pass swaps in a dense-attention model (Pallas
+    kernels can't lower on CPU, shard_map can't use remote device meshes) —
+    neither feature has parameters, so the tree is unchanged.
+    """
     seed = cfg.seed if seed is None else seed
     root = jax.random.PRNGKey(seed)
     k_params, k_dropout, k_train = jax.random.split(root, 3)
     B = sample_batch["z"].shape[0]
-    variables = model.init(
-        {"params": k_params, "dropout": k_dropout},
-        sample_batch, cond_mask=jnp.ones((B,)), train=True)
-    params = variables["params"]
+    if on_cpu is None:
+        on_cpu = jax.default_backend() != "cpu"
+
+    init_model = model
+    if on_cpu and hasattr(model, "config"):
+        import dataclasses
+
+        init_model = type(model)(dataclasses.replace(
+            model.config, use_flash_attention=False,
+            sequence_parallel=False))
+
+    def run_init():
+        return init_model.init(
+            {"params": k_params, "dropout": k_dropout},
+            sample_batch, cond_mask=jnp.ones((B,)), train=True)
+
     tx = make_optimizer(cfg)
-    return TrainState(
-        step=jnp.zeros((), jnp.int32),
-        params=params,
-        opt_state=tx.init(params),
-        rng=k_train,
-        # Distinct buffers from params: the donated train step must not see
-        # the same buffer twice (f(donate(a), donate(a)) is invalid).
-        ema_params=(jax.tree.map(jnp.copy, params)
-                    if cfg.ema_decay > 0 else None),
-    )
+
+    def build_state():
+        params = run_init()["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            # Optimizer + EMA state are ~3x param bytes — they must follow
+            # the same host-side path as params or they'd materialize on
+            # accelerator device 0 before any sharded device_put.
+            opt_state=tx.init(params),
+            rng=k_train,
+            # Distinct buffers from params: the donated train step must not
+            # see the same buffer twice (f(donate(a), donate(a)) invalid).
+            ema_params=(jax.tree.map(jnp.copy, params)
+                        if cfg.ema_decay > 0 else None),
+        )
+
+    if on_cpu:
+        with jax.default_device(jax.devices("cpu")[0]):
+            return build_state()
+    return build_state()

@@ -124,6 +124,57 @@ def test_sequence_parallel_forward_matches_dense():
                                atol=1e-4, rtol=1e-4)
 
 
+def test_host_side_init_matches_default():
+    """create_train_state(on_cpu=True) — the remote-accelerator startup path
+    — must produce the identical param tree (structure AND values; threefry
+    is backend-deterministic) as the default init, including under the
+    flash/sequence-parallel model variants it swaps out during init."""
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2, model=1, seq=4))
+    mcfg = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                       attn_resolutions=(8,), dropout=0.0,
+                       use_flash_attention=True, sequence_parallel=True)
+    batch = make_example_batch(batch_size=8, sidelength=16, seed=0)
+    model = XUNet(mcfg, mesh=mesh)
+    tcfg = TrainConfig(batch_size=8, ema_decay=0.999)
+    sample = _sample_model_batch(batch)
+    s_host = create_train_state(tcfg, model, sample, on_cpu=True)
+    s_default = create_train_state(tcfg, model, sample, on_cpu=False)
+    ja, jb = jax.tree.flatten(s_host.params), jax.tree.flatten(s_default.params)
+    assert ja[1] == jb[1], "param tree structure differs"
+    for a, b in zip(ja[0], jb[0]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Optimizer + EMA state trees exist and mirror params.
+    assert jax.tree.structure(s_host.ema_params) == jax.tree.structure(
+        s_default.ema_params)
+
+
+def test_pod64_preset_scaled_one_step():
+    """pod64 (BASELINE ladder step 5) structure: data=-1 mesh absorption +
+    FSDP + bf16/remat flags — executed scaled-down on the 8-device mesh."""
+    from novel_view_synthesis_3d_tpu.config import get_preset
+
+    cfg = get_preset("pod64")
+    assert cfg.train.fsdp and cfg.model.remat
+    assert cfg.mesh.data == -1
+    cfg = cfg.override(**{
+        "train.batch_size": 8, "data.img_sidelength": 32, "model.ch": 32,
+        "model.ch_mult": [1, 2], "model.emb_ch": 32,
+        "model.num_res_blocks": 1, "model.dtype": "float32",
+        "model.remat": False})
+    mesh = mesh_lib.make_mesh(cfg.mesh)
+    assert mesh.shape["data"] == 8  # -1 absorbed all virtual devices
+    batch = make_example_batch(batch_size=8, sidelength=32)
+    model = XUNet(cfg.model)
+    schedule = make_schedule(cfg.diffusion)
+    state = create_train_state(cfg.train, model, _sample_model_batch(batch))
+    sharding = mesh_lib.state_shardings(mesh, state, cfg.train.fsdp)
+    state = jax.device_put(state, sharding)
+    step = make_train_step(cfg, model, schedule, mesh,
+                           state_sharding=sharding)
+    state, m = step(state, mesh_lib.shard_batch(mesh, batch))
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
 def test_dryrun_multichip_entrypoint():
     import importlib.util
     import os
