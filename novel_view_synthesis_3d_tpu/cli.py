@@ -197,6 +197,7 @@ def cmd_eval(args, overrides: List[str]) -> int:
         cond_view=args.cond_view,
         sample_steps=args.sample_steps,
         batch_size=args.batch_size,
+        compute_fid=args.fid,
     )
     print(json.dumps(dict(result.to_dict(), checkpoint_step=step)))
     if args.out:
@@ -277,7 +278,7 @@ def make_parser() -> argparse.ArgumentParser:
                    help="checkpoint step (default: latest)")
     p.add_argument("--seed", type=int, default=0)
 
-    p = sub.add_parser("eval", help="PSNR/SSIM over held-out views")
+    p = sub.add_parser("eval", help="PSNR/SSIM/FID over held-out views")
     _add_common(p)
     p.add_argument("folder", nargs="?", default=None)
     p.add_argument("--out", default=None, help="write result JSON here")
@@ -288,6 +289,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--step", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--fid", action="store_true",
+                   help="also compute Fréchet distance (random-conv "
+                        "features; see eval/metrics.py on comparability)")
 
     p = sub.add_parser("prep", help="offline dataset preparation")
     prep_sub = p.add_subparsers(dest="prep_command", required=True)

@@ -19,7 +19,7 @@ import numpy as np
 from novel_view_synthesis_3d_tpu.config import Config
 from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
 from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
-from novel_view_synthesis_3d_tpu.eval.metrics import psnr, ssim
+from novel_view_synthesis_3d_tpu.eval.metrics import fid, psnr, ssim
 from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
 
 
@@ -30,13 +30,17 @@ class EvalResult:
     num_views: int
     per_view_psnr: np.ndarray
     per_view_ssim: np.ndarray
+    fid: Optional[float] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "psnr": self.psnr,
             "ssim": self.ssim,
             "num_views": self.num_views,
         }
+        if self.fid is not None:
+            d["fid"] = self.fid
+        return d
 
 
 def evaluate_dataset(
@@ -51,6 +55,7 @@ def evaluate_dataset(
     cond_view: int = 0,
     sample_steps: Optional[int] = None,
     batch_size: int = 8,
+    compute_fid: bool = False,
 ) -> EvalResult:
     """Sample novel views for held-out (cond, target) pairs and score them.
 
@@ -82,7 +87,7 @@ def evaluate_dataset(
         raise ValueError("no evaluation pairs (need ≥2 views per instance)")
 
     # Batch through the sampler (pad the tail so one compilation serves all).
-    all_psnr, all_ssim = [], []
+    all_psnr, all_ssim, all_imgs = [], [], []
     for start in range(0, len(conds), batch_size):
         chunk = conds[start:start + batch_size]
         truth = np.stack(truths[start:start + batch_size])
@@ -98,13 +103,19 @@ def evaluate_dataset(
             psnr(imgs, jnp.asarray(truth)))))
         all_ssim.append(np.asarray(jax.device_get(
             ssim(imgs, jnp.asarray(truth)))))
+        if compute_fid:
+            all_imgs.append(np.asarray(jax.device_get(imgs)))
 
     per_psnr = np.concatenate(all_psnr)
     per_ssim = np.concatenate(all_ssim)
+    fid_value = None
+    if compute_fid:
+        fid_value = fid(np.stack(truths), np.concatenate(all_imgs))
     return EvalResult(
         psnr=float(per_psnr.mean()),
         ssim=float(per_ssim.mean()),
         num_views=len(per_psnr),
         per_view_psnr=per_psnr,
         per_view_ssim=per_ssim,
+        fid=fid_value,
     )
