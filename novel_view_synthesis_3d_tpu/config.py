@@ -120,6 +120,10 @@ class TrainConfig:
     # (parallel/mesh.fsdp_spec). The reference replicates everything per
     # device (train.py:46).
     fsdp: bool = False
+    # Tensor parallelism: shard attention heads + conv/dense output channels
+    # over the mesh 'model' axis (parallel/mesh.tp_spec). No-op unless
+    # mesh.model > 1. The reference has no TP (SURVEY.md §2.3).
+    tp: bool = False
     ema_decay: float = 0.0  # 0 = off; 3DiM paper uses EMA for sampling
     results_folder: str = "./results"
     checkpoint_dir: str = "./checkpoints"

@@ -85,6 +85,10 @@ def evaluate_dataset(
             truths.append(target)
     if not conds:
         raise ValueError("no evaluation pairs (need ≥2 views per instance)")
+    if compute_fid and len(conds) < 2:
+        raise ValueError(
+            "FID needs ≥2 evaluation pairs for a covariance estimate; "
+            "raise num_instances/views_per_instance or drop compute_fid")
 
     # Batch through the sampler (pad the tail so one compilation serves all).
     all_psnr, all_ssim, all_imgs = [], [], []

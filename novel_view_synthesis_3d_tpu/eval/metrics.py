@@ -120,8 +120,7 @@ def frechet_distance(mu1: jnp.ndarray, sigma1: jnp.ndarray,
             - 2.0 * tr_sqrt)
 
 
-def make_random_conv_features(feature_dim: int = 512, seed: int = 0,
-                              image_size: int | None = None):
+def make_random_conv_features(feature_dim: int = 512, seed: int = 0):
     """Deterministic random-projection conv feature extractor.
 
     Three stride-2 3×3 conv + leaky-relu stages (fixed Gaussian kernels from

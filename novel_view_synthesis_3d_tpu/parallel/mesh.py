@@ -105,6 +105,15 @@ def replicate(mesh: Mesh, tree):
 # the reduce-scatter on the gradient — the standard JAX FSDP recipe
 # (sharding-annotation-driven, no hand-written collectives).
 
+def _largest_divisible_axis(shape, n: int, taken=()) -> int:
+    """Index of the largest axis divisible by n, excluding `taken`; -1 if none."""
+    best = -1
+    for i, d in enumerate(shape):
+        if i not in taken and d % n == 0 and (best == -1 or d > shape[best]):
+            best = i
+    return best
+
+
 def fsdp_spec(mesh: Mesh, shape, min_elems: int = 2 ** 15) -> P:
     """PartitionSpec sharding the largest 'data'-divisible axis of `shape`.
 
@@ -114,10 +123,7 @@ def fsdp_spec(mesh: Mesh, shape, min_elems: int = 2 ** 15) -> P:
     n = mesh.shape[DATA_AXIS]
     if n <= 1 or int(np.prod(shape or (1,))) < min_elems:
         return P()
-    best = -1
-    for i, d in enumerate(shape):
-        if d % n == 0 and (best == -1 or d > shape[best]):
-            best = i
+    best = _largest_divisible_axis(shape, n)
     if best == -1:
         return P()
     spec = [None] * len(shape)
@@ -125,13 +131,105 @@ def fsdp_spec(mesh: Mesh, shape, min_elems: int = 2 ** 15) -> P:
     return P(*spec)
 
 
-def state_shardings(mesh: Mesh, state, fsdp: bool):
-    """Sharding pytree for a TrainState: fsdp=False → fully replicated;
-    fsdp=True → per-leaf largest-axis sharding over 'data'."""
-    if not fsdp:
+# ---------------------------------------------------------------------------
+# Tensor parallelism over the 'model' axis
+# ---------------------------------------------------------------------------
+# Weight-stationary output-channel sharding (Megatron column-parallel style),
+# driven purely by sharding annotations — GSPMD inserts the collectives:
+#   - attention q/k/v DenseGeneral kernels (C, heads, head_dim): heads axis
+#     sharded → each model-shard computes its own heads;
+#   - conv / dense kernels (..., Cin, Cout): Cout sharded → channel-sharded
+#     activations, all-gathered where a consumer needs the full channels;
+#   - matching biases sharded on the same output axis; norm scales and other
+#     small vectors replicated.
+# The reference has no TP at all (SURVEY.md §2.3 "Tensor parallel: No").
+
+def _path_names(path) -> list:
+    names = []
+    for entry in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(entry, attr):
+                names.append(str(getattr(entry, attr)))
+                break
+    return names
+
+
+def tp_spec(path_names, shape, tp_n: int) -> Optional[list]:
+    """Partition-axis list for one param under TP, or None if replicated."""
+    if tp_n <= 1 or not shape or not path_names:
+        return None
+    leaf = path_names[-1]
+    parent = next((p for p in reversed(path_names[:-1])
+                   if not p.isdigit()), "")
+    spec = [None] * len(shape)
+    if parent.startswith("DenseGeneral"):
+        if leaf == "kernel" and len(shape) == 3:
+            # q/k/v kernel (C, heads, hd) — C factors into heads·hd — is
+            # column-parallel on heads; the out-projection kernel
+            # (heads, hd, C) — C on the last axis — is row-parallel on its
+            # heads contraction (partial outputs psum'd by GSPMD), so the
+            # head-sharded attention output feeds it with no reshard.
+            if shape[2] == shape[0] * shape[1] and shape[0] % tp_n == 0:
+                spec[0] = MODEL_AXIS
+                return spec
+            if shape[0] == shape[1] * shape[2] and shape[1] % tp_n == 0:
+                spec[1] = MODEL_AXIS
+                return spec
+            return None
+        if leaf == "bias" and len(shape) == 2 and shape[0] % tp_n == 0:
+            spec[0] = MODEL_AXIS  # q/k/v bias (heads, hd)
+            return spec
+        return None  # out-proj bias (C,) rides the psum'd output: replicate
+    if leaf == "kernel" and len(shape) >= 2 and shape[-1] % tp_n == 0:
+        spec[-1] = MODEL_AXIS
+        return spec
+    # Only biases of output-channel-sharded layers follow their kernel; norm
+    # scales/biases and other small vectors stay replicated.
+    if (leaf == "bias" and len(shape) == 1 and shape[0] % tp_n == 0
+            and (parent.startswith("Conv") or parent.startswith("Dense"))):
+        spec[0] = MODEL_AXIS
+        return spec
+    return None
+
+
+def param_spec(mesh: Mesh, path_names, shape, fsdp: bool, tp: bool,
+               min_elems: int = 2 ** 15) -> P:
+    """Combined TP ('model' axis) + FSDP ('data' axis) spec for one leaf."""
+    spec = (tp_spec(path_names, shape, mesh.shape[MODEL_AXIS])
+            if tp else None)
+    if spec is None:
+        spec = [None] * len(shape)
+    if fsdp:
+        n = mesh.shape[DATA_AXIS]
+        if n > 1 and int(np.prod(shape or (1,))) >= min_elems:
+            taken = tuple(i for i, s in enumerate(spec) if s is not None)
+            best = _largest_divisible_axis(shape, n, taken)
+            if best != -1:
+                spec[best] = DATA_AXIS
+    if all(s is None for s in spec):
+        return P()
+    return P(*spec)
+
+
+def state_shardings(mesh: Mesh, state, fsdp: bool, tp: bool = False):
+    """Sharding pytree for a TrainState.
+
+    fsdp=False, tp=False → fully replicated. fsdp → largest-divisible-axis
+    sharding over 'data' (ZeRO-3). tp (with mesh.model > 1) → name-aware
+    head/output-channel sharding over 'model'; both compose per leaf.
+    """
+    tp = tp and mesh.shape[MODEL_AXIS] > 1
+    if not fsdp and not tp:
         return replicated(mesh)
-    return jax.tree.map(
-        lambda x: NamedSharding(mesh, fsdp_spec(mesh, jnp_shape(x))), state)
+    if not tp:
+        return jax.tree.map(
+            lambda x: NamedSharding(mesh, fsdp_spec(mesh, jnp_shape(x))),
+            state)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: NamedSharding(
+            mesh, param_spec(mesh, _path_names(path), jnp_shape(x),
+                             fsdp, True)),
+        state)
 
 
 def jnp_shape(x):

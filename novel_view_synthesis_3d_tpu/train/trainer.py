@@ -142,7 +142,7 @@ class Trainer:
         self.state = create_train_state(
             tcfg, self.model, _sample_model_batch(first_batch))
         self._state_sharding = mesh_lib.state_shardings(
-            self.mesh, self.state, tcfg.fsdp)
+            self.mesh, self.state, tcfg.fsdp, tp=tcfg.tp)
         self.state = jax.device_put(self.state, self._state_sharding)
         self.train_step = make_train_step(
             config, self.model, self.schedule, self.mesh,
