@@ -8,8 +8,9 @@ dataset/data_loader.py:92-110) + an un-donated, eager-dispatch update — i.e.
 the reference's host-loop structure with our model. The reference repo
 itself publishes no numbers (BASELINE.md), so the baseline is self-measured.
 
-Usage: python bench.py [preset] [steps]   (default: tiny64 30 steps on the
-real chip; base128/paper256 for the ladder).
+Usage: python bench.py [preset] [steps] [key=value ...]   (default: tiny64
+30 steps on the real chip; base128/paper256 for the ladder; trailing
+key=value pairs are config overrides, e.g. train.batch_size=32).
 """
 
 from __future__ import annotations
@@ -23,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def build(preset_name: str):
+def build(preset_name: str, overrides=()):
     from novel_view_synthesis_3d_tpu.config import get_preset, MeshConfig
     from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
     from novel_view_synthesis_3d_tpu.diffusion import make_schedule
@@ -34,11 +35,25 @@ def build(preset_name: str):
     from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
 
     cfg = get_preset(preset_name)
+    if overrides:
+        cfg = cfg.apply_cli(list(overrides))
     n_dev = len(jax.devices())
-    per_dev = max(1, cfg.train.batch_size // max(1, n_dev))
+    # The 'data' axis absorbs whatever the (overridable) model/seq axes
+    # don't claim; the global batch is rounded to a data-axis multiple.
+    model_par = max(1, cfg.mesh.model)
+    seq = max(1, cfg.mesh.seq)
+    if n_dev % (model_par * seq) != 0:
+        raise SystemExit(f"{n_dev} devices not divisible by "
+                         f"mesh.model×mesh.seq = {model_par * seq}")
+    data = n_dev // (model_par * seq)
+    per_dev = max(1, cfg.train.batch_size // data)
+    if per_dev * data != cfg.train.batch_size:
+        print(f"note: rounding train.batch_size "
+              f"{cfg.train.batch_size} -> {per_dev * data} "
+              f"(multiple of data axis {data})", file=sys.stderr)
     cfg = cfg.override(**{
-        "train.batch_size": per_dev * n_dev,
-        "mesh.data": n_dev,
+        "train.batch_size": per_dev * data,
+        "mesh.data": data,
     })
     mesh = mesh_lib.make_mesh(cfg.mesh)
     batch = make_example_batch(batch_size=cfg.train.batch_size,
@@ -126,7 +141,8 @@ def bench_reference_style(cfg, model, schedule, params, batch,
     return (time.perf_counter() - t0) / steps
 
 
-def bench_sample(preset_name: str, sample_steps: int = 256) -> None:
+def bench_sample(preset_name: str, sample_steps: int = 256,
+                 overrides=()) -> None:
     """DDPM sample sec/view (BASELINE.md metric 2): the on-device lax.scan
     sampler vs the reference's host loop (sampling.py:116-167 — per-step
     un-jitted applies, 2 CFG forwards each; measured over a short prefix and
@@ -141,8 +157,10 @@ def bench_sample(preset_name: str, sample_steps: int = 256) -> None:
     from novel_view_synthesis_3d_tpu.train.state import create_train_state
     from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
 
-    cfg = get_preset(preset_name).override(
-        **{"diffusion.sample_timesteps": sample_steps})
+    cfg = get_preset(preset_name)
+    if overrides:
+        cfg = cfg.apply_cli(list(overrides))
+    cfg = cfg.override(**{"diffusion.sample_timesteps": sample_steps})
     raw = make_example_batch(batch_size=1,
                              sidelength=cfg.data.img_sidelength, seed=0)
     model = XUNet(cfg.model)
@@ -193,14 +211,17 @@ def bench_sample(preset_name: str, sample_steps: int = 256) -> None:
 
 
 def main():
-    if len(sys.argv) > 1 and sys.argv[1] == "sample":
-        preset = sys.argv[2] if len(sys.argv) > 2 else "tiny64"
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 256
-        bench_sample(preset, steps)
+    args = [a for a in sys.argv[1:] if "=" not in a]
+    overrides = [a for a in sys.argv[1:] if "=" in a]
+    if args and args[0] == "sample":
+        preset = args[1] if len(args) > 1 else "tiny64"
+        steps = int(args[2]) if len(args) > 2 else 256
+        bench_sample(preset, steps, overrides)
         return
-    preset = sys.argv[1] if len(sys.argv) > 1 else "tiny64"
-    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 30
-    cfg, mesh, model, schedule, state, step, batch, device_batch = build(preset)
+    preset = args[0] if args else "tiny64"
+    steps = int(args[1]) if len(args) > 1 else 30
+    cfg, mesh, model, schedule, state, step, batch, device_batch = build(
+        preset, overrides)
     n_chips = max(1, len(jax.devices()))
     B = cfg.train.batch_size
 
