@@ -157,10 +157,11 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
     from novel_view_synthesis_3d_tpu.train.state import create_train_state
     from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
 
-    cfg = get_preset(preset_name)
-    if overrides:
+    cfg = get_preset(preset_name).override(
+        **{"diffusion.sample_timesteps": sample_steps})
+    if overrides:  # explicit overrides win, including sample_timesteps
         cfg = cfg.apply_cli(list(overrides))
-    cfg = cfg.override(**{"diffusion.sample_timesteps": sample_steps})
+    sample_steps = cfg.diffusion.sample_timesteps
     raw = make_example_batch(batch_size=1,
                              sidelength=cfg.data.img_sidelength, seed=0)
     model = XUNet(cfg.model)

@@ -74,6 +74,11 @@ class DiffusionConfig:
     sample_timesteps: int = 1000  # respaced steps for the ancestral sampler
     guidance_weight: float = 3.0  # CFG w (reference sampling.py:134)
     clip_denoised: bool = True
+    # 'ddpm' = ancestral (the reference's sampler); 'ddim' = Song et al.
+    # 2021 non-Markovian update — deterministic at ddim_eta=0, ancestral-like
+    # at ddim_eta=1; pairs well with aggressive respacing (sample_timesteps).
+    sampler: str = "ddpm"
+    ddim_eta: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

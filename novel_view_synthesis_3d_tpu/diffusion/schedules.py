@@ -121,6 +121,32 @@ class DiffusionSchedule:
         log_var = self._extract(self.posterior_log_variance_clipped, t, z_t)
         return mean, var, log_var
 
+    def predict_noise_from_start(self, z_t, t, x0):
+        """ε̂ implied by x̂₀ — exact inverse of predict_start_from_noise."""
+        return (
+            self._extract(self.sqrt_recip_alphas_cumprod, t, z_t) * z_t - x0
+        ) / self._extract(self.sqrt_recipm1_alphas_cumprod, t, z_t)
+
+    def ddim_step(self, x0, z_t, t, noise, eta: float):
+        """One DDIM update z_t → z_{t−1} (Song et al. 2021 eq. 12).
+
+        η=0 is the deterministic DDIM ODE (σ=0, `noise` unused); η=1 matches
+        the ancestral posterior variance. Lives here with q_posterior so the
+        reverse-process math has one home; the sampler only picks which
+        update to call.
+        """
+        acp = self._extract(self.alphas_cumprod, t, z_t)
+        acp_prev = self._extract(self.alphas_cumprod_prev, t, z_t)
+        eps_hat = self.predict_noise_from_start(z_t, t, x0)
+        sigma = (eta * jnp.sqrt((1.0 - acp_prev) / (1.0 - acp))
+                 * jnp.sqrt(jnp.maximum(1.0 - acp / acp_prev, 0.0)))
+        dir_zt = jnp.sqrt(
+            jnp.maximum(1.0 - acp_prev - sigma ** 2, 0.0)) * eps_hat
+        nonzero = jnp.reshape(  # scalar or per-sample t
+            (t > 0).astype(z_t.dtype),
+            jnp.shape(t) + (1,) * (z_t.ndim - jnp.ndim(t)))
+        return jnp.sqrt(acp_prev) * x0 + dir_zt + nonzero * sigma * noise
+
     # -- conditioning signal --------------------------------------------
     def logsnr(self, t) -> jnp.ndarray:
         """logsnr at (respaced) integer timestep t, evaluated at original t/T.

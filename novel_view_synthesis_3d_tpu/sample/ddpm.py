@@ -20,6 +20,11 @@ Per-step math (reference sampling.py:119-151):
   ε̂ = (1+w)·ε̂_cond − w·ε̂_uncond
   x̂₀ = clip(√(1/ᾱ_t) z − √(1/ᾱ_t − 1) ε̂, ±1)
   z ← posterior_mean(x̂₀, z, t) + 1{t>0} · exp(½ log σ̃²_t) · ε′
+
+`diffusion.sampler='ddim'` swaps the ancestral update for the DDIM
+non-Markovian one (Song et al. 2021) — deterministic at `ddim_eta=0`,
+ancestral-variance at `ddim_eta=1`; the reference has only the 1000-step
+ancestral loop.
 """
 
 from __future__ import annotations
@@ -51,8 +56,35 @@ def _ancestral_update(schedule: DiffusionSchedule, z, t, eps, key,
         x0 = jnp.clip(x0, -1.0, 1.0)
     mean, _, log_var = schedule.q_posterior(x0, z, t)
     noise = jax.random.normal(key, z.shape)
-    nonzero = (t > 0).astype(z.dtype)  # no noise at the final step
+    nonzero = jnp.reshape(  # no noise at the final step; scalar or (B,) t
+        (t > 0).astype(z.dtype), jnp.shape(t) + (1,) * (z.ndim - jnp.ndim(t)))
     return mean + nonzero * jnp.exp(0.5 * log_var) * noise
+
+
+def _ddim_update(schedule: DiffusionSchedule, z, t, eps, key,
+                 clip_denoised: bool, eta: float):
+    """DDIM step on the respaced ᾱ ladder; math lives in the schedule.
+
+    ε̂ is re-derived inside ddim_step from the (possibly clipped) x̂₀ so the
+    update stays on the clipped trajectory.
+    """
+    x0 = schedule.predict_start_from_noise(z, t, eps)
+    if clip_denoised:
+        x0 = jnp.clip(x0, -1.0, 1.0)
+    noise = jax.random.normal(key, z.shape)
+    return schedule.ddim_step(x0, z, t, noise, eta)
+
+
+def _make_update(schedule: DiffusionSchedule, config: DiffusionConfig):
+    """Bind the configured reverse-process update (ddpm | ddim)."""
+    if config.sampler == "ddim":
+        return partial(_ddim_update, schedule,
+                       clip_denoised=config.clip_denoised,
+                       eta=config.ddim_eta)
+    if config.sampler == "ddpm":
+        return partial(_ancestral_update, schedule,
+                       clip_denoised=config.clip_denoised)
+    raise ValueError(f"unknown sampler {config.sampler!r}")
 
 
 def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig):
@@ -62,7 +94,7 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig):
     holds x, R1, t1, R2, t2, K (the clean conditioning view(s) + poses).
     """
     w = config.guidance_weight
-    clip_denoised = config.clip_denoised
+    update = _make_update(schedule, config)
 
     @jax.jit
     def sample(params, key, cond: dict) -> jnp.ndarray:
@@ -77,7 +109,7 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig):
             batch = dict(cond, z=z,
                          logsnr=jnp.full((z.shape[0],), schedule.logsnr(t)))
             eps = _cfg_eps(model, params, batch, w)
-            z = _ancestral_update(schedule, z, t, eps, k_step, clip_denoised)
+            z = update(z, t, eps, k_step)
             return (z, key), None
 
         (z, _), _ = jax.lax.scan(body, (z0, key), ts)
@@ -96,7 +128,7 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
     (autoregressive generation never recompiles).
     """
     w = config.guidance_weight
-    clip_denoised = config.clip_denoised
+    update = _make_update(schedule, config)
 
     @partial(jax.jit, static_argnames=())
     def sample(params, key, pool: dict, target_pose: dict,
@@ -126,7 +158,7 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
                 "logsnr": jnp.full((B,), schedule.logsnr(t)),
             }
             eps = _cfg_eps(model, params, batch, w)
-            z = _ancestral_update(schedule, z, t, eps, k_step, clip_denoised)
+            z = update(z, t, eps, k_step)
             return (z, key), None
 
         (z, _), _ = jax.lax.scan(body, (z0, key), ts)

@@ -141,3 +141,20 @@ def test_respace_too_many_steps_raises():
     cfg = DiffusionConfig(timesteps=100)
     with pytest.raises(ValueError):
         respace(cfg, 101)
+
+
+def test_predict_noise_from_start_inverts():
+    from novel_view_synthesis_3d_tpu.config import DiffusionConfig
+    from novel_view_synthesis_3d_tpu.diffusion import make_schedule
+
+    sched = make_schedule(DiffusionConfig(timesteps=100))
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.uniform(-1, 1, (4, 8, 8, 3)), jnp.float32)
+    eps = jnp.asarray(rng.standard_normal((4, 8, 8, 3)), jnp.float32)
+    t = jnp.asarray([0, 17, 50, 99])
+    z = sched.q_sample(x0, t, eps)
+    # ε → x̂₀ → ε̂ round-trips through the two reverse-process helpers.
+    x0_hat = sched.predict_start_from_noise(z, t, eps)
+    eps_hat = sched.predict_noise_from_start(z, t, x0_hat)
+    np.testing.assert_allclose(np.asarray(eps_hat), np.asarray(eps),
+                               atol=1e-3, rtol=1e-3)
