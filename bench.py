@@ -46,6 +46,10 @@ def build(preset_name: str, overrides=()):
         raise SystemExit(f"{n_dev} devices not divisible by "
                          f"mesh.model×mesh.seq = {model_par * seq}")
     data = n_dev // (model_par * seq)
+    if cfg.mesh.data not in (-1, data):
+        print(f"note: mesh.data={cfg.mesh.data} replaced by {data} "
+              f"(all {n_dev} devices minus model/seq claims)",
+              file=sys.stderr)
     per_dev = max(1, cfg.train.batch_size // data)
     if per_dev * data != cfg.train.batch_size:
         print(f"note: rounding train.batch_size "
@@ -67,15 +71,30 @@ def build(preset_name: str, overrides=()):
     return cfg, mesh, model, schedule, state, step, batch, device_batch
 
 
+REPEATS = 3  # median-of-N timing: the remote-TPU tunnel adds bimodal
+# dispatch-latency noise that a single short loop can't average out (and a
+# min would chase fast-direction artifacts).
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
 def bench_framework(state, step, device_batch, steps: int) -> float:
-    # Warmup/compile.
+    # Warmup/compile. Sync points use device_get (a real host fetch):
+    # block_until_ready has been observed returning early through the
+    # remote-accelerator tunnel, producing physically impossible timings.
     state, m = step(state, device_batch)
-    jax.block_until_ready(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = step(state, device_batch)
-    jax.block_until_ready(m["loss"])
-    return (time.perf_counter() - t0) / steps
+    float(jax.device_get(m["loss"]))
+    reps = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, device_batch)
+        float(jax.device_get(m["loss"]))
+        reps.append((time.perf_counter() - t0) / steps)
+    return _median(reps)
 
 
 def bench_reference_style(cfg, model, schedule, params, batch,
@@ -133,12 +152,15 @@ def bench_reference_style(cfg, model, schedule, params, batch,
         return params, opt_state, loss
 
     params, opt_state, loss = one_step(params, opt_state)  # warmup/compile
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = one_step(params, opt_state)
-    jax.block_until_ready(loss)
-    return (time.perf_counter() - t0) / steps
+    float(jax.device_get(loss))
+    reps = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = one_step(params, opt_state)
+        float(jax.device_get(loss))  # real host fetch, see bench_framework
+        reps.append((time.perf_counter() - t0) / steps)
+    return _median(reps)
 
 
 def bench_sample(preset_name: str, sample_steps: int = 256,
@@ -174,12 +196,13 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
 
     schedule = sampling_schedule(cfg.diffusion, sample_steps)
     sampler = make_sampler(model, schedule, cfg.diffusion)
-    img = jax.block_until_ready(sampler(params, jax.random.PRNGKey(0), cond))
+    img = sampler(params, jax.random.PRNGKey(0), cond)
+    float(jax.device_get(img.sum()))  # real host fetch, see bench_framework
     t0 = time.perf_counter()
     reps = 3
     for i in range(reps):
         img = sampler(params, jax.random.PRNGKey(i + 1), cond)
-    jax.block_until_ready(img)
+    float(jax.device_get(img.sum()))
     sec_view = (time.perf_counter() - t0) / reps
 
     # Reference-style: per-step host loop, two separate un-jitted applies.
@@ -196,11 +219,12 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
         eps = 4.0 * e_c - 3.0 * e_u
         return z - 0.01 * eps  # shape-preserving update; cost is the fwds
 
-    z = jax.block_until_ready(ref_step(z, 0))  # warm caches
+    z = ref_step(z, 0)  # warm caches
+    float(jax.device_get(z.sum()))
     t0 = time.perf_counter()
     for t in range(probe):
         z = ref_step(z, t)
-    jax.block_until_ready(z)
+    float(jax.device_get(z.sum()))
     ref_sec_view = (time.perf_counter() - t0) / probe * sample_steps
 
     print(json.dumps({
@@ -234,7 +258,7 @@ def main():
     imgs_per_sec_chip = B / sec_fw / n_chips
 
     sec_ref = bench_reference_style(cfg, model, schedule, host_params, batch,
-                                    max(5, steps // 3))
+                                    steps)
     ref_imgs_per_sec_chip = B / sec_ref / n_chips
 
     print(json.dumps({
@@ -242,6 +266,7 @@ def main():
         "value": round(imgs_per_sec_chip, 3),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(imgs_per_sec_chip / ref_imgs_per_sec_chip, 3),
+        "baseline_value": round(ref_imgs_per_sec_chip, 3),
     }))
 
 

@@ -70,6 +70,10 @@ class DiffusionConfig:
     cosine_s: float = 0.008
     logsnr_min: float = -20.0
     logsnr_max: float = 20.0
+    # What the network predicts / is trained against: 'eps' (the reference's
+    # noise prediction), 'x0' (clean image), or 'v' (√ᾱε − √(1−ᾱ)x₀,
+    # Salimans & Ho 2022). Train step and samplers both honor this.
+    objective: str = "eps"
     # Sampling
     sample_timesteps: int = 1000  # respaced steps for the ancestral sampler
     guidance_weight: float = 3.0  # CFG w (reference sampling.py:134)

@@ -127,6 +127,22 @@ class DiffusionSchedule:
             self._extract(self.sqrt_recip_alphas_cumprod, t, z_t) * z_t - x0
         ) / self._extract(self.sqrt_recipm1_alphas_cumprod, t, z_t)
 
+    # -- v-parameterization (Salimans & Ho 2022, progressive distillation) --
+    def v_from_eps_x0(self, t, eps, x0):
+        """v = √ᾱ_t ε − √(1−ᾱ_t) x₀ — the training target for
+        objective='v'."""
+        return (
+            self._extract(self.sqrt_alphas_cumprod, t, eps) * eps
+            - self._extract(self.sqrt_one_minus_alphas_cumprod, t, eps) * x0
+        )
+
+    def predict_start_from_v(self, z_t, t, v):
+        """x̂₀ = √ᾱ_t z_t − √(1−ᾱ_t) v."""
+        return (
+            self._extract(self.sqrt_alphas_cumprod, t, z_t) * z_t
+            - self._extract(self.sqrt_one_minus_alphas_cumprod, t, z_t) * v
+        )
+
     def ddim_step(self, x0, z_t, t, noise, eta: float):
         """One DDIM update z_t → z_{t−1} (Song et al. 2021 eq. 12).
 

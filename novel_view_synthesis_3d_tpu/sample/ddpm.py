@@ -49,11 +49,8 @@ def _cfg_eps(model, params, model_batch: dict, w: float, dropout_rng=None):
     return (1.0 + w) * eps_cond - w * eps_uncond
 
 
-def _ancestral_update(schedule: DiffusionSchedule, z, t, eps, key,
-                      clip_denoised: bool):
-    x0 = schedule.predict_start_from_noise(z, t, eps)
-    if clip_denoised:
-        x0 = jnp.clip(x0, -1.0, 1.0)
+def _posterior_sample(schedule: DiffusionSchedule, x0, z, t, key):
+    """Draw z_{t−1} ~ q(z_{t−1}|z_t, x̂₀); noiseless at t=0."""
     mean, _, log_var = schedule.q_posterior(x0, z, t)
     noise = jax.random.normal(key, z.shape)
     nonzero = jnp.reshape(  # no noise at the final step; scalar or (B,) t
@@ -61,29 +58,47 @@ def _ancestral_update(schedule: DiffusionSchedule, z, t, eps, key,
     return mean + nonzero * jnp.exp(0.5 * log_var) * noise
 
 
-def _ddim_update(schedule: DiffusionSchedule, z, t, eps, key,
-                 clip_denoised: bool, eta: float):
-    """DDIM step on the respaced ᾱ ladder; math lives in the schedule.
-
-    ε̂ is re-derived inside ddim_step from the (possibly clipped) x̂₀ so the
-    update stays on the clipped trajectory.
-    """
-    x0 = schedule.predict_start_from_noise(z, t, eps)
-    if clip_denoised:
-        x0 = jnp.clip(x0, -1.0, 1.0)
-    noise = jax.random.normal(key, z.shape)
-    return schedule.ddim_step(x0, z, t, noise, eta)
+def _make_x0_fn(schedule: DiffusionSchedule, objective: str):
+    """x̂₀ from the network output under the configured objective."""
+    if objective == "eps":
+        return schedule.predict_start_from_noise
+    if objective == "x0":
+        return lambda z, t, out: out
+    if objective == "v":
+        return schedule.predict_start_from_v
+    raise ValueError(f"unknown objective {objective!r}")
 
 
 def _make_update(schedule: DiffusionSchedule, config: DiffusionConfig):
-    """Bind the configured reverse-process update (ddpm | ddim)."""
+    """Bind the configured reverse-process update (ddpm | ddim), converting
+    the network output (eps | x0 | v per diffusion.objective) to x̂₀ first.
+
+    CFG is applied in the network's output space before this conversion
+    (guidance in eps-space and v-space coincide up to the linear maps here).
+    """
+    x0_fn = _make_x0_fn(schedule, config.objective)
+    clip_denoised = config.clip_denoised
+
     if config.sampler == "ddim":
-        return partial(_ddim_update, schedule,
-                       clip_denoised=config.clip_denoised,
-                       eta=config.ddim_eta)
+        eta = config.ddim_eta
+
+        def update(z, t, out, key):
+            x0 = x0_fn(z, t, out)
+            if clip_denoised:
+                x0 = jnp.clip(x0, -1.0, 1.0)
+            noise = jax.random.normal(key, z.shape)
+            return schedule.ddim_step(x0, z, t, noise, eta)
+
+        return update
     if config.sampler == "ddpm":
-        return partial(_ancestral_update, schedule,
-                       clip_denoised=config.clip_denoised)
+
+        def update(z, t, out, key):
+            x0 = x0_fn(z, t, out)
+            if clip_denoised:
+                x0 = jnp.clip(x0, -1.0, 1.0)
+            return _posterior_sample(schedule, x0, z, t, key)
+
+        return update
     raise ValueError(f"unknown sampler {config.sampler!r}")
 
 

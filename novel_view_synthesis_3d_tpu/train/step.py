@@ -55,6 +55,9 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
     use and reduce-scatters the gradient — ZeRO-3 from annotations alone.
     """
     tcfg = config.train
+    objective = config.diffusion.objective
+    if objective not in ("eps", "x0", "v"):
+        raise ValueError(f"unknown objective {objective!r}")
     tx = make_optimizer(tcfg)
 
     def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
@@ -82,11 +85,20 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
             "K": batch["K"],
         }
 
+        # Regression target per diffusion.objective: ε (reference behavior),
+        # clean x₀, or v = √ᾱε − √(1−ᾱ)x₀ (Salimans & Ho 2022).
+        if objective == "eps":
+            regression_target = noise
+        elif objective == "x0":
+            regression_target = target
+        else:  # 'v'
+            regression_target = schedule.v_from_eps_x0(t, noise, target)
+
         def loss_fn(params):
-            eps_pred = model.apply(
+            pred = model.apply(
                 {"params": params}, model_batch, cond_mask=cond_mask,
                 train=True, rngs={"dropout": k_dropout})
-            return compute_loss(eps_pred, noise, tcfg.loss)
+            return compute_loss(pred, regression_target, tcfg.loss)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)

@@ -169,6 +169,17 @@ def test_cli_train_sample_eval_e2e(cli_workspace, capsys):
     assert result["num_views"] == 1
     assert result["checkpoint_step"] == 2
 
+    # --fid needs ≥2 pairs; 2 instances × 2 views each gives 4.
+    fid_json = str(tmp / "eval_fid.json")
+    assert main(["eval", root, "--out", fid_json, "--fid",
+                 "--views-per-instance", "2", "--sample-steps", "2",
+                 "--batch-size", "2"] + _tiny_overrides(tmp)) == 0
+    with open(fid_json) as fh:
+        result = json.load(fh)
+    assert "fid" in result and np.isfinite(result["fid"])
+    assert result["fid"] >= 0.0
+    assert result["num_views"] == 4
+
 
 def test_cli_sample_without_checkpoint_fails(cli_workspace, tmp_path):
     root = str(cli_workspace / "srn")

@@ -158,3 +158,21 @@ def test_predict_noise_from_start_inverts():
     eps_hat = sched.predict_noise_from_start(z, t, x0_hat)
     np.testing.assert_allclose(np.asarray(eps_hat), np.asarray(eps),
                                atol=1e-3, rtol=1e-3)
+
+
+def test_v_parameterization_identities():
+    from novel_view_synthesis_3d_tpu.config import DiffusionConfig
+    from novel_view_synthesis_3d_tpu.diffusion import make_schedule
+
+    sched = make_schedule(DiffusionConfig(timesteps=100))
+    rng = np.random.default_rng(1)
+    x0 = jnp.asarray(rng.uniform(-1, 1, (4, 8, 8, 3)), jnp.float32)
+    eps = jnp.asarray(rng.standard_normal((4, 8, 8, 3)), jnp.float32)
+    t = jnp.asarray([0, 33, 66, 99])
+    z = sched.q_sample(x0, t, eps)
+    v = sched.v_from_eps_x0(t, eps, x0)
+    # x̂₀ recovered from (z_t, v) equals the true x₀ (algebraic identity:
+    # √ᾱ z − √(1−ᾱ) v = (ᾱ + 1 − ᾱ) x₀).
+    x0_hat = sched.predict_start_from_v(z, t, v)
+    np.testing.assert_allclose(np.asarray(x0_hat), np.asarray(x0),
+                               atol=2e-3, rtol=2e-3)

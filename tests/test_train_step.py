@@ -112,3 +112,42 @@ def test_ema_params_track():
         lambda p, e: float(jnp.max(jnp.abs(p - e))),
         state.params, state.ema_params)
     assert max(jax.tree.leaves(diffs)) > 1e-6
+
+
+def test_train_step_objectives_run_and_learn():
+    """One step with each objective is finite; targets differ per objective."""
+    import dataclasses
+
+    from novel_view_synthesis_3d_tpu.config import (
+        Config, DiffusionConfig, MeshConfig, ModelConfig, TrainConfig)
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.diffusion import make_schedule
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+    from novel_view_synthesis_3d_tpu.train.state import create_train_state
+    from novel_view_synthesis_3d_tpu.train.step import make_train_step
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    batch = make_example_batch(batch_size=4, sidelength=16, seed=0)
+    losses = {}
+    for objective in ("eps", "x0", "v"):
+        cfg = Config(
+            model=ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32,
+                              num_res_blocks=1, attn_resolutions=(8,),
+                              dropout=0.0),
+            diffusion=DiffusionConfig(timesteps=50, objective=objective),
+            train=TrainConfig(batch_size=4, lr=1e-3, ema_decay=0.0),
+            mesh=MeshConfig(data=1, model=1, seq=1),
+        )
+        mesh = mesh_lib.make_mesh(cfg.mesh, devices=jax.devices()[:1])
+        model = XUNet(cfg.model)
+        schedule = make_schedule(cfg.diffusion)
+        state = create_train_state(cfg.train, model,
+                                   _sample_model_batch(batch))
+        state = mesh_lib.replicate(mesh, state)
+        step = make_train_step(cfg, model, schedule, mesh)
+        state, m = step(state, mesh_lib.shard_batch(mesh, batch))
+        losses[objective] = float(jax.device_get(m["loss"]))
+        assert np.isfinite(losses[objective]), objective
+    # The three objectives regress different targets → different losses.
+    assert len({round(v, 6) for v in losses.values()}) == 3
