@@ -125,6 +125,11 @@ class TrainConfig:
     optimizer: str = "adam"
     grad_clip: float = 0.0  # 0 = off
     warmup_steps: int = 0
+    # Micro-batching inside the jitted step (lax.scan over batch_size /
+    # grad_accum_steps slices, gradients averaged) — trains configs whose
+    # full-batch activations exceed HBM (paper256 ladder) without changing
+    # the effective batch. 1 = off.
+    grad_accum_steps: int = 1
     # ZeRO/FSDP: shard params + optimizer state over the mesh 'data' axis
     # (parallel/mesh.fsdp_spec). The reference replicates everything per
     # device (train.py:46).

@@ -58,6 +58,16 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
     objective = config.diffusion.objective
     if objective not in ("eps", "x0", "v"):
         raise ValueError(f"unknown objective {objective!r}")
+    accum = max(1, tcfg.grad_accum_steps)
+    if tcfg.batch_size % accum != 0:
+        raise ValueError(
+            f"batch_size {tcfg.batch_size} not divisible by "
+            f"grad_accum_steps {accum}")
+    if accum > 1 and tcfg.loss == "frobenius":
+        # The whole-tensor L2 norm is not decomposable across micro-batches
+        # (mean of micro norms ≠ full-batch norm), so accumulation would
+        # silently change the reference-parity objective.
+        raise ValueError("grad_accum_steps > 1 requires loss='mse'")
     tx = make_optimizer(tcfg)
 
     def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
@@ -94,13 +104,45 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         else:  # 'v'
             regression_target = schedule.v_from_eps_x0(t, noise, target)
 
-        def loss_fn(params):
+        def micro_loss(params, mb):
             pred = model.apply(
-                {"params": params}, model_batch, cond_mask=cond_mask,
-                train=True, rngs={"dropout": k_dropout})
-            return compute_loss(pred, regression_target, tcfg.loss)
+                {"params": params},
+                {k: mb[k] for k in model_batch},
+                cond_mask=mb["cond_mask"], train=True,
+                rngs={"dropout": mb["dropout_key"]})
+            return compute_loss(pred, mb["regression_target"], tcfg.loss)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        full = dict(model_batch, cond_mask=cond_mask,
+                    regression_target=regression_target)
+        if accum == 1:
+            loss, grads = jax.value_and_grad(micro_loss)(
+                state.params, dict(full, dropout_key=k_dropout))
+        else:
+            # lax.scan over micro-batches: activations live one slice at a
+            # time; gradients accumulate in a params-shaped f32 tree. Equal
+            # slice sizes make mean-of-means == full-batch mean.
+            micro = jax.tree.map(
+                lambda a: a.reshape((accum, a.shape[0] // accum)
+                                    + a.shape[1:]), full)
+            micro["dropout_key"] = jax.random.split(k_dropout, accum)
+
+            def body(carry, mb):
+                loss_sum, grad_sum = carry
+                l, g = jax.value_and_grad(micro_loss)(state.params, mb)
+                return (loss_sum + l,
+                        jax.tree.map(
+                            lambda s, x: s + x.astype(jnp.float32),
+                            grad_sum, g)), None
+
+            # Accumulate in f32 regardless of param_dtype — bf16 sums would
+            # swallow small per-micro-batch contributions — then cast back.
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (loss, grads), _ = jax.lax.scan(body, (0.0, zero_grads), micro)
+            loss = loss / accum
+            grads = jax.tree.map(
+                lambda g, p: (g / accum).astype(p.dtype),
+                grads, state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
 

@@ -151,3 +151,77 @@ def test_train_step_objectives_run_and_learn():
         assert np.isfinite(losses[objective]), objective
     # The three objectives regress different targets → different losses.
     assert len({round(v, 6) for v in losses.values()}) == 3
+
+
+def test_grad_accum_matches_full_batch():
+    """accum=2 must reproduce the accum=1 step (loss and params) given the
+    same per-step RNG, modulo fp reassociation. dropout=0 so the only
+    difference is the micro-batch split itself."""
+    from novel_view_synthesis_3d_tpu.config import (
+        Config, DiffusionConfig, MeshConfig, ModelConfig, TrainConfig)
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.diffusion import make_schedule
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+    from novel_view_synthesis_3d_tpu.train.state import create_train_state
+    from novel_view_synthesis_3d_tpu.train.step import make_train_step
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    batch = make_example_batch(batch_size=8, sidelength=16, seed=0)
+    model = XUNet(ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32,
+                              num_res_blocks=1, attn_resolutions=(8,),
+                              dropout=0.0))
+
+    def run(accum):
+        cfg = Config(
+            model=ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32,
+                              num_res_blocks=1, attn_resolutions=(8,),
+                              dropout=0.0),
+            diffusion=DiffusionConfig(timesteps=50),
+            train=TrainConfig(batch_size=8, lr=1e-3, ema_decay=0.0,
+                              grad_accum_steps=accum),
+            mesh=MeshConfig(data=1, model=1, seq=1),
+        )
+        mesh = mesh_lib.make_mesh(cfg.mesh, devices=jax.devices()[:1])
+        schedule = make_schedule(cfg.diffusion)
+        state = create_train_state(cfg.train, model,
+                                   _sample_model_batch(batch))
+        state = mesh_lib.replicate(mesh, state)
+        step = make_train_step(cfg, model, schedule, mesh)
+        state, m = step(state, mesh_lib.shard_batch(mesh, batch))
+        return (float(jax.device_get(m["loss"])),
+                jax.device_get(state.params))
+
+    loss1, params1 = run(1)
+    loss2, params2 = run(2)
+    assert np.isclose(loss1, loss2, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(params1), jax.tree.leaves(params2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-3, rtol=1e-3)
+
+
+def test_grad_accum_rejects_bad_configs():
+    import pytest
+
+    from novel_view_synthesis_3d_tpu.config import (
+        Config, DiffusionConfig, MeshConfig, ModelConfig, TrainConfig)
+    from novel_view_synthesis_3d_tpu.diffusion import make_schedule
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+    from novel_view_synthesis_3d_tpu.train.step import make_train_step
+
+    def mk(**train_kw):
+        cfg = Config(
+            model=ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32),
+            diffusion=DiffusionConfig(timesteps=8),
+            train=TrainConfig(**train_kw),
+            mesh=MeshConfig(data=1, model=1, seq=1),
+        )
+        mesh = mesh_lib.make_mesh(cfg.mesh, devices=jax.devices()[:1])
+        return make_train_step(cfg, XUNet(cfg.model),
+                               make_schedule(cfg.diffusion), mesh)
+
+    with pytest.raises(ValueError, match="not divisible"):
+        mk(batch_size=6, grad_accum_steps=4)
+    with pytest.raises(ValueError, match="loss='mse'"):
+        mk(batch_size=8, grad_accum_steps=2, loss="frobenius")
