@@ -125,6 +125,10 @@ class TrainConfig:
     optimizer: str = "adam"
     grad_clip: float = 0.0  # 0 = off
     warmup_steps: int = 0
+    # LR decay after warmup: 'constant' (reference behavior, train.py:46)
+    # or 'cosine' (decay to lr_final_fraction·lr over num_steps).
+    lr_schedule: str = "constant"
+    lr_final_fraction: float = 0.1
     # Micro-batching inside the jitted step (lax.scan over batch_size /
     # grad_accum_steps slices, gradients averaged) — trains configs whose
     # full-batch activations exceed HBM (paper256 ladder) without changing

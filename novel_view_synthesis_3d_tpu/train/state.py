@@ -28,13 +28,28 @@ class TrainState:
     ema_params: Optional[Any] = None
 
 
+def make_lr_schedule(cfg: TrainConfig):
+    """LR schedule per config — probeable directly (scalar or step→lr)."""
+    if cfg.lr_schedule == "constant":
+        return (optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps)
+                if cfg.warmup_steps > 0 else cfg.lr)
+    if cfg.lr_schedule == "cosine":
+        if cfg.warmup_steps > 0:
+            return optax.warmup_cosine_decay_schedule(
+                init_value=0.0, peak_value=cfg.lr,
+                warmup_steps=cfg.warmup_steps,
+                decay_steps=cfg.num_steps,
+                end_value=cfg.lr * cfg.lr_final_fraction)
+        return optax.cosine_decay_schedule(
+            init_value=cfg.lr, decay_steps=max(1, cfg.num_steps),
+            alpha=cfg.lr_final_fraction)
+    raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     if cfg.optimizer != "adam":
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
-    if cfg.warmup_steps > 0:
-        schedule = optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps)
-    else:
-        schedule = cfg.lr
+    schedule = make_lr_schedule(cfg)
     parts = []
     if cfg.grad_clip > 0:
         parts.append(optax.clip_by_global_norm(cfg.grad_clip))

@@ -68,6 +68,14 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         # (mean of micro norms ≠ full-batch norm), so accumulation would
         # silently change the reference-parity objective.
         raise ValueError("grad_accum_steps > 1 requires loss='mse'")
+    data_shards = mesh_lib.num_data_shards(mesh)
+    if accum > 1 and (tcfg.batch_size // accum) % data_shards != 0:
+        # A micro-batch that can't stay sharded over 'data' makes GSPMD
+        # replicate the batch inside the scan — memory goes UP, defeating
+        # the point of accumulation.
+        raise ValueError(
+            f"micro-batch {tcfg.batch_size // accum} not divisible by the "
+            f"data-axis size {data_shards}")
     tx = make_optimizer(tcfg)
 
     def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
