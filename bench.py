@@ -235,6 +235,23 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
     }))
 
 
+def bench_profile(preset_name: str, steps: int, overrides=(),
+                  out_dir: str = "./profile") -> None:
+    """Capture a jax.profiler trace of the train step (XLA ops, HBM, fusion
+    decisions) for offline inspection — the measurement tool for kernel-level
+    perf work that wall-clock timing over the tunnel can't resolve."""
+    cfg, mesh, model, schedule, state, step, batch, device_batch = build(
+        preset_name, overrides)
+    state, m = step(state, device_batch)  # compile outside the trace
+    float(jax.device_get(m["loss"]))
+    with jax.profiler.trace(out_dir):
+        for _ in range(steps):
+            state, m = step(state, device_batch)
+        float(jax.device_get(m["loss"]))
+    print(json.dumps({"metric": f"profile_{preset_name}", "value": steps,
+                      "unit": "steps", "trace_dir": out_dir}))
+
+
 def main():
     args = [a for a in sys.argv[1:] if "=" not in a]
     overrides = [a for a in sys.argv[1:] if "=" in a]
@@ -242,6 +259,11 @@ def main():
         preset = args[1] if len(args) > 1 else "tiny64"
         steps = int(args[2]) if len(args) > 2 else 256
         bench_sample(preset, steps, overrides)
+        return
+    if args and args[0] == "profile":
+        preset = args[1] if len(args) > 1 else "tiny64"
+        steps = int(args[2]) if len(args) > 2 else 5
+        bench_profile(preset, steps, overrides)
         return
     preset = args[0] if args else "tiny64"
     steps = int(args[1]) if len(args) > 1 else 30

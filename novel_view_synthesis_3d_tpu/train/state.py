@@ -34,6 +34,10 @@ def make_lr_schedule(cfg: TrainConfig):
         return (optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps)
                 if cfg.warmup_steps > 0 else cfg.lr)
     if cfg.lr_schedule == "cosine":
+        if cfg.warmup_steps >= cfg.num_steps:
+            raise ValueError(
+                f"lr_schedule='cosine' needs num_steps ({cfg.num_steps}) > "
+                f"warmup_steps ({cfg.warmup_steps})")
         if cfg.warmup_steps > 0:
             return optax.warmup_cosine_decay_schedule(
                 init_value=0.0, peak_value=cfg.lr,

@@ -328,3 +328,14 @@ def test_grad_accum_rejects_unshardable_microbatch():
     with pytest.raises(ValueError, match="micro-batch"):
         make_train_step(cfg, XUNet(cfg.model),
                         make_schedule(cfg.diffusion), mesh)
+
+
+def test_cosine_warmup_exceeding_num_steps_rejected():
+    import pytest
+
+    from novel_view_synthesis_3d_tpu.config import TrainConfig
+    from novel_view_synthesis_3d_tpu.train.state import make_lr_schedule
+
+    with pytest.raises(ValueError, match="warmup_steps"):
+        make_lr_schedule(TrainConfig(lr_schedule="cosine", warmup_steps=200,
+                                     num_steps=100))
