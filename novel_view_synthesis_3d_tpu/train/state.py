@@ -78,9 +78,22 @@ def create_train_state(cfg: TrainConfig, model, sample_batch: dict,
     seed = cfg.seed if seed is None else seed
     root = jax.random.PRNGKey(seed)
     k_params, k_dropout, k_train = jax.random.split(root, 3)
-    B = sample_batch["z"].shape[0]
     if on_cpu is None:
         on_cpu = jax.default_backend() != "cpu"
+
+    # Params are batch-size independent: init on the smallest batch slice
+    # so the traced init forward costs ~1/B of the real step (at paper256
+    # scale the full batch-8 256px forward takes tens of minutes on the
+    # host). A sequence-parallel model initializing on its real mesh needs
+    # the batch divisible by the 'data' axis, so keep that many rows.
+    min_b = 1
+    model_mesh = getattr(model, "mesh", None)
+    if not on_cpu and model_mesh is not None:
+        min_b = dict(model_mesh.shape).get("data", 1)
+    full_b = sample_batch["z"].shape[0]
+    min_b = min(min_b, full_b)
+    sample_batch = jax.tree.map(lambda a: a[:min_b], sample_batch)
+    B = min_b
 
     init_model = model
     if on_cpu and hasattr(model, "config"):
@@ -91,9 +104,15 @@ def create_train_state(cfg: TrainConfig, model, sample_batch: dict,
             sequence_parallel=False))
 
     def run_init():
-        return init_model.init(
-            {"params": k_params, "dropout": k_dropout},
-            sample_batch, cond_mask=jnp.ones((B,)), train=True)
+        # jit makes the init forward an XLA program instead of thousands of
+        # eager dispatches — the dominant cost of large-model host init.
+        @jax.jit
+        def _init(k_p, k_d, batch):
+            return init_model.init(
+                {"params": k_p, "dropout": k_d}, batch,
+                cond_mask=jnp.ones((B,)), train=True)
+
+        return _init(k_params, k_dropout, sample_batch)
 
     tx = make_optimizer(cfg)
 

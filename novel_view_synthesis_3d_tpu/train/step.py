@@ -33,6 +33,29 @@ from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
 from novel_view_synthesis_3d_tpu.train.state import TrainState, make_optimizer
 
 
+def effective_accum_steps(batch_size: int, data_shards: int,
+                          requested: int) -> int:
+    """Largest usable accumulation ≤ `requested` for this batch and mesh.
+
+    Accumulation only helps while each micro-batch can stay sharded over
+    the 'data' axis (micro % data_shards == 0) — otherwise GSPMD replicates
+    the batch inside the scan and memory goes UP. Per-chip memory already
+    scales as 1/data_shards, so the accumulation a config requests for one
+    chip is naturally satisfied by the sharding on many. Hence: the largest
+    divisor of the per-shard batch that is ≤ `requested`.
+    """
+    if batch_size % max(1, data_shards) != 0:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by data-axis size "
+            f"{data_shards}")
+    per_shard = batch_size // max(1, data_shards)
+    requested = max(1, requested)
+    for accum in range(min(requested, per_shard), 0, -1):
+        if per_shard % accum == 0:
+            return accum
+    return 1
+
+
 def compute_loss(eps_pred: jnp.ndarray, noise: jnp.ndarray, kind: str) -> jnp.ndarray:
     if kind == "mse":
         return jnp.mean(jnp.square(eps_pred - noise))
@@ -58,24 +81,14 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
     objective = config.diffusion.objective
     if objective not in ("eps", "x0", "v"):
         raise ValueError(f"unknown objective {objective!r}")
-    accum = max(1, tcfg.grad_accum_steps)
-    if tcfg.batch_size % accum != 0:
-        raise ValueError(
-            f"batch_size {tcfg.batch_size} not divisible by "
-            f"grad_accum_steps {accum}")
+    data_shards = mesh_lib.num_data_shards(mesh)
+    accum = effective_accum_steps(tcfg.batch_size, data_shards,
+                                  tcfg.grad_accum_steps)
     if accum > 1 and tcfg.loss == "frobenius":
         # The whole-tensor L2 norm is not decomposable across micro-batches
         # (mean of micro norms ≠ full-batch norm), so accumulation would
         # silently change the reference-parity objective.
         raise ValueError("grad_accum_steps > 1 requires loss='mse'")
-    data_shards = mesh_lib.num_data_shards(mesh)
-    if accum > 1 and (tcfg.batch_size // accum) % data_shards != 0:
-        # A micro-batch that can't stay sharded over 'data' makes GSPMD
-        # replicate the batch inside the scan — memory goes UP, defeating
-        # the point of accumulation.
-        raise ValueError(
-            f"micro-batch {tcfg.batch_size // accum} not divisible by the "
-            f"data-axis size {data_shards}")
     tx = make_optimizer(tcfg)
 
     def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
