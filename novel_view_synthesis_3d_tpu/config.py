@@ -129,6 +129,13 @@ class TrainConfig:
     # or 'cosine' (decay to lr_final_fraction·lr over num_steps).
     lr_schedule: str = "constant"
     lr_final_fraction: float = 0.1
+    # Per-timestep loss weighting: 'none' (uniform — the reference and DDPM
+    # default) or 'min_snr' (min-SNR-γ, Hang et al. 2023: clamp the
+    # effective SNR-dependent weight at γ so easy low-noise timesteps stop
+    # dominating training). Requires loss='mse' (the frobenius compat loss
+    # is a whole-batch norm with no per-sample decomposition).
+    loss_weighting: str = "none"
+    min_snr_gamma: float = 5.0
     # Micro-batching inside the jitted step (lax.scan over batch slices,
     # gradients averaged) — trains configs whose full-batch activations
     # exceed HBM (paper256 ladder) without changing the effective batch.

@@ -56,14 +56,42 @@ def effective_accum_steps(batch_size: int, data_shards: int,
     return 1
 
 
-def compute_loss(eps_pred: jnp.ndarray, noise: jnp.ndarray, kind: str) -> jnp.ndarray:
+def compute_loss(eps_pred: jnp.ndarray, noise: jnp.ndarray, kind: str,
+                 weight: jnp.ndarray | None = None) -> jnp.ndarray:
     if kind == "mse":
-        return jnp.mean(jnp.square(eps_pred - noise))
+        if weight is None:
+            return jnp.mean(jnp.square(eps_pred - noise))
+        # Per-sample MSE over pixel dims, then weighted batch mean.
+        per_sample = jnp.mean(
+            jnp.square(eps_pred - noise).reshape(eps_pred.shape[0], -1),
+            axis=-1)
+        return jnp.mean(weight * per_sample)
     if kind == "frobenius":
+        if weight is not None:
+            raise ValueError("loss weighting requires kind='mse' — the "
+                             "whole-tensor norm has no per-sample terms")
         # Reference parity (train.py:67): L2 norm of the whole flattened
         # residual tensor (jnp.mean over a scalar is the identity).
         return jnp.linalg.norm((eps_pred - noise).reshape(-1))
     raise ValueError(f"unknown loss {kind!r}")
+
+
+def min_snr_weight(snr: jnp.ndarray, gamma: float,
+                   objective: str) -> jnp.ndarray:
+    """Min-SNR-γ per-sample loss weight (Hang et al. 2023, arXiv 2303.09556).
+
+    The paper weights the x₀-space loss by min(SNR, γ); expressed in each
+    prediction space that becomes min(SNR,γ)/SNR for ε-prediction and
+    min(SNR,γ)/(SNR+1) for v-prediction.
+    """
+    clipped = jnp.minimum(snr, gamma)
+    if objective == "eps":
+        return clipped / snr
+    if objective == "x0":
+        return clipped
+    if objective == "v":
+        return clipped / (snr + 1.0)
+    raise ValueError(f"unknown objective {objective!r}")
 
 
 def make_train_step(config: Config, model, schedule: DiffusionSchedule,
@@ -89,6 +117,11 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         # (mean of micro norms ≠ full-batch norm), so accumulation would
         # silently change the reference-parity objective.
         raise ValueError("grad_accum_steps > 1 requires loss='mse'")
+    if tcfg.loss_weighting not in ("none", "min_snr"):
+        raise ValueError(
+            f"unknown loss_weighting {tcfg.loss_weighting!r}")
+    if tcfg.loss_weighting != "none" and tcfg.loss != "mse":
+        raise ValueError("loss_weighting requires loss='mse'")
     tx = make_optimizer(tcfg)
 
     def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
@@ -125,16 +158,26 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         else:  # 'v'
             regression_target = schedule.v_from_eps_x0(t, noise, target)
 
+        if tcfg.loss_weighting == "min_snr":
+            acp = jnp.take(schedule.alphas_cumprod, t, axis=0)
+            snr = acp / (1.0 - acp)
+            loss_weight = min_snr_weight(snr, tcfg.min_snr_gamma, objective)
+        else:
+            loss_weight = None
+
         def micro_loss(params, mb):
             pred = model.apply(
                 {"params": params},
                 {k: mb[k] for k in model_batch},
                 cond_mask=mb["cond_mask"], train=True,
                 rngs={"dropout": mb["dropout_key"]})
-            return compute_loss(pred, mb["regression_target"], tcfg.loss)
+            return compute_loss(pred, mb["regression_target"], tcfg.loss,
+                                weight=mb.get("loss_weight"))
 
         full = dict(model_batch, cond_mask=cond_mask,
                     regression_target=regression_target)
+        if loss_weight is not None:
+            full["loss_weight"] = loss_weight
         if accum == 1:
             loss, grads = jax.value_and_grad(micro_loss)(
                 state.params, dict(full, dropout_key=k_dropout))
