@@ -99,7 +99,7 @@ def cmd_sample(args, overrides: List[str]) -> int:
     from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
     from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
     from novel_view_synthesis_3d_tpu.utils.images import (
-        save_image, save_image_grid)
+        save_animation, save_image, save_image_grid)
 
     cfg = build_config(args, overrides)
     dcfg = cfg.diffusion
@@ -163,6 +163,9 @@ def cmd_sample(args, overrides: List[str]) -> int:
         save_image(img, os.path.join(args.out, f"view_{i:03d}.png"))
     save_image_grid(imgs, os.path.join(args.out, "grid.png"))
     save_image(x, os.path.join(args.out, "cond.png"))
+    if args.gif:
+        save_animation(imgs, os.path.join(args.out, "orbit.gif"),
+                       fps=args.gif_fps)
     print(f"wrote {len(imgs)} views to {args.out}")
     return 0
 
@@ -277,6 +280,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--step", type=int, default=None,
                    help="checkpoint step (default: latest)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--gif", action="store_true",
+                   help="also write a looping orbit.gif of the views")
+    p.add_argument("--gif-fps", type=float, default=8.0)
 
     p = sub.add_parser("eval", help="PSNR/SSIM/FID over held-out views")
     _add_common(p)

@@ -40,6 +40,23 @@ def save_image(img: np.ndarray, path: str) -> None:
     Image.fromarray(to_uint8(img)).save(path)
 
 
+def save_animation(imgs: np.ndarray, path: str, fps: float = 8.0) -> None:
+    """(N, H, W, 3) in [-1, 1] → animated GIF (looping).
+
+    Turntable/orbit export for sampled view sequences — the closest the
+    reference gets is a blocking per-view cv2 window (sampling.py:153-154).
+    """
+    imgs = np.asarray(imgs)
+    if imgs.ndim != 4 or imgs.shape[0] < 1:
+        raise ValueError(f"expected (N, H, W, C), got {imgs.shape}")
+    if not fps > 0:
+        raise ValueError(f"fps must be positive, got {fps}")
+    frames = [Image.fromarray(to_uint8(f)) for f in imgs]
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    frames[0].save(path, save_all=True, append_images=frames[1:],
+                   duration=max(1, int(round(1000.0 / fps))), loop=0)
+
+
 def save_image_grid(imgs: np.ndarray, path: str, cols: int = 4) -> None:
     """(N, H, W, 3) in [-1, 1] → one tiled PNG."""
     imgs = np.asarray(imgs)

@@ -153,10 +153,13 @@ def test_cli_train_sample_eval_e2e(cli_workspace, capsys):
 
     out_dir = str(tmp / "samples")
     assert main(["sample", root, "--out", out_dir, "--num-views", "2",
-                 "--sample-steps", "2"] + _tiny_overrides(tmp)) == 0
+                 "--sample-steps", "2", "--gif"] + _tiny_overrides(tmp)) == 0
     assert os.path.exists(os.path.join(out_dir, "view_000.png"))
     assert os.path.exists(os.path.join(out_dir, "grid.png"))
     assert os.path.exists(os.path.join(out_dir, "cond.png"))
+    from PIL import Image
+    with Image.open(os.path.join(out_dir, "orbit.gif")) as gif:
+        assert gif.n_frames == 2
 
     eval_json = str(tmp / "eval.json")
     assert main(["eval", root, "--out", eval_json, "--num-instances", "1",

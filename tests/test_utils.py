@@ -176,3 +176,29 @@ def test_shapenet_split(tmp_path):
     assert placed == {"train": ["aaa"], "val": ["bbb"], "test": ["ccc"]}
     assert os.path.exists(shapenet / f"{synset}_cars_train" / "aaa" /
                           "marker.txt")
+
+
+def test_save_animation_roundtrip(tmp_path):
+    from PIL import Image
+
+    from novel_view_synthesis_3d_tpu.utils.images import save_animation
+
+    rng = np.random.default_rng(0)
+    imgs = rng.uniform(-1, 1, size=(5, 8, 8, 3)).astype(np.float32)
+    path = str(tmp_path / "orbit.gif")
+    save_animation(imgs, path, fps=10)
+    with Image.open(path) as gif:
+        assert gif.n_frames == 5
+        assert gif.size == (8, 8)
+        assert gif.info.get("duration") == 100
+    with pytest.raises(ValueError):
+        save_animation(imgs[0], str(tmp_path / "bad.gif"))
+
+
+def test_save_animation_rejects_bad_fps(tmp_path):
+    from novel_view_synthesis_3d_tpu.utils.images import save_animation
+
+    imgs = np.zeros((2, 4, 4, 3), np.float32)
+    for fps in (0, -5):
+        with pytest.raises(ValueError, match="fps"):
+            save_animation(imgs, str(tmp_path / "x.gif"), fps=fps)
