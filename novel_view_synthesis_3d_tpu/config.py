@@ -236,16 +236,26 @@ class Config:
         return Config.from_dict(d)
 
     def apply_cli(self, argv: Sequence[str]) -> "Config":
-        """Apply 'model.ch=64'-style CLI overrides (values parsed as JSON)."""
+        """Apply 'model.ch=64'-style CLI overrides.
+
+        Values parse as JSON, plus the Python spellings True/False/None —
+        otherwise `model.use_flash_attention=False` would silently arrive
+        as the string 'False' (truthy!) and either crash later or flip the
+        wrong way.
+        """
+        py_literals = {"True": True, "False": False, "None": None}
         overrides = {}
         for arg in argv:
             if "=" not in arg:
                 raise ValueError(f"override must look like key=value: {arg!r}")
             k, v = arg.split("=", 1)
-            try:
-                overrides[k] = json.loads(v)
-            except json.JSONDecodeError:
-                overrides[k] = v  # bare string
+            if v in py_literals:
+                overrides[k] = py_literals[v]
+            else:
+                try:
+                    overrides[k] = json.loads(v)
+                except json.JSONDecodeError:
+                    overrides[k] = v  # bare string
         return self.override(**overrides)
 
 

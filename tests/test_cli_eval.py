@@ -81,6 +81,22 @@ def test_cli_config_overrides(capsys):
     assert out["train"]["lr"] == 0.001
 
 
+def test_cli_overrides_python_bool_spellings(capsys):
+    """Python-style True/False/None must parse as booleans/null, not as the
+    (truthy!) strings 'True'/'False'/'None'."""
+    main(["config", "--preset", "tiny64",
+          "model.use_flash_attention=False", "train.fsdp=True",
+          "data.specific_observation_idcs=None"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["model"]["use_flash_attention"] is False
+    assert out["train"]["fsdp"] is True
+    assert out["data"]["specific_observation_idcs"] is None
+    # JSON spellings keep working.
+    main(["config", "--preset", "tiny64", "model.use_flash_attention=true"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["model"]["use_flash_attention"] is True
+
+
 def test_cli_rejects_bad_override():
     with pytest.raises(SystemExit):
         main(["config", "--preset", "tiny64", "not-an-override"])
