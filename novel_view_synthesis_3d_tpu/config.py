@@ -43,7 +43,12 @@ class ModelConfig:
     # --- TPU knobs ---
     dtype: str = "float32"  # compute dtype: "float32" | "bfloat16"
     param_dtype: str = "float32"
-    remat: bool = False  # jax.checkpoint each UNet block (memory for FLOPs)
+    # Rematerialization of UNet blocks: False/'none' = off; True/'full' =
+    # jax.checkpoint each block (min memory, max recompute); 'dots' = save
+    # conv/matmul outputs, recompute elementwise chains
+    # (checkpoint_policies.dots_saveable) — cuts HBM traffic without
+    # re-running convs, often the right setting for bandwidth-bound configs.
+    remat: Any = False
     # Fused Pallas attention kernel (ops/flash_attention.py) instead of the
     # XLA dot_product_attention path. "auto" (default) enables it on TPU
     # backends only (measured +26-35% train step on v5e at tiny64) and keeps

@@ -47,6 +47,47 @@ def _as_frames(arr: jnp.ndarray, frame_rank: int) -> jnp.ndarray:
     return arr
 
 
+def _named_remat(policy=None):
+    """nn.remat(XUNetBlock) renamed back to 'XUNetBlock'.
+
+    Flax derives parameter paths from the class name, and the lifted
+    transform returns a class called 'CheckpointXUNetBlock' — which would
+    silently fork the param tree ('CheckpointXUNetBlock_0' vs
+    'XUNetBlock_0') and make checkpoints non-portable between remat
+    settings (train at 256px with remat, sample without). Renaming the
+    wrapped class keeps one layout for every mode. (A checkpoint written by
+    a pre-rename build with remat on can be migrated by renaming its
+    'CheckpointXUNetBlock_N' keys to 'XUNetBlock_N'.)
+    """
+    cls = nn.remat(XUNetBlock, policy=policy)
+    cls.__name__ = "XUNetBlock"
+    cls.__qualname__ = "XUNetBlock"
+    return cls
+
+
+def _remat_block(remat):
+    """Resolve config.model.remat → the (possibly rematerialized) block class.
+
+    False = no remat. True / 'full' = recompute everything in the backward
+    pass (smallest memory, most recompute FLOPs). 'dots' = save matmul/conv
+    outputs, recompute only the elementwise chains between them
+    (jax.checkpoint_policies.dots_saveable) — the bandwidth-flops middle
+    ground for an HBM-bound model: GroupNorm/swish/FiLM intermediates are
+    never written to HBM, while no conv runs twice.
+    """
+    import jax
+
+    if remat in (False, "none"):
+        return XUNetBlock
+    if remat in (True, "full"):
+        return _named_remat()
+    if remat == "dots":
+        return _named_remat(jax.checkpoint_policies.dots_saveable)
+    raise ValueError(
+        f"model.remat must be False, True, 'none', 'full', or 'dots'; "
+        f"got {remat!r}")
+
+
 
 
 class ConditioningProcessor(nn.Module):
@@ -171,7 +212,7 @@ class XUNet(nn.Module):
 
         # `train` is threaded as a module attribute (static by construction)
         # so the blocks can be remat'd without static-argnum plumbing.
-        Block = nn.remat(XUNetBlock) if cfg.remat else XUNetBlock
+        Block = _remat_block(cfg.remat)
 
         def block(features, use_attn, h, emb, train):
             return Block(

@@ -209,3 +209,49 @@ def test_frameconv_equivalent_to_per_frame_conv():
     out0 = conv.apply(v, h[:, :1])
     np.testing.assert_allclose(np.asarray(out[:, :1]), np.asarray(out0),
                                atol=1e-5)
+
+
+def test_remat_modes_same_params_and_grads():
+    """Every remat mode must yield the SAME param tree (checkpoints trained
+    with remat on/off are interchangeable — nn.remat's 'CheckpointXUNetBlock'
+    class name would otherwise fork the tree) and identical outputs/grads."""
+    import dataclasses
+
+    batch = make_batch(jax.random.PRNGKey(3))
+    results = {}
+    for remat in (False, True, "full", "dots", "none"):
+        cfg = dataclasses.replace(TINY, remat=remat)
+        model = XUNet(cfg)
+        v = model.init({"params": jax.random.PRNGKey(0)}, batch,
+                       cond_mask=jnp.ones((batch["z"].shape[0],)),
+                       train=False)
+
+        def loss(p):
+            out = model.apply({"params": p}, batch,
+                              cond_mask=jnp.ones((batch["z"].shape[0],)),
+                              train=False)
+            return jnp.sum((out - 0.5) ** 2)
+
+        g = jax.jit(jax.grad(loss))(v["params"])
+        results[str(remat)] = (v["params"], jax.device_get(g))
+
+    base_params, base_grads = results["False"]
+    base_paths = [jax.tree_util.keystr(p) for p, _ in
+                  jax.tree_util.tree_flatten_with_path(base_params)[0]]
+    for mode, (params, grads) in results.items():
+        paths = [jax.tree_util.keystr(p) for p, _ in
+                 jax.tree_util.tree_flatten_with_path(params)[0]]
+        assert paths == base_paths, f"param tree differs for remat={mode}"
+        for a, b in zip(jax.tree.leaves(base_grads), jax.tree.leaves(grads)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_remat_rejects_unknown_mode():
+    import dataclasses
+
+    batch = make_batch(jax.random.PRNGKey(3))
+    with pytest.raises(ValueError, match="remat"):
+        XUNet(dataclasses.replace(TINY, remat="bogus")).init(
+            {"params": jax.random.PRNGKey(0)}, batch,
+            cond_mask=jnp.ones((batch["z"].shape[0],)), train=False)
