@@ -24,6 +24,7 @@ Batch contract (canonical keys, reference train.py:23-34):
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -75,8 +76,6 @@ def _remat_block(remat):
     ground for an HBM-bound model: GroupNorm/swish/FiLM intermediates are
     never written to HBM, while no conv runs twice.
     """
-    import jax
-
     if remat in (False, "none"):
         return XUNetBlock
     if remat in (True, "full"):

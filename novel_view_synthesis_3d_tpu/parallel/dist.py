@@ -22,9 +22,14 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     On Cloud TPU VMs `jax.distributed.initialize()` auto-discovers the pod
     topology from the metadata server; explicit args cover other clusters.
     Safe to call unconditionally: single-process environments skip init.
+
+    NOTE: must not touch the XLA backend before deciding — jax.distributed
+    rejects initialization after any backend query (jax.devices,
+    jax.process_count, any computation), so the already-initialized check
+    uses jax.distributed.is_initialized(), not jax.process_count().
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    if jax.distributed.is_initialized():
+        return
     explicit = coordinator_address is not None
     # Opt-in env gate (NVS3D_MULTIHOST=1) rather than sniffing TPU_* vars:
     # single-host TPU containers may set TPU_WORKER_HOSTNAMES themselves.
