@@ -137,6 +137,8 @@ def cmd_sample(args, overrides: List[str]) -> int:
     schedule = sampling_schedule(dcfg, args.sample_steps)
     key = jax.random.PRNGKey(args.seed)
 
+    if args.stochastic and args.denoise_gif:
+        raise SystemExit("--denoise-gif is not supported with --stochastic")
     if args.stochastic:
         # Autoregressive 3DiM sampling: each generated view joins the
         # conditioning pool for the next (sample/ddpm.py).
@@ -150,13 +152,31 @@ def cmd_sample(args, overrides: List[str]) -> int:
     else:
         # One batched reverse process: the conditioning view broadcasts over
         # all N target poses (same pattern as eval/evaluate.py).
-        sampler = make_sampler(model, schedule, dcfg)
+        traj_every = 0
+        if args.denoise_gif:
+            # Aim for ~32 frames of the reverse process, hard-capped at 64:
+            # among the divisors of T that give ≤64 frames, pick the frame
+            # count closest to 32 (never fall back to every-step capture —
+            # at T=499 that would materialize a (499, N, H, W, 3) tensor).
+            T = schedule.num_timesteps
+            divisors = [d for d in range(1, T + 1)
+                        if T % d == 0 and T // d <= 64]
+            traj_every = min(divisors, key=lambda d: abs(T // d - 32))
+        sampler = make_sampler(model, schedule, dcfg,
+                               trajectory_every=traj_every)
         N = len(poses2)
         cond = {k: jnp.broadcast_to(v, (N,) + v.shape[1:])
                 for k, v in first_view.items()}
         cond["R2"] = jnp.asarray(poses2[:, :3, :3])
         cond["t2"] = jnp.asarray(poses2[:, :3, 3])
-        imgs = np.asarray(jax.device_get(sampler(params, key, cond)))
+        out = sampler(params, key, cond)
+        if traj_every:
+            out, traj = out
+            # Slice to view 0 on device: only its frames cross to the host.
+            save_animation(
+                np.asarray(jax.device_get(traj[:, 0])),
+                os.path.join(args.out, "denoise.gif"), fps=args.gif_fps)
+        imgs = np.asarray(jax.device_get(out))
 
     os.makedirs(args.out, exist_ok=True)
     for i, img in enumerate(imgs):
@@ -283,6 +303,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--gif", action="store_true",
                    help="also write a looping orbit.gif of the views")
     p.add_argument("--gif-fps", type=float, default=8.0)
+    p.add_argument("--denoise-gif", action="store_true",
+                   help="also write denoise.gif showing the reverse "
+                        "diffusion of the first view (not with --stochastic)")
 
     p = sub.add_parser("eval", help="PSNR/SSIM/FID over held-out views")
     _add_common(p)

@@ -102,33 +102,57 @@ def _make_update(schedule: DiffusionSchedule, config: DiffusionConfig):
     raise ValueError(f"unknown sampler {config.sampler!r}")
 
 
-def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig):
+def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
+                 trajectory_every: int = 0):
     """Jitted sampler for a fixed conditioning layout (k = model's Fc).
 
     sample(params, key, cond) -> (B, H, W, 3) images in [-1, 1], where cond
     holds x, R1, t1, R2, t2, K (the clean conditioning view(s) + poses).
+
+    `trajectory_every=k` (k > 0, k | num_timesteps) makes the sampler ALSO
+    return the partially-denoised z after every k-th reverse step:
+    sample(...) -> (final, trajectory) with trajectory
+    (num_timesteps//k, B, H, W, 3), final == trajectory[-1]. Implemented as
+    a nested scan (inner k steps, outer collects), so the RNG stream — and
+    therefore the final image — is bit-identical to the flat sampler.
     """
     w = config.guidance_weight
     update = _make_update(schedule, config)
+    T = schedule.num_timesteps
+    if trajectory_every < 0 or (trajectory_every
+                                and T % trajectory_every != 0):
+        raise ValueError(
+            f"trajectory_every must be 0 or a divisor of {T}; "
+            f"got {trajectory_every}")
+
+    def body(cond, params, carry, t):
+        z, key = carry
+        key, k_step = jax.random.split(key)
+        batch = dict(cond, z=z,
+                     logsnr=jnp.full((z.shape[0],), schedule.logsnr(t)))
+        eps = _cfg_eps(model, params, batch, w)
+        z = update(z, t, eps, k_step)
+        return (z, key), None
 
     @jax.jit
     def sample(params, key, cond: dict) -> jnp.ndarray:
         z_shape = cond["x"].shape[:1] + cond["x"].shape[-3:]  # (B, H, W, 3)
         key, k_init = jax.random.split(key)
         z0 = jax.random.normal(k_init, z_shape)
-        ts = jnp.arange(schedule.num_timesteps - 1, -1, -1)
+        ts = jnp.arange(T - 1, -1, -1)
+        step = partial(body, cond, params)
 
-        def body(carry, t):
-            z, key = carry
-            key, k_step = jax.random.split(key)
-            batch = dict(cond, z=z,
-                         logsnr=jnp.full((z.shape[0],), schedule.logsnr(t)))
-            eps = _cfg_eps(model, params, batch, w)
-            z = update(z, t, eps, k_step)
-            return (z, key), None
+        if not trajectory_every:
+            (z, _), _ = jax.lax.scan(step, (z0, key), ts)
+            return z
 
-        (z, _), _ = jax.lax.scan(body, (z0, key), ts)
-        return z
+        def outer(carry, ts_chunk):
+            carry, _ = jax.lax.scan(step, carry, ts_chunk)
+            return carry, carry[0]
+
+        chunks = ts.reshape(T // trajectory_every, trajectory_every)
+        (z, _), traj = jax.lax.scan(outer, (z0, key), chunks)
+        return z, traj
 
     return sample
 
