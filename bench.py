@@ -19,7 +19,23 @@ import json
 import sys
 import time
 
+import os
+
 import jax
+
+# Honor JAX_PLATFORMS=cpu set after interpreter start-up: the container's
+# sitecustomize imports jax first, and the remote-accelerator registration
+# hook initializes its client on the first backend query unless the platform
+# is pinned via jax.config too (same dance as tests/conftest.py). Without
+# this, CPU-only bench/analyze runs hang whenever the accelerator tunnel is
+# down.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -235,6 +251,41 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
     }))
 
 
+def bench_analyze(preset_name: str, overrides=()) -> None:
+    """Static roofline analysis of the jitted train step via XLA's own
+    cost model: FLOPs, HBM bytes accessed, arithmetic intensity, and peak
+    memory — the numbers that say whether a config is MXU-bound or
+    bandwidth-bound BEFORE burning device time on wall-clock runs. (This is
+    how base128 was diagnosed as HBM-bound: 14.8 TFLOP over 130 GB/step =
+    114 FLOP/byte against a v5e ridge point of ~240.)
+    """
+    cfg, mesh, model, schedule, state, step, batch, device_batch = build(
+        preset_name, overrides)
+    compiled = step.lower(state, device_batch).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    result = {
+        "metric": f"analyze_{preset_name}",
+        "flops_per_step": flops,
+        "bytes_accessed_per_step": byts,
+        "arithmetic_intensity_flop_per_byte": (
+            round(flops / byts, 2) if byts else None),
+        "batch_size": cfg.train.batch_size,
+        "unit": "flop,byte",
+    }
+    mem = compiled.memory_analysis()
+    if mem is not None:
+        for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                result[k] = int(v)
+    print(json.dumps(result))
+
+
 def bench_profile(preset_name: str, steps: int, overrides=(),
                   out_dir: str = "./profile") -> None:
     """Capture a jax.profiler trace of the train step (XLA ops, HBM, fusion
@@ -264,6 +315,10 @@ def main():
         preset = args[1] if len(args) > 1 else "tiny64"
         steps = int(args[2]) if len(args) > 2 else 5
         bench_profile(preset, steps, overrides)
+        return
+    if args and args[0] == "analyze":
+        preset = args[1] if len(args) > 1 else "tiny64"
+        bench_analyze(preset, overrides)
         return
     preset = args[0] if args else "tiny64"
     steps = int(args[1]) if len(args) > 1 else 30

@@ -101,6 +101,9 @@ def cmd_sample(args, overrides: List[str]) -> int:
     from novel_view_synthesis_3d_tpu.utils.images import (
         save_animation, save_image, save_image_grid)
 
+    if args.stochastic and args.denoise_gif:
+        # Fail fast — before dataset IO and checkpoint restore.
+        raise SystemExit("--denoise-gif is not supported with --stochastic")
     cfg = build_config(args, overrides)
     dcfg = cfg.diffusion
     ds = SRNDataset(args.folder or cfg.data.root_dir,
@@ -137,8 +140,6 @@ def cmd_sample(args, overrides: List[str]) -> int:
     schedule = sampling_schedule(dcfg, args.sample_steps)
     key = jax.random.PRNGKey(args.seed)
 
-    if args.stochastic and args.denoise_gif:
-        raise SystemExit("--denoise-gif is not supported with --stochastic")
     if args.stochastic:
         # Autoregressive 3DiM sampling: each generated view joins the
         # conditioning pool for the next (sample/ddpm.py).
@@ -163,7 +164,8 @@ def cmd_sample(args, overrides: List[str]) -> int:
                         if T % d == 0 and T // d <= 64]
             traj_every = min(divisors, key=lambda d: abs(T // d - 32))
         sampler = make_sampler(model, schedule, dcfg,
-                               trajectory_every=traj_every)
+                               trajectory_every=traj_every,
+                               trajectory_views=1)
         N = len(poses2)
         cond = {k: jnp.broadcast_to(v, (N,) + v.shape[1:])
                 for k, v in first_view.items()}
@@ -171,10 +173,9 @@ def cmd_sample(args, overrides: List[str]) -> int:
         cond["t2"] = jnp.asarray(poses2[:, :3, 3])
         out = sampler(params, key, cond)
         if traj_every:
-            out, traj = out
-            # Slice to view 0 on device: only its frames cross to the host.
+            out, traj = out  # traj is (frames, 1, H, W, 3): view 0 only
             save_animation(
-                np.asarray(jax.device_get(traj[:, 0])),
+                np.asarray(jax.device_get(traj))[:, 0],
                 os.path.join(args.out, "denoise.gif"), fps=args.gif_fps)
         imgs = np.asarray(jax.device_get(out))
 

@@ -103,7 +103,8 @@ def _make_update(schedule: DiffusionSchedule, config: DiffusionConfig):
 
 
 def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
-                 trajectory_every: int = 0):
+                 trajectory_every: int = 0,
+                 trajectory_views: Optional[int] = None):
     """Jitted sampler for a fixed conditioning layout (k = model's Fc).
 
     sample(params, key, cond) -> (B, H, W, 3) images in [-1, 1], where cond
@@ -112,9 +113,12 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
     `trajectory_every=k` (k > 0, k | num_timesteps) makes the sampler ALSO
     return the partially-denoised z after every k-th reverse step:
     sample(...) -> (final, trajectory) with trajectory
-    (num_timesteps//k, B, H, W, 3), final == trajectory[-1]. Implemented as
-    a nested scan (inner k steps, outer collects), so the RNG stream — and
-    therefore the final image — is bit-identical to the flat sampler.
+    (num_timesteps//k, B', H, W, 3), final == trajectory[-1][:B'].
+    Implemented as a nested scan (inner k steps, outer collects), so the
+    RNG stream — and therefore the final image — is bit-identical to the
+    flat sampler. `trajectory_views` limits B' to the first n batch entries
+    so a consumer that only wants one view's denoising film doesn't buy the
+    whole batch's trajectory in HBM (B' = B when None).
     """
     w = config.guidance_weight
     update = _make_update(schedule, config)
@@ -148,7 +152,9 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
 
         def outer(carry, ts_chunk):
             carry, _ = jax.lax.scan(step, carry, ts_chunk)
-            return carry, carry[0]
+            z = carry[0]
+            return carry, (z if trajectory_views is None
+                           else z[:trajectory_views])
 
         chunks = ts.reshape(T // trajectory_every, trajectory_every)
         (z, _), traj = jax.lax.scan(outer, (z0, key), chunks)

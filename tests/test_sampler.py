@@ -233,3 +233,19 @@ def test_trajectory_every_validation():
         make_sampler(model, sched, dcfg, trajectory_every=3)
     with pytest.raises(ValueError, match="trajectory_every"):
         make_sampler(model, sched, dcfg, trajectory_every=-1)
+
+
+def test_trajectory_views_limits_batch():
+    dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8)
+    sched = make_schedule(dcfg)
+    model, params, cond = _model_and_params()
+    full = make_sampler(model, sched, dcfg, trajectory_every=2)
+    lim = make_sampler(model, sched, dcfg, trajectory_every=2,
+                       trajectory_views=1)
+    key = jax.random.PRNGKey(7)
+    final_f, traj_f = full(params, key, cond)
+    final_l, traj_l = lim(params, key, cond)
+    assert traj_l.shape == (4, 1, 16, 16, 3)
+    np.testing.assert_array_equal(np.asarray(final_l), np.asarray(final_f))
+    np.testing.assert_array_equal(np.asarray(traj_l)[:, 0],
+                                  np.asarray(traj_f)[:, 0])
