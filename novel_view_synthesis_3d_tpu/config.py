@@ -119,6 +119,11 @@ class TrainConfig:
     save_every: int = 1000
     log_every: int = 50
     sample_every: int = 0  # 0 = never dump eval samples during training
+    # Every N steps, sample the held batch's target poses and log PSNR/SSIM
+    # vs ground truth to results_folder/eval.csv (0 = off). Cheap in-loop
+    # quality signal; full held-out evaluation stays in the `eval` CLI.
+    eval_every: int = 0
+    eval_sample_steps: int = 64  # respaced steps for the in-loop eval
     seed: int = 0
     # Per-sample probability of dropping pose conditioning for CFG
     # (reference: train.py:64 uses 0.1, but bakes the mask at trace time).

@@ -67,5 +67,23 @@ class MetricsLogger:
             "imgs_per_sec_per_chip": imgs_per_sec_per_chip,
         }
 
+    def log_eval(self, step: int, metrics: dict) -> None:
+        """Append eval-quality metrics (PSNR/SSIM/…) to eval.csv + TB."""
+        path = os.path.join(os.path.dirname(self.csv_path), "eval.csv")
+        new = not os.path.exists(path) or os.path.getsize(path) == 0
+        with open(path, "a", newline="") as fh:
+            w = csv.writer(fh)
+            if new:
+                w.writerow(["step"] + sorted(metrics))
+            w.writerow([step] + [f"{float(metrics[k]):.5f}"
+                                 for k in sorted(metrics)])
+        if self._tb is not None:
+            import tensorflow as tf
+
+            with self._tb.as_default():
+                for k in sorted(metrics):
+                    tf.summary.scalar(f"eval/{k}", float(metrics[k]),
+                                      step=step)
+
     def close(self) -> None:
         self._csv_file.close()

@@ -139,6 +139,11 @@ class Trainer:
             mesh=self.mesh if config.model.sequence_parallel else None)
         first_batch = next(self.data_iter)
         self._held_batch = first_batch
+        # Fixed probe batch for eval_every: scoring the SAME views every
+        # time makes the PSNR/SSIM curve comparable across steps (a fresh
+        # random batch per eval would swing several dB on content alone).
+        self._eval_batch = jax.tree.map(np.array, first_batch)
+        self._samplers = {}  # (sample_steps) -> jitted sampler, see _sampler
         self.state = create_train_state(
             tcfg, self.model, _sample_model_batch(first_batch))
         self._state_sharding = mesh_lib.state_shardings(
@@ -206,6 +211,12 @@ class Trainer:
             return batch
         return next(self.data_iter)
 
+    def _peek_batch(self) -> dict:
+        """Look at the next batch without consuming it from the loop."""
+        if self._held_batch is None:
+            self._held_batch = next(self.data_iter)
+        return self._held_batch
+
     def train(self) -> None:
         tcfg = self.config.train
         last_metrics = None
@@ -250,6 +261,11 @@ class Trainer:
             if tcfg.sample_every and step_now % tcfg.sample_every == 0:
                 self.dump_samples(step_now)
 
+            if tcfg.eval_every and step_now % tcfg.eval_every == 0:
+                logged = self.eval_step(step_now)
+                print(f"{step_now}: eval psnr={logged['psnr']:.2f} "
+                      f"ssim={logged['ssim']:.4f}")
+
             if self._preempt_agreed():
                 print(f"preemption signal received at step {step_now}: "
                       "checkpointing and exiting")
@@ -267,28 +283,66 @@ class Trainer:
             print(f"step timing: {timing}")
 
     # ------------------------------------------------------------------
+    def eval_step(self, step: int, num: int = 4) -> dict:
+        """In-loop quality probe on a FIXED batch of training views.
+
+        Samples the probe batch's target poses and scores PSNR/SSIM against
+        the ground-truth targets — same views every call, so the eval.csv
+        curve is comparable across steps. (It is a training-data probe, not
+        a held-out evaluation; the `eval` CLI does that.) Uses EMA params
+        when available, a respaced `eval_sample_steps` ladder, and logs to
+        eval.csv — the reference has no quality signal at all during
+        training (SURVEY.md §5.5)."""
+        from novel_view_synthesis_3d_tpu.eval.metrics import psnr, ssim
+
+        batch = self._eval_batch
+        num = min(num, batch["target"].shape[0])
+        imgs = self._sample_cond(
+            {k: jnp.asarray(batch[k][:num])
+             for k in ("x", "R1", "t1", "R2", "t2", "K")},
+            seed=step, sample_steps=self.config.train.eval_sample_steps)
+        truth = np.asarray(batch["target"][:num])
+        logged = {
+            "psnr": float(np.mean(psnr(imgs, truth))),
+            "ssim": float(np.mean(ssim(imgs, truth))),
+        }
+        self.metrics.log_eval(step, logged)
+        return logged
+
+    def _sample_cond(self, cond: dict, seed: int,
+                     sample_steps: Optional[int] = None) -> np.ndarray:
+        """Sample novel views for a conditioning dict with current params.
+
+        Samples with dense (non-sequence-parallel) attention: identical math
+        and identical params, but free of the batch/'data'-axis
+        divisibility constraint the ring path imposes (a 4-view probe need
+        not divide the mesh). Samplers are cached per sample_steps — a
+        fresh make_sampler closure would recompile its scan on every call."""
+        key = sample_steps or self.config.diffusion.sample_timesteps
+        sampler = self._samplers.get(key)
+        if sampler is None:
+            dcfg = self.config.diffusion
+            sample_model = self.model
+            if self.config.model.sequence_parallel:
+                import dataclasses
+                sample_model = XUNet(dataclasses.replace(
+                    self.config.model, sequence_parallel=False))
+            sampler = make_sampler(sample_model,
+                                   sampling_schedule(dcfg, sample_steps),
+                                   dcfg)
+            self._samplers[key] = sampler
+        params = (self.state.ema_params if self.state.ema_params is not None
+                  else self.state.params)
+        imgs = sampler(params, jax.random.PRNGKey(seed), cond)
+        return np.asarray(jax.device_get(imgs))
+
     def dump_samples(self, step: int, num: int = 4,
                      sample_steps: Optional[int] = None) -> str:
         """Sample novel views for the first records and write a PNG grid."""
-        dcfg = self.config.diffusion
-        # Sample with dense (non-sequence-parallel) attention: identical math
-        # and identical params, but free of the batch/'data'-axis
-        # divisibility constraint the ring path imposes (num=4 here need not
-        # divide the mesh).
-        sample_model = self.model
-        if self.config.model.sequence_parallel:
-            import dataclasses
-            sample_model = XUNet(dataclasses.replace(
-                self.config.model, sequence_parallel=False))
-        sampler = make_sampler(sample_model,
-                               sampling_schedule(dcfg, sample_steps), dcfg)
-        batch = self._held_batch if self._held_batch is not None else next(self.data_iter)
-        self._held_batch = batch
+        batch = self._peek_batch()
         cond = {k: jnp.asarray(batch[k][:num])
                 for k in ("x", "R1", "t1", "R2", "t2", "K")}
-        params = (self.state.ema_params if self.state.ema_params is not None
-                  else self.state.params)
-        imgs = sampler(params, jax.random.PRNGKey(step), cond)
+        imgs = self._sample_cond(cond, seed=step, sample_steps=sample_steps)
         path = os.path.join(self.results_folder, f"samples_{step:07d}.png")
-        save_image_grid(np.asarray(jax.device_get(imgs)), path)
+        save_image_grid(imgs, path)
         return path

@@ -107,3 +107,26 @@ def test_reference_compatible_constructor(srn_root, tmp_path):
     t.train()
     assert t.step == 1
     t.ckpt.close()
+
+
+def test_in_loop_eval(srn_root, tmp_path):
+    """train.eval_every samples the held batch and logs PSNR/SSIM to
+    eval.csv (the reference has no quality signal during training)."""
+    import dataclasses
+
+    tmp = str(tmp_path)
+    cfg = _config(srn_root, tmp, num_steps=2, resume=False)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, eval_every=2,
+                                       eval_sample_steps=2))
+    t = Trainer(config=cfg, use_grain=False)
+    t.train()
+    path = os.path.join(tmp, "results", "eval.csv")
+    assert os.path.exists(path)
+    with open(path) as fh:
+        lines = fh.read().strip().splitlines()
+    assert lines[0] == "step,psnr,ssim"
+    step, psnr_v, ssim_v = lines[1].split(",")
+    assert int(step) == 2
+    assert np.isfinite(float(psnr_v))
+    assert -1.0 <= float(ssim_v) <= 1.0
