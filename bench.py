@@ -53,6 +53,7 @@ def build(preset_name: str, overrides=()):
     cfg = get_preset(preset_name)
     if overrides:
         cfg = cfg.apply_cli(list(overrides))
+    cfg.validate()
     n_dev = len(jax.devices())
     # The 'data' axis absorbs whatever the (overridable) model/seq axes
     # don't claim; the global batch is rounded to a data-axis multiple.
@@ -199,6 +200,7 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
         **{"diffusion.sample_timesteps": sample_steps})
     if overrides:  # explicit overrides win, including sample_timesteps
         cfg = cfg.apply_cli(list(overrides))
+    cfg.validate()
     sample_steps = cfg.diffusion.sample_timesteps
     raw = make_example_batch(batch_size=1,
                              sidelength=cfg.data.img_sidelength, seed=0)

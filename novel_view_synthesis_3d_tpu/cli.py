@@ -40,7 +40,10 @@ def build_config(args, overrides: Sequence[str]) -> Config:
             cfg = cfg.apply_cli(overrides)
         except KeyError as e:
             raise SystemExit(f"config error: {e.args[0]}") from e
-    return cfg
+    try:
+        return cfg.validate()
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
 
 
 def _split_overrides(rest: List[str]) -> List[str]:

@@ -199,6 +199,76 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 
     # ------------------------------------------------------------------
+    # Validation
+    # ------------------------------------------------------------------
+    def validate(self) -> "Config":
+        """Cross-field sanity checks with actionable messages.
+
+        Catches the mistakes that otherwise surface as opaque errors deep
+        inside flax/XLA (e.g. GroupNorm's 32-group divisibility failing as
+        a reshape error three modules down). Returns self so call sites can
+        chain. Enum-valued fields (loss, objective, sampler, remat, …) are
+        checked at their point of use, where the full context lives.
+        """
+        m, d, t = self.model, self.data, self.train
+        errors = []
+        if m.ch <= 0 or not m.ch_mult:
+            errors.append("model.ch must be positive and model.ch_mult "
+                          "non-empty")
+        for level, mult in enumerate(m.ch_mult):
+            c = m.ch * mult
+            if c % 32 != 0:
+                errors.append(
+                    f"model.ch×mult = {c} is not divisible by 32 "
+                    "(GroupNorm runs with 32 groups at every level)")
+            # Heads only matter at levels where attention actually runs.
+            if (d.img_sidelength // (2 ** level) in m.attn_resolutions
+                    and c % m.attn_heads != 0):
+                errors.append(
+                    f"model.ch×mult = {c} (level {level}, attention "
+                    f"resolution {d.img_sidelength // (2 ** level)}) is "
+                    f"not divisible by attn_heads={m.attn_heads}")
+        if not 0.0 <= m.dropout < 1.0:
+            errors.append(f"model.dropout={m.dropout} outside [0, 1)")
+        if m.num_cond_frames < 1:
+            errors.append("model.num_cond_frames must be >= 1")
+        down = 2 ** (len(m.ch_mult) - 1)
+        if d.img_sidelength % down != 0:
+            errors.append(
+                f"data.img_sidelength={d.img_sidelength} is not divisible "
+                f"by 2^{len(m.ch_mult) - 1} (the UNet downsamples "
+                f"{len(m.ch_mult) - 1} times)")
+        if self.diffusion.timesteps < 1:
+            errors.append("diffusion.timesteps must be >= 1")
+        if not 1 <= self.diffusion.sample_timesteps <= self.diffusion.timesteps:
+            errors.append(
+                f"diffusion.sample_timesteps="
+                f"{self.diffusion.sample_timesteps} must be in "
+                f"[1, diffusion.timesteps={self.diffusion.timesteps}]")
+        if t.eval_every > 0 and not (
+                1 <= t.eval_sample_steps <= self.diffusion.timesteps):
+            # Only enforced when the probe is on: eval_sample_steps is inert
+            # otherwise, and a direct eval_step() call still gets a clear
+            # error from sampling_schedule/respace.
+            errors.append(
+                f"train.eval_sample_steps={t.eval_sample_steps} must be in "
+                f"[1, diffusion.timesteps={self.diffusion.timesteps}] when "
+                "train.eval_every is set")
+        if t.batch_size < 1:
+            errors.append("train.batch_size must be >= 1")
+        if not 0.0 <= t.cond_drop_prob <= 1.0:
+            errors.append(
+                f"train.cond_drop_prob={t.cond_drop_prob} outside [0, 1]")
+        for axis in ("model", "seq"):
+            if getattr(self.mesh, axis) < 1:
+                errors.append(f"mesh.{axis} must be >= 1")
+        if self.mesh.data == 0 or self.mesh.data < -1:
+            errors.append("mesh.data must be -1 (all remaining) or >= 1")
+        if errors:
+            raise ValueError("invalid config:\n  - " + "\n  - ".join(errors))
+        return self
+
+    # ------------------------------------------------------------------
     # Serialization + overrides
     # ------------------------------------------------------------------
     def to_dict(self) -> dict:

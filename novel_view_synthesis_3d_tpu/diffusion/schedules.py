@@ -227,7 +227,9 @@ def sampling_schedule(config: DiffusionConfig,
     """Schedule for sampling: respaced to `num_steps` (default
     config.sample_timesteps) unless that equals the training timestep count,
     in which case the full schedule is built directly."""
-    num_steps = num_steps or config.sample_timesteps
+    num_steps = config.sample_timesteps if num_steps is None else num_steps
+    if num_steps < 1:
+        raise ValueError(f"sample steps must be >= 1, got {num_steps}")
     if num_steps == config.timesteps:
         return make_schedule(config)
     return respace(config, num_steps)

@@ -85,7 +85,7 @@ class Trainer:
                 "data.img_sidelength": img_sidelength,
                 "train.results_folder": results_folder,
             })
-        self.config = config
+        self.config = config.validate()
         tcfg = config.train
 
         dist.initialize_distributed()
@@ -142,8 +142,11 @@ class Trainer:
         # Fixed probe batch for eval_every: scoring the SAME views every
         # time makes the PSNR/SSIM curve comparable across steps (a fresh
         # random batch per eval would swing several dB on content alone).
-        self._eval_batch = jax.tree.map(np.array, first_batch)
-        self._samplers = {}  # (sample_steps) -> jitted sampler, see _sampler
+        # Only copied when the probe is on — it pins a full batch in host
+        # RAM for the Trainer's lifetime.
+        self._eval_batch = (jax.tree.map(np.array, first_batch)
+                            if tcfg.eval_every else None)
+        self._samplers = {}  # sample_steps -> jitted sampler (_sample_cond)
         self.state = create_train_state(
             tcfg, self.model, _sample_model_batch(first_batch))
         self._state_sharding = mesh_lib.state_shardings(
@@ -295,6 +298,8 @@ class Trainer:
         training (SURVEY.md §5.5)."""
         from novel_view_synthesis_3d_tpu.eval.metrics import psnr, ssim
 
+        if self._eval_batch is None:  # direct eval_step call, eval_every=0
+            self._eval_batch = jax.tree.map(np.array, self._peek_batch())
         batch = self._eval_batch
         num = min(num, batch["target"].shape[0])
         imgs = self._sample_cond(
@@ -318,7 +323,8 @@ class Trainer:
         divisibility constraint the ring path imposes (a 4-view probe need
         not divide the mesh). Samplers are cached per sample_steps — a
         fresh make_sampler closure would recompile its scan on every call."""
-        key = sample_steps or self.config.diffusion.sample_timesteps
+        key = (self.config.diffusion.sample_timesteps
+               if sample_steps is None else sample_steps)
         sampler = self._samplers.get(key)
         if sampler is None:
             dcfg = self.config.diffusion

@@ -13,7 +13,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TINY = ["model.ch=32", "model.ch_mult=[1,2]", "model.emb_ch=32",
         "model.num_res_blocks=1", "model.attn_resolutions=[4]",
         "data.img_sidelength=16", "train.batch_size=8",
-        "diffusion.timesteps=8"]
+        "diffusion.timesteps=8", "diffusion.sample_timesteps=8"]
 
 
 def test_bench_analyze_emits_roofline_json():

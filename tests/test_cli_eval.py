@@ -205,3 +205,43 @@ def test_cli_sample_without_checkpoint_fails(cli_workspace, tmp_path):
     with pytest.raises(FileNotFoundError, match="no checkpoint"):
         main(["sample", root, "--out", str(tmp_path / "s")] + _TINY +
              [f"train.checkpoint_dir={tmp_path}/empty_ckpt"])
+
+
+def test_config_validate_catches_bad_configs():
+    from novel_view_synthesis_3d_tpu.config import Config
+
+    good = get_preset("tiny64")
+    assert good.validate() is good
+    for preset in ("reference", "base128", "paper256", "pod64"):
+        get_preset(preset).validate()
+
+    cases = {
+        "model.ch": 48,                 # 48·2=96 ÷ 32 fails at mult=1 (48)
+        "model.dropout": 1.5,
+        "model.num_cond_frames": 0,
+        "diffusion.sample_timesteps": 2000,
+        "train.batch_size": 0,
+        "train.cond_drop_prob": -0.1,
+        "mesh.model": 0,
+        "mesh.data": -3,
+    }
+    for key, bad in cases.items():
+        with pytest.raises(ValueError, match="invalid config"):
+            good.override(**{key: bad}).validate()
+    # eval_sample_steps only matters when the probe is on.
+    good.override(**{"train.eval_sample_steps": 0}).validate()
+    with pytest.raises(ValueError, match="eval_sample_steps"):
+        good.override(**{"train.eval_every": 10,
+                         "train.eval_sample_steps": 0}).validate()
+    with pytest.raises(ValueError, match="sample_timesteps"):
+        good.override(**{"diffusion.sample_timesteps": 0}).validate()
+    # Sidelength not divisible by the UNet's downsampling factor.
+    with pytest.raises(ValueError, match="img_sidelength"):
+        good.override(**{"model.ch_mult": (1, 2, 2, 4),
+                         "data.img_sidelength": 36}).validate()
+
+
+def test_cli_rejects_invalid_config_with_clear_message(capsys):
+    with pytest.raises(SystemExit) as ei:
+        main(["config", "--preset", "tiny64", "model.ch=48"])
+    assert "divisible by 32" in str(ei.value)

@@ -49,7 +49,7 @@ def test_trainer_e2e_k2(srn_root, tmp_path):
     cfg = Config(
         model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
                           attn_resolutions=(16,), num_cond_frames=2),
-        diffusion=DiffusionConfig(timesteps=10),
+        diffusion=DiffusionConfig(timesteps=10, sample_timesteps=10),
         data=DataConfig(root_dir=srn_root, img_sidelength=16,
                         loader="native", num_workers=0),
         train=TrainConfig(batch_size=8, num_steps=2, save_every=0,

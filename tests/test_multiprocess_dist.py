@@ -47,6 +47,22 @@ from novel_view_synthesis_3d_tpu.train.state import create_train_state
 from novel_view_synthesis_3d_tpu.train.step import make_train_step
 from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
 
+# Establish the CPU (Gloo) collective context with a trivial all-reduce
+# BEFORE the big train-step compile: context setup requires both workers to
+# rendezvous within ~30s, and under heavy machine load the slower worker's
+# XUNet compile can miss that window. A tiny program compiles in <1s on
+# both sides, so the rendezvous happens while the workers are still in
+# lock-step; the context is cached for every later collective.
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+import numpy as np  # noqa: E402
+
+_warm_mesh = jax.sharding.Mesh(np.asarray(jax.devices()).reshape(8), ("d",))
+_warm = jax.make_array_from_process_local_data(
+    NamedSharding(_warm_mesh, P("d")), np.ones((4,), np.float32), (8,))
+_total = float(jax.device_get(jax.jit(
+    lambda x: x.sum(), out_shardings=NamedSharding(_warm_mesh, P()))(_warm)))
+assert _total == 8.0, _total
+
 cfg = Config(
     model=ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
                       attn_resolutions=(8,), dropout=0.0),

@@ -135,7 +135,7 @@ def test_trainer_uses_native_loader(srn_root, tmp_path):
     cfg = Config(
         model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
                           attn_resolutions=()),
-        diffusion=DiffusionConfig(timesteps=10),
+        diffusion=DiffusionConfig(timesteps=10, sample_timesteps=10),
         data=DataConfig(root_dir=srn_root, img_sidelength=16,
                         loader="native", num_workers=2, prefetch=2),
         train=TrainConfig(batch_size=8, num_steps=2, save_every=0,

@@ -21,7 +21,7 @@ def _cfg(tmp_path, num_steps):
     return Config(
         model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
                           attn_resolutions=()),
-        diffusion=DiffusionConfig(timesteps=10),
+        diffusion=DiffusionConfig(timesteps=10, sample_timesteps=10),
         train=TrainConfig(batch_size=8, num_steps=num_steps, save_every=100,
                           log_every=100,
                           checkpoint_dir=str(tmp_path / "ckpt"),

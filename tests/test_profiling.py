@@ -72,7 +72,7 @@ def test_trainer_profile_window(tmp_path):
     cfg = Config(
         model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
                           attn_resolutions=()),
-        diffusion=DiffusionConfig(timesteps=10),
+        diffusion=DiffusionConfig(timesteps=10, sample_timesteps=10),
         train=TrainConfig(batch_size=8, num_steps=4, save_every=0,
                           log_every=10, profile_from=1, profile_steps=2,
                           checkpoint_dir=str(tmp_path / "ckpt"),
