@@ -26,6 +26,12 @@
 extern "C" {
 #endif
 
+/* ABI version of this library. The ctypes binding refuses to drive a
+ * mismatched (stale) .so — bump whenever a signature or buffer layout
+ * changes. */
+#define NVS3D_ABI_VERSION 2
+int nvs3d_abi_version(void);
+
 /* Most recent error message for the calling thread ("" if none). */
 const char *nvs3d_last_error(void);
 
@@ -60,20 +66,24 @@ int nvs3d_parse_intrinsics(const char *path, int sidelength,
 /* ------------------------------------------------------------------ */
 /* Creates a loader over n_records observations. rgb_paths[i]/pose_paths[i]
  * describe observation i; instance_ids[i] (non-decreasing) groups
- * observations into object instances. Each produced record pairs the
- * conditioning view i with a uniformly random target view of the SAME
- * instance (reference dataset/data_loader.py:85-90). Worker threads decode
- * and fill whole batches into a bounded prefetch queue. Returns NULL on
- * failure. */
+ * observations into object instances. Each produced record pairs num_cond
+ * conditioning views — the indexed view i first, the rest drawn uniformly
+ * from the SAME instance — with a uniformly random target view of that
+ * instance (reference dataset/data_loader.py:85-90 at num_cond=1; 3DiM k>1
+ * conditioning otherwise, matching data/srn.py SRNDataset.pair). Worker
+ * threads decode and fill whole batches into a bounded prefetch queue.
+ * Returns NULL on failure. */
 void *nvs3d_loader_create(const char **rgb_paths, const char **pose_paths,
                           const int32_t *instance_ids, int n_records,
-                          int sidelength, int batch_size, int n_threads,
-                          int prefetch_depth, uint64_t seed,
+                          int sidelength, int batch_size, int num_cond,
+                          int n_threads, int prefetch_depth, uint64_t seed,
                           int shard_index, int shard_count);
 
 /* Blocks until the next batch is ready, then copies it out.
- * x, target: batch*S*S*3 floats.  pose1, pose2: batch*16 floats (4x4).
- * record_idx: batch int32 flat record indices (conditioning views). */
+ * x: batch*num_cond*S*S*3 floats (conditioning frames, indexed view first).
+ * target: batch*S*S*3 floats.  pose1: batch*num_cond*16 floats (4x4).
+ * pose2: batch*16 floats.
+ * record_idx: batch int32 flat record indices (first conditioning views). */
 int nvs3d_loader_next(void *loader, float *x, float *target,
                       float *pose1, float *pose2, int32_t *record_idx);
 

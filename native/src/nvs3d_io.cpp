@@ -350,6 +350,8 @@ int parse_pose_impl(const char *path, float *out16, std::string &err) {
 
 extern "C" {
 
+int nvs3d_abi_version(void) { return NVS3D_ABI_VERSION; }
+
 const char *nvs3d_last_error(void) { return g_error.c_str(); }
 
 int nvs3d_decode_png_rgb(const char *path, int *w, int *h, uint8_t *out,
@@ -473,7 +475,7 @@ struct Loader {
   std::vector<std::string> rgb_paths, pose_paths;
   std::vector<int32_t> instance_of;            // record -> instance
   std::vector<std::vector<int32_t>> members;   // instance -> records
-  int sidelength, batch_size, prefetch_depth;
+  int sidelength, batch_size, num_cond, prefetch_depth;
   int shard_index, shard_count;
   uint64_t seed;
 
@@ -556,32 +558,44 @@ struct Loader {
       }
       auto b = std::make_unique<Batch>();
       b->serial = serial;
-      b->x.resize(img * batch_size);
+      const size_t k = size_t(num_cond);
+      b->x.resize(img * batch_size * k);
       b->target.resize(img * batch_size);
-      b->pose1.resize(16 * size_t(batch_size));
+      b->pose1.resize(16 * size_t(batch_size) * k);
       b->pose2.resize(16 * size_t(batch_size));
       b->record_idx.assign(records.begin(), records.end());
       std::mt19937_64 rng(seed ^ (tag * 0xda942042e4dd58b5ULL));
       std::string err;
-      for (int i = 0; i < batch_size; ++i) {
+      bool failed = false;
+      for (int i = 0; i < batch_size && !failed; ++i) {
         int32_t rec = records[i];
         const auto &sibs = members[size_t(instance_of[size_t(rec)])];
         std::uniform_int_distribution<size_t> pick(0, sibs.size() - 1);
+        // Target first, then extra conditioning views — the draw order of
+        // SRNDataset.pair (data/srn.py), keeping stream semantics aligned.
         int32_t rec2 = sibs[pick(rng)];
-        if (load_rgb_impl(rgb_paths[size_t(rec)].c_str(), sidelength,
-                          b->x.data() + img * i, err) ||
+        std::vector<int32_t> cond(1, rec);
+        for (size_t c = 1; c < k; ++c) cond.push_back(sibs[pick(rng)]);
+        failed =
             load_rgb_impl(rgb_paths[size_t(rec2)].c_str(), sidelength,
                           b->target.data() + img * i, err) ||
-            parse_pose_impl(pose_paths[size_t(rec)].c_str(),
-                            b->pose1.data() + 16 * i, err) ||
             parse_pose_impl(pose_paths[size_t(rec2)].c_str(),
-                            b->pose2.data() + 16 * i, err)) {
-          std::lock_guard<std::mutex> lk(mu);
-          error = err;
-          stop = true;
-          cv_get.notify_all();
-          return;
+                            b->pose2.data() + 16 * i, err);
+        for (size_t c = 0; c < k && !failed; ++c) {
+          failed =
+              load_rgb_impl(rgb_paths[size_t(cond[c])].c_str(), sidelength,
+                            b->x.data() + img * (size_t(i) * k + c), err) ||
+              parse_pose_impl(pose_paths[size_t(cond[c])].c_str(),
+                              b->pose1.data() + 16 * (size_t(i) * k + c),
+                              err);
         }
+      }
+      if (failed) {
+        std::lock_guard<std::mutex> lk(mu);
+        error = err;
+        stop = true;
+        cv_get.notify_all();
+        return;
       }
       {
         std::lock_guard<std::mutex> lk(mu);
@@ -599,16 +613,18 @@ struct Loader {
 
 void *nvs3d_loader_create(const char **rgb_paths, const char **pose_paths,
                           const int32_t *instance_ids, int n_records,
-                          int sidelength, int batch_size, int n_threads,
-                          int prefetch_depth, uint64_t seed, int shard_index,
-                          int shard_count) {
-  if (n_records <= 0 || batch_size <= 0 || sidelength <= 0) {
+                          int sidelength, int batch_size, int num_cond,
+                          int n_threads, int prefetch_depth, uint64_t seed,
+                          int shard_index, int shard_count) {
+  if (n_records <= 0 || batch_size <= 0 || sidelength <= 0 ||
+      num_cond <= 0) {
     g_error = "invalid loader arguments";
     return nullptr;
   }
   auto L = std::make_unique<Loader>();
   L->sidelength = sidelength;
   L->batch_size = batch_size;
+  L->num_cond = num_cond;
   L->prefetch_depth = std::max(1, prefetch_depth);
   L->seed = seed;
   L->shard_index = std::max(0, shard_index);

@@ -29,14 +29,21 @@ _lib = None
 _lib_lock = threading.Lock()
 _load_failed = False
 
+# Must match NVS3D_ABI_VERSION in native/include/nvs3d_io.h: the binding
+# refuses to drive a stale .so whose signatures may have changed.
+_ABI_VERSION = 2
+
 
 def _build() -> bool:
     try:
+        # Always invoke make: it is an mtime-based no-op when the library is
+        # current, and it REBUILDS a stale .so left over from older sources
+        # (an .so-exists check alone would load mismatched signatures).
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                        capture_output=True, timeout=120)
         return os.path.exists(_LIB_PATH)
     except Exception:
-        return False
+        return os.path.exists(_LIB_PATH)
 
 
 def _load():
@@ -44,12 +51,22 @@ def _load():
     with _lib_lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build():
+        if not _build():
             _load_failed = True
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
+            _load_failed = True
+            return None
+        try:
+            lib.nvs3d_abi_version.restype = ctypes.c_int
+            abi = int(lib.nvs3d_abi_version())
+        except AttributeError:
+            abi = -1  # pre-versioning build
+        if abi != _ABI_VERSION:
+            # A stale library is already mapped into this process; dlopen
+            # would keep returning it. Fall back to the Python/grain path.
             _load_failed = True
             return None
         c_char_pp = ctypes.POINTER(ctypes.c_char_p)
@@ -71,8 +88,8 @@ def _load():
         lib.nvs3d_loader_create.restype = ctypes.c_void_p
         lib.nvs3d_loader_create.argtypes = [
             c_char_pp, c_char_pp, i32_p, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
-            ctypes.c_int, ctypes.c_int]
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
         lib.nvs3d_loader_next.argtypes = [
             ctypes.c_void_p, f32_p, f32_p, f32_p, f32_p, i32_p]
         lib.nvs3d_loader_destroy.argtypes = [ctypes.c_void_p]
@@ -159,7 +176,8 @@ class NativePairLoader:
 
     def __init__(self, rgb_paths: Sequence[str], pose_paths: Sequence[str],
                  instance_ids: Sequence[int], Ks: np.ndarray, *,
-                 sidelength: int, batch_size: int, n_threads: int = 8,
+                 sidelength: int, batch_size: int, num_cond: int = 1,
+                 n_threads: int = 8,
                  prefetch_depth: int = 4, seed: int = 0,
                  shard_index: int = 0, shard_count: int = 1):
         lib = _load()
@@ -169,6 +187,7 @@ class NativePairLoader:
         self._lib = lib
         self._B = batch_size
         self._S = sidelength
+        self._K_frames = num_cond
         # Keep path arrays alive for the loader's lifetime (the C++ side
         # copies at create time, but be conservative about GC ordering).
         self._rgb_arr = _paths_array(rgb_paths)
@@ -180,7 +199,7 @@ class NativePairLoader:
         self._handle = lib.nvs3d_loader_create(
             self._rgb_arr, self._pose_arr,
             inst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            len(rgb_paths), sidelength, batch_size, n_threads,
+            len(rgb_paths), sidelength, batch_size, num_cond, n_threads,
             prefetch_depth, seed, shard_index, shard_count)
         if not self._handle:
             raise RuntimeError(f"nvs3d_loader_create: {_err(lib)}")
@@ -189,10 +208,10 @@ class NativePairLoader:
         return self
 
     def __next__(self) -> dict:
-        B, S = self._B, self._S
-        x = np.empty((B, S, S, 3), dtype=np.float32)
+        B, S, F = self._B, self._S, self._K_frames
+        x = np.empty((B, F, S, S, 3), dtype=np.float32)
         target = np.empty((B, S, S, 3), dtype=np.float32)
-        pose1 = np.empty((B, 4, 4), dtype=np.float32)
+        pose1 = np.empty((B, F, 4, 4), dtype=np.float32)
         pose2 = np.empty((B, 4, 4), dtype=np.float32)
         idx = np.empty((B,), dtype=np.int32)
         rc = self._lib.nvs3d_loader_next(
@@ -200,11 +219,16 @@ class NativePairLoader:
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
         if rc:
             raise RuntimeError(f"nvs3d_loader_next: {_err(self._lib)}")
+        if F == 1:  # same per-record contract as SRNDataset.pair(num_cond=1)
+            x, pose1 = x[:, 0], pose1[:, 0]
+            R1, t1 = pose1[:, :3, :3], pose1[:, :3, 3]
+        else:
+            R1, t1 = pose1[:, :, :3, :3], pose1[:, :, :3, 3]
         return {
             "x": x,
             "target": target,
-            "R1": pose1[:, :3, :3].copy(),
-            "t1": pose1[:, :3, 3].copy(),
+            "R1": R1.copy(),
+            "t1": t1.copy(),
             "R2": pose2[:, :3, :3].copy(),
             "t2": pose2[:, :3, 3].copy(),
             "K": self._Ks[idx],
@@ -222,7 +246,8 @@ class NativePairLoader:
             pass
 
 
-def make_native_loader(dataset, batch_size: int, *, n_threads: int = 8,
+def make_native_loader(dataset, batch_size: int, *, num_cond: int = 1,
+                       n_threads: int = 8,
                        prefetch_depth: int = 4, seed: int = 0,
                        shard_index: int = 0,
                        shard_count: int = 1) -> NativePairLoader:
@@ -239,6 +264,6 @@ def make_native_loader(dataset, batch_size: int, *, n_threads: int = 8,
             Ks.append(instance.K)
     return NativePairLoader(
         rgb, pose, inst, np.stack(Ks), sidelength=dataset.img_sidelength,
-        batch_size=batch_size, n_threads=n_threads,
+        batch_size=batch_size, num_cond=num_cond, n_threads=n_threads,
         prefetch_depth=prefetch_depth, seed=seed,
         shard_index=shard_index, shard_count=shard_count)

@@ -103,13 +103,11 @@ class Trainer:
             local_bs = dist.local_batch_size(tcfg.batch_size)
             num_cond = config.model.num_cond_frames
             backend = config.data.loader if use_grain else "python"
-            if backend == "native" and num_cond > 1:
-                backend = "grain"  # native loader is k=1; grain handles k>1
             if backend == "native":
                 from novel_view_synthesis_3d_tpu.data import native_io
                 if native_io.available():
                     self._native_loader = native_io.make_native_loader(
-                        self.dataset, local_bs,
+                        self.dataset, local_bs, num_cond=num_cond,
                         n_threads=config.data.num_workers,
                         prefetch_depth=config.data.prefetch,
                         seed=config.data.shuffle_seed,
