@@ -71,7 +71,10 @@ class DiffusionConfig:
     """Diffusion process (reference: sampling.py:16-53,73-76, T=1000 cosine)."""
 
     timesteps: int = 1000
-    schedule: str = "cosine"  # only cosine exists in the reference
+    # 'cosine' (the reference's only schedule) or 'linear' (Ho et al. 2020
+    # 1e-4→0.02 ladder, endpoints scaled by 1000/T). Non-cosine schedules
+    # condition the model on the exact per-timestep log(ᾱ/(1−ᾱ)).
+    schedule: str = "cosine"
     cosine_s: float = 0.008
     logsnr_min: float = -20.0
     logsnr_max: float = 20.0

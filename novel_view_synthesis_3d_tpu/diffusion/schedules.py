@@ -45,6 +45,21 @@ def cosine_beta_schedule(timesteps: int, s: float = 0.008) -> np.ndarray:
     return np.clip(betas, 0.0, 0.9999)
 
 
+def linear_beta_schedule(timesteps: int) -> np.ndarray:
+    """Linear β schedule (Ho et al. 2020), float64.
+
+    The DDPM paper's 1e-4 → 0.02 ladder is defined at T=1000; other T scale
+    the endpoints by 1000/T so the continuous-time diffusion is preserved.
+    The reference has no linear option (cosine only, data_loader.py:15-25);
+    this is a framework extension.
+    """
+    scale = 1000.0 / timesteps
+    # Clip like cosine_beta_schedule: for very small T the scaled endpoint
+    # exceeds 1 and unclipped betas would turn the tables NaN/inf.
+    return np.clip(np.linspace(scale * 1e-4, scale * 0.02, timesteps,
+                               dtype=np.float64), 0.0, 0.9999)
+
+
 def logsnr_schedule_cosine(t, *, logsnr_min: float = -20.0, logsnr_max: float = 20.0):
     """logsnr(t) for continuous t ∈ [0, 1].
 
@@ -83,6 +98,11 @@ class DiffusionSchedule:
     # logsnr must always be evaluated at ORIGINAL t/T.
     timestep_map: jnp.ndarray = None
     num_original_timesteps: int = flax.struct.field(pytree_node=False, default=1000)
+    # Non-cosine schedules condition on the EXACT per-timestep
+    # log(ᾱ/(1−ᾱ)) of the original (un-respaced) table instead of the
+    # closed-form cosine logsnr (which would misdescribe the actual noise
+    # level). None → use the cosine formula (reference behavior).
+    logsnr_table: Optional[jnp.ndarray] = None
 
     @property
     def num_timesteps(self) -> int:
@@ -171,6 +191,8 @@ class DiffusionSchedule:
         (data_loader.py:110) and sampling (sampling.py:151).
         """
         t_orig = jnp.take(self.timestep_map, t, axis=0)
+        if self.logsnr_table is not None:
+            return jnp.take(self.logsnr_table, t_orig, axis=0)
         u = t_orig.astype(jnp.float32) / float(self.num_original_timesteps)
         return logsnr_schedule_cosine(
             u, logsnr_min=self.logsnr_min, logsnr_max=self.logsnr_max
@@ -208,17 +230,37 @@ def _tables_from_betas(betas: np.ndarray) -> dict:
     )
 
 
+def _betas_for(config: DiffusionConfig) -> np.ndarray:
+    if config.schedule == "cosine":
+        return cosine_beta_schedule(config.timesteps, s=config.cosine_s)
+    if config.schedule == "linear":
+        return linear_beta_schedule(config.timesteps)
+    raise ValueError(f"unknown schedule {config.schedule!r}")
+
+
+def _exact_logsnr_table(config: DiffusionConfig,
+                        acp: np.ndarray) -> Optional[jnp.ndarray]:
+    """Per-timestep log(ᾱ/(1−ᾱ)) for non-cosine schedules (clipped to the
+    configured logsnr range, matching the cosine path's ±20 clip). `acp` is
+    the float64 alphas_cumprod of the ORIGINAL (un-respaced) schedule."""
+    if config.schedule == "cosine":
+        return None  # closed-form cosine logsnr — reference behavior
+    table = np.clip(np.log(acp / (1.0 - acp)),
+                    config.logsnr_min, config.logsnr_max)
+    return jnp.asarray(table, dtype=jnp.float32)
+
+
 def make_schedule(config: DiffusionConfig) -> DiffusionSchedule:
-    if config.schedule != "cosine":
-        raise ValueError(f"unknown schedule {config.schedule!r}")
-    betas = cosine_beta_schedule(config.timesteps, s=config.cosine_s)
-    tables = {k: jnp.asarray(v, dtype=jnp.float32) for k, v in _tables_from_betas(betas).items()}
+    betas = _betas_for(config)
+    f64 = _tables_from_betas(betas)
+    tables = {k: jnp.asarray(v, dtype=jnp.float32) for k, v in f64.items()}
     return DiffusionSchedule(
         **tables,
         logsnr_min=config.logsnr_min,
         logsnr_max=config.logsnr_max,
         timestep_map=jnp.arange(config.timesteps, dtype=jnp.int32),
         num_original_timesteps=config.timesteps,
+        logsnr_table=_exact_logsnr_table(config, f64["alphas_cumprod"]),
     )
 
 
@@ -247,7 +289,7 @@ def respace(schedule_config: DiffusionConfig, num_steps: int) -> DiffusionSchedu
     T = schedule_config.timesteps
     if num_steps > T:
         raise ValueError(f"cannot respace {T} steps to {num_steps}")
-    betas = cosine_beta_schedule(T, s=schedule_config.cosine_s)
+    betas = _betas_for(schedule_config)
     acp = np.cumprod(1.0 - betas, axis=0)
     use = np.linspace(0, T - 1, num_steps).round().astype(np.int64)
     use = np.unique(use)
@@ -264,4 +306,5 @@ def respace(schedule_config: DiffusionConfig, num_steps: int) -> DiffusionSchedu
         logsnr_max=schedule_config.logsnr_max,
         timestep_map=jnp.asarray(use, dtype=jnp.int32),
         num_original_timesteps=T,
+        logsnr_table=_exact_logsnr_table(schedule_config, acp),
     )

@@ -176,3 +176,72 @@ def test_v_parameterization_identities():
     x0_hat = sched.predict_start_from_v(z, t, v)
     np.testing.assert_allclose(np.asarray(x0_hat), np.asarray(x0),
                                atol=2e-3, rtol=2e-3)
+
+
+def test_linear_schedule_tables():
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        linear_beta_schedule)
+
+    betas = linear_beta_schedule(1000)
+    assert betas.shape == (1000,)
+    assert np.isclose(betas[0], 1e-4) and np.isclose(betas[-1], 0.02)
+    # T-scaling preserves the continuous diffusion: endpoints scale 1000/T.
+    betas100 = linear_beta_schedule(100)
+    assert np.isclose(betas100[0], 1e-3) and np.isclose(betas100[-1], 0.2)
+
+
+def test_linear_schedule_logsnr_is_exact():
+    cfg = DiffusionConfig(timesteps=100, sample_timesteps=100,
+                          schedule="linear")
+    sched = make_schedule(cfg)
+    acp = np.asarray(sched.alphas_cumprod, np.float64)
+    t = jnp.arange(100)
+    expected = np.clip(np.log(acp / (1 - acp)), -20.0, 20.0)
+    np.testing.assert_allclose(np.asarray(sched.logsnr(t)), expected,
+                               rtol=2e-4, atol=2e-4)
+    # Monotone decreasing in t (noise grows).
+    assert np.all(np.diff(np.asarray(sched.logsnr(t))) < 0)
+
+
+def test_linear_schedule_respace_matches_acp():
+    cfg = DiffusionConfig(timesteps=100, sample_timesteps=100,
+                          schedule="linear")
+    full = make_schedule(cfg)
+    sub = respace(cfg, 10)
+    kept = np.asarray(sub.timestep_map)
+    np.testing.assert_allclose(np.asarray(sub.alphas_cumprod),
+                               np.asarray(full.alphas_cumprod)[kept],
+                               rtol=1e-5)
+    # logsnr at respaced index i equals the full table at the kept timestep.
+    np.testing.assert_allclose(
+        np.asarray(sub.logsnr(jnp.arange(len(kept)))),
+        np.asarray(full.logsnr(jnp.asarray(kept))), rtol=1e-6)
+
+
+def test_unknown_schedule_rejected():
+    with pytest.raises(ValueError, match="schedule"):
+        make_schedule(DiffusionConfig(schedule="quadratic"))
+
+
+def test_cosine_logsnr_unchanged_by_table_feature():
+    # Cosine schedules keep the closed-form logsnr (reference parity).
+    cfg = DiffusionConfig(timesteps=50, sample_timesteps=50)
+    sched = make_schedule(cfg)
+    assert sched.logsnr_table is None
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        logsnr_schedule_cosine)
+    t = jnp.arange(50)
+    np.testing.assert_allclose(
+        np.asarray(sched.logsnr(t)),
+        logsnr_schedule_cosine(np.arange(50) / 50.0), rtol=1e-5,
+        atol=1e-4)  # atol for the zero crossing near u=0.5 (f32 vs f64)
+
+
+def test_linear_schedule_small_T_finite():
+    """T ≤ 20 scales the linear endpoint past 1; clipping keeps every table
+    finite (unclipped betas would NaN the posterior coefficients)."""
+    for T in (8, 16, 20):
+        sched = make_schedule(DiffusionConfig(timesteps=T, sample_timesteps=T,
+                                              schedule="linear"))
+        for leaf in jax.tree.leaves(sched):
+            assert np.isfinite(np.asarray(leaf)).all(), (T, leaf)
