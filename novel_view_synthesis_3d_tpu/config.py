@@ -71,10 +71,15 @@ class DiffusionConfig:
     """Diffusion process (reference: sampling.py:16-53,73-76, T=1000 cosine)."""
 
     timesteps: int = 1000
-    # 'cosine' (the reference's only schedule) or 'linear' (Ho et al. 2020
-    # 1e-4→0.02 ladder, endpoints scaled by 1000/T). Non-cosine schedules
-    # condition the model on the exact per-timestep log(ᾱ/(1−ᾱ)).
+    # 'cosine' (the reference's only schedule), 'linear' (Ho et al. 2020
+    # 1e-4→0.02 ladder, endpoints scaled by 1000/T), or 'shifted_cosine'
+    # (Hoogeboom et al. 2023 "simple diffusion": cosine logsnr shifted by
+    # `logsnr_shift` — at resolution S set it to 2·log(64/S), e.g. −2.77 at
+    # 256px, so high-res training sees as much signal destruction as 64px).
+    # Non-cosine schedules condition the model on the exact per-timestep
+    # log(ᾱ/(1−ᾱ)).
     schedule: str = "cosine"
+    logsnr_shift: float = 0.0  # shifted_cosine only
     cosine_s: float = 0.008
     logsnr_min: float = -20.0
     logsnr_max: float = 20.0

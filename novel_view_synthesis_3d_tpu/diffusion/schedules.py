@@ -60,6 +60,24 @@ def linear_beta_schedule(timesteps: int) -> np.ndarray:
                                dtype=np.float64), 0.0, 0.9999)
 
 
+def shifted_cosine_beta_schedule(timesteps: int, shift: float, *,
+                                 logsnr_min: float = -20.0,
+                                 logsnr_max: float = 20.0) -> np.ndarray:
+    """β table whose ᾱ follows the SHIFTED cosine logsnr (float64).
+
+    ᾱ_t = σ(logsnr_cosine((t+1)/T) + shift): the discrete table realizes
+    exactly the shifted noise level the model is conditioned on (simple
+    diffusion, arXiv 2301.11093 §2.3 — shift 2·log(64/S) for resolution S).
+    shift=0 reproduces a sigmoid-parameterized cosine schedule.
+    """
+    u = np.arange(1, timesteps + 1, dtype=np.float64) / timesteps
+    logsnr = logsnr_schedule_cosine(u, logsnr_min=logsnr_min,
+                                    logsnr_max=logsnr_max) + shift
+    acp = 1.0 / (1.0 + np.exp(-logsnr))  # sigmoid
+    acp_prev = np.concatenate([[1.0], acp[:-1]])
+    return np.clip(1.0 - acp / acp_prev, 0.0, 0.9999)
+
+
 def logsnr_schedule_cosine(t, *, logsnr_min: float = -20.0, logsnr_max: float = 20.0):
     """logsnr(t) for continuous t ∈ [0, 1].
 
@@ -231,10 +249,21 @@ def _tables_from_betas(betas: np.ndarray) -> dict:
 
 
 def _betas_for(config: DiffusionConfig) -> np.ndarray:
+    if config.logsnr_shift != 0.0 and config.schedule != "shifted_cosine":
+        # Dropping the shift silently would train at the wrong noise level —
+        # the exact misconfig the shift exists to fix at high resolution.
+        raise ValueError(
+            f"diffusion.logsnr_shift={config.logsnr_shift} has no effect "
+            f"with schedule={config.schedule!r}; use "
+            "schedule='shifted_cosine'")
     if config.schedule == "cosine":
         return cosine_beta_schedule(config.timesteps, s=config.cosine_s)
     if config.schedule == "linear":
         return linear_beta_schedule(config.timesteps)
+    if config.schedule == "shifted_cosine":
+        return shifted_cosine_beta_schedule(
+            config.timesteps, config.logsnr_shift,
+            logsnr_min=config.logsnr_min, logsnr_max=config.logsnr_max)
     raise ValueError(f"unknown schedule {config.schedule!r}")
 
 

@@ -245,3 +245,45 @@ def test_linear_schedule_small_T_finite():
                                               schedule="linear"))
         for leaf in jax.tree.leaves(sched):
             assert np.isfinite(np.asarray(leaf)).all(), (T, leaf)
+
+
+def test_shifted_cosine_schedule():
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        logsnr_schedule_cosine)
+
+    T = 100
+    base = make_schedule(DiffusionConfig(timesteps=T, sample_timesteps=T,
+                                         schedule="shifted_cosine",
+                                         logsnr_shift=0.0))
+    shifted = make_schedule(DiffusionConfig(timesteps=T, sample_timesteps=T,
+                                            schedule="shifted_cosine",
+                                            logsnr_shift=-2.77))
+    # shift=0: acp = sigmoid(cosine logsnr at (t+1)/T).
+    u = (np.arange(T) + 1) / T
+    expected = 1.0 / (1.0 + np.exp(-logsnr_schedule_cosine(u)))
+    np.testing.assert_allclose(np.asarray(base.alphas_cumprod), expected,
+                               rtol=1e-4, atol=1e-6)
+    # Negative shift destroys MORE signal at every timestep (256px rule).
+    assert np.all(np.asarray(shifted.alphas_cumprod)
+                  < np.asarray(base.alphas_cumprod) + 1e-9)
+    # The conditioning signal is the exact shifted logsnr.
+    t = jnp.arange(T)
+    np.testing.assert_allclose(
+        np.asarray(shifted.logsnr(t)),
+        np.clip(logsnr_schedule_cosine(u) - 2.77, -20, 20),
+        rtol=1e-3, atol=1e-3)
+    # Finite tables throughout, and respacing works.
+    for leaf in jax.tree.leaves(shifted):
+        assert np.isfinite(np.asarray(leaf)).all()
+    sub = respace(DiffusionConfig(timesteps=T, sample_timesteps=T,
+                                  schedule="shifted_cosine",
+                                  logsnr_shift=-2.77), 10)
+    kept = np.asarray(sub.timestep_map)
+    np.testing.assert_allclose(np.asarray(sub.alphas_cumprod),
+                               np.asarray(shifted.alphas_cumprod)[kept],
+                               rtol=1e-5)
+
+
+def test_logsnr_shift_requires_shifted_cosine():
+    with pytest.raises(ValueError, match="logsnr_shift"):
+        make_schedule(DiffusionConfig(logsnr_shift=-2.77))
