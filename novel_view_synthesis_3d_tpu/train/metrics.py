@@ -16,15 +16,23 @@ import jax
 
 
 class MetricsLogger:
+    HEADER = ["step", "loss", "grad_norm", "lr", "steps_per_sec",
+              "imgs_per_sec_per_chip"]
+
     def __init__(self, results_folder: str, use_tensorboard: bool = False):
         os.makedirs(results_folder, exist_ok=True)
         self.csv_path = os.path.join(results_folder, "metrics.csv")
+        # Resumed run with a DIFFERENT schema (older build): rotate the old
+        # file aside rather than appending misaligned rows under its header.
+        if os.path.exists(self.csv_path) and os.path.getsize(self.csv_path):
+            with open(self.csv_path) as fh:
+                old_header = fh.readline().strip().split(",")
+            if old_header != self.HEADER:
+                os.replace(self.csv_path, self.csv_path + ".old")
         self._csv_file = open(self.csv_path, "a", newline="")
         self._csv = csv.writer(self._csv_file)
         if self._csv_file.tell() == 0:
-            self._csv.writerow([
-                "step", "loss", "grad_norm", "steps_per_sec",
-                "imgs_per_sec_per_chip"])
+            self._csv.writerow(self.HEADER)
         self._tb = None
         if use_tensorboard:
             try:
@@ -49,7 +57,9 @@ class MetricsLogger:
 
         loss = float(metrics.get("loss", float("nan")))
         gnorm = float(metrics.get("grad_norm", float("nan")))
-        self._csv.writerow([step, loss, gnorm, f"{steps_per_sec:.3f}",
+        lr = float(metrics.get("lr", float("nan")))
+        self._csv.writerow([step, loss, gnorm, f"{lr:.3e}",
+                            f"{steps_per_sec:.3f}",
                             f"{imgs_per_sec_per_chip:.3f}"])
         self._csv_file.flush()
         if self._tb is not None:
@@ -58,6 +68,7 @@ class MetricsLogger:
             with self._tb.as_default():
                 tf.summary.scalar("loss", loss, step=step)
                 tf.summary.scalar("grad_norm", gnorm, step=step)
+                tf.summary.scalar("lr", lr, step=step)
                 tf.summary.scalar("imgs_per_sec_per_chip",
                                   imgs_per_sec_per_chip, step=step)
         return {

@@ -50,7 +50,10 @@ def make_lr_schedule(cfg: TrainConfig):
     raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
 
 
-def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+def make_optimizer(cfg: TrainConfig, return_schedule: bool = False):
+    """Optimizer chain per config; with return_schedule=True also returns
+    the EXACT lr schedule handed to optax, so callers logging lr can never
+    drift from what the optimizer applies."""
     if cfg.optimizer != "adam":
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     schedule = make_lr_schedule(cfg)
@@ -58,7 +61,8 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     if cfg.grad_clip > 0:
         parts.append(optax.clip_by_global_norm(cfg.grad_clip))
     parts.append(optax.adam(schedule))
-    return optax.chain(*parts)
+    tx = optax.chain(*parts)
+    return (tx, schedule) if return_schedule else tx
 
 
 def create_train_state(cfg: TrainConfig, model, sample_batch: dict,

@@ -122,7 +122,7 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
             f"unknown loss_weighting {tcfg.loss_weighting!r}")
     if tcfg.loss_weighting != "none" and tcfg.loss != "mse":
         raise ValueError("loss_weighting requires loss='mse'")
-    tx = make_optimizer(tcfg)
+    tx, lr_schedule = make_optimizer(tcfg, return_schedule=True)
 
     def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
@@ -224,9 +224,11 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
             rng=state.rng,
             ema_params=ema_params,
         )
+        lr = lr_schedule(state.step) if callable(lr_schedule) else lr_schedule
         metrics = {
             "loss": loss,
             "grad_norm": optax.global_norm(grads),
+            "lr": jnp.asarray(lr, jnp.float32),
         }
         return new_state, metrics
 

@@ -436,3 +436,21 @@ def test_min_snr_requires_mse():
     with pytest.raises(ValueError, match="loss_weighting"):
         make_train_step(cfg, XUNet(cfg.model),
                         make_schedule(cfg.diffusion), mesh)
+
+
+def test_metrics_include_lr():
+    from novel_view_synthesis_3d_tpu.train.state import make_lr_schedule
+
+    batch = make_example_batch(batch_size=8, sidelength=16)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=1, model=1, seq=1),
+                              devices=jax.devices()[:1])
+    cfg = TINY_CFG.override(**{"train.lr_schedule": "cosine",
+                               "train.warmup_steps": 2,
+                               "train.num_steps": 10})
+    state, step, _ = _setup(cfg, mesh, batch)
+    db = mesh_lib.shard_batch(mesh, batch)
+    sched = make_lr_schedule(cfg.train)
+    for i in range(3):
+        state, m = step(state, db)
+        np.testing.assert_allclose(float(jax.device_get(m["lr"])),
+                                   float(sched(i)), rtol=1e-6)

@@ -130,3 +130,31 @@ def test_in_loop_eval(srn_root, tmp_path):
     assert int(step) == 2
     assert np.isfinite(float(psnr_v))
     assert -1.0 <= float(ssim_v) <= 1.0
+
+
+def test_metrics_csv_schema_rotation(tmp_path):
+    """A metrics.csv from an older build (different header) is rotated to
+    .old instead of receiving misaligned appended rows."""
+    from novel_view_synthesis_3d_tpu.train.metrics import MetricsLogger
+
+    folder = str(tmp_path)
+    old = os.path.join(folder, "metrics.csv")
+    with open(old, "w") as fh:
+        fh.write("step,loss,grad_norm,steps_per_sec,imgs_per_sec_per_chip\n")
+        fh.write("1,0.5,1.0,2.0,16.0\n")
+    logger = MetricsLogger(folder)
+    logger.log(2, {"loss": 0.4, "grad_norm": 0.9, "lr": 1e-4}, batch_size=8)
+    logger.close()
+    with open(old) as fh:
+        lines = fh.read().strip().splitlines()
+    assert lines[0] == ",".join(MetricsLogger.HEADER)
+    assert lines[1].startswith("2,")
+    with open(old + ".old") as fh:
+        assert fh.readline().startswith("step,loss,grad_norm,steps_per_sec")
+    # Same-schema file appends in place (normal resume).
+    logger2 = MetricsLogger(folder)
+    logger2.log(3, {"loss": 0.3, "grad_norm": 0.8, "lr": 1e-4}, batch_size=8)
+    logger2.close()
+    with open(old) as fh:
+        lines = fh.read().strip().splitlines()
+    assert len(lines) == 3 and lines[2].startswith("3,")
