@@ -90,6 +90,11 @@ class DiffusionConfig:
     # Sampling
     sample_timesteps: int = 1000  # respaced steps for the ancestral sampler
     guidance_weight: float = 3.0  # CFG w (reference sampling.py:134)
+    # CFG rescale φ (Lin et al. 2023, arXiv 2305.08891 §3.4): after guidance,
+    # rescale x̂₀ so its per-sample std matches the conditional prediction's,
+    # then blend x̂₀ ← φ·rescaled + (1−φ)·guided. 0 = off (reference
+    # behavior); ~0.7 counters the over-saturation large w causes.
+    cfg_rescale: float = 0.0
     clip_denoised: bool = True
     # 'ddpm' = ancestral (the reference's sampler); 'ddim' = Song et al.
     # 2021 non-Markovian update — deterministic at ddim_eta=0, ancestral-like

@@ -40,13 +40,14 @@ from novel_view_synthesis_3d_tpu.diffusion.schedules import DiffusionSchedule
 
 
 def _cfg_eps(model, params, model_batch: dict, w: float, dropout_rng=None):
-    """ε̂ with classifier-free guidance via one doubled-batch forward."""
+    """(guided, conditional) network outputs; CFG via one doubled-batch
+    forward. The conditional output rides along for cfg_rescale."""
     B = model_batch["z"].shape[0]
     doubled = jax.tree.map(lambda a: jnp.concatenate([a, a], axis=0), model_batch)
     mask = jnp.concatenate([jnp.ones((B,)), jnp.zeros((B,))])
     eps = model.apply({"params": params}, doubled, cond_mask=mask, train=False)
     eps_cond, eps_uncond = jnp.split(eps, 2, axis=0)
-    return (1.0 + w) * eps_cond - w * eps_uncond
+    return (1.0 + w) * eps_cond - w * eps_uncond, eps_cond
 
 
 def _posterior_sample(schedule: DiffusionSchedule, x0, z, t, key):
@@ -75,28 +76,43 @@ def _make_update(schedule: DiffusionSchedule, config: DiffusionConfig):
 
     CFG is applied in the network's output space before this conversion
     (guidance in eps-space and v-space coincide up to the linear maps here).
+    `update` takes the (guided, conditional) output pair from _cfg_eps: the
+    conditional branch feeds cfg_rescale (Lin et al. 2023) — after guidance,
+    x̂₀ is rescaled toward the conditional prediction's per-sample std and
+    blended with weight φ = config.cfg_rescale (0 = off, reference behavior).
     """
     x0_fn = _make_x0_fn(schedule, config.objective)
     clip_denoised = config.clip_denoised
+    phi = config.cfg_rescale
+    if not 0.0 <= phi <= 1.0:
+        raise ValueError(f"cfg_rescale must be in [0, 1], got {phi}")
+
+    def to_x0(z, t, outs):
+        guided, cond_out = outs
+        x0 = x0_fn(z, t, guided)
+        if phi > 0.0:
+            x0_c = x0_fn(z, t, cond_out)
+            axes = tuple(range(1, x0.ndim))
+            std_c = jnp.std(x0_c, axis=axes, keepdims=True)
+            std_g = jnp.std(x0, axis=axes, keepdims=True)
+            rescaled = x0 * (std_c / jnp.maximum(std_g, 1e-8))
+            x0 = phi * rescaled + (1.0 - phi) * x0
+        if clip_denoised:
+            x0 = jnp.clip(x0, -1.0, 1.0)
+        return x0
 
     if config.sampler == "ddim":
         eta = config.ddim_eta
 
-        def update(z, t, out, key):
-            x0 = x0_fn(z, t, out)
-            if clip_denoised:
-                x0 = jnp.clip(x0, -1.0, 1.0)
+        def update(z, t, outs, key):
             noise = jax.random.normal(key, z.shape)
-            return schedule.ddim_step(x0, z, t, noise, eta)
+            return schedule.ddim_step(to_x0(z, t, outs), z, t, noise, eta)
 
         return update
     if config.sampler == "ddpm":
 
-        def update(z, t, out, key):
-            x0 = x0_fn(z, t, out)
-            if clip_denoised:
-                x0 = jnp.clip(x0, -1.0, 1.0)
-            return _posterior_sample(schedule, x0, z, t, key)
+        def update(z, t, outs, key):
+            return _posterior_sample(schedule, to_x0(z, t, outs), z, t, key)
 
         return update
     raise ValueError(f"unknown sampler {config.sampler!r}")
@@ -134,8 +150,8 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
         key, k_step = jax.random.split(key)
         batch = dict(cond, z=z,
                      logsnr=jnp.full((z.shape[0],), schedule.logsnr(t)))
-        eps = _cfg_eps(model, params, batch, w)
-        z = update(z, t, eps, k_step)
+        outs = _cfg_eps(model, params, batch, w)
+        z = update(z, t, outs, k_step)
         return (z, key), None
 
     @jax.jit
@@ -202,8 +218,8 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
                 "z": z,
                 "logsnr": jnp.full((B,), schedule.logsnr(t)),
             }
-            eps = _cfg_eps(model, params, batch, w)
-            z = update(z, t, eps, k_step)
+            outs = _cfg_eps(model, params, batch, w)
+            z = update(z, t, outs, k_step)
             return (z, key), None
 
         (z, _), _ = jax.lax.scan(body, (z0, key), ts)

@@ -127,14 +127,14 @@ def test_ddim_eta0_ignores_step_noise():
     t = jnp.asarray([5, 5])
     upd0 = _make_update(sched, DiffusionConfig(
         timesteps=16, sampler="ddim", ddim_eta=0.0))
-    a = upd0(z, t, eps, jax.random.PRNGKey(0))
-    b = upd0(z, t, eps, jax.random.PRNGKey(123))
+    a = upd0(z, t, (eps, eps), jax.random.PRNGKey(0))
+    b = upd0(z, t, (eps, eps), jax.random.PRNGKey(123))
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # …and at η=1 the noise branch must be live.
     upd1 = _make_update(sched, DiffusionConfig(
         timesteps=16, sampler="ddim", ddim_eta=1.0))
-    c = upd1(z, t, eps, jax.random.PRNGKey(0))
-    d = upd1(z, t, eps, jax.random.PRNGKey(123))
+    c = upd1(z, t, (eps, eps), jax.random.PRNGKey(0))
+    d = upd1(z, t, (eps, eps), jax.random.PRNGKey(123))
     assert np.abs(np.asarray(c) - np.asarray(d)).max() > 1e-4
 
 
@@ -249,3 +249,33 @@ def test_trajectory_views_limits_batch():
     np.testing.assert_array_equal(np.asarray(final_l), np.asarray(final_f))
     np.testing.assert_array_equal(np.asarray(traj_l)[:, 0],
                                   np.asarray(traj_f)[:, 0])
+
+
+def test_cfg_rescale_changes_output_and_stays_finite():
+    model, params, cond = _model_and_params()
+    # Perturb params: the zero-init head makes cond == uncond at init, and
+    # rescale is a no-op when the two branches agree.
+    params = jax.tree.map(
+        lambda p: p + 0.01 * jax.random.normal(jax.random.PRNGKey(5), p.shape),
+        params)
+    key = jax.random.PRNGKey(0)
+    imgs = {}
+    for phi in (0.0, 0.7):
+        dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8,
+                               guidance_weight=3.0, cfg_rescale=phi)
+        sched = make_schedule(dcfg)
+        out = make_sampler(model, sched, dcfg)(params, key, cond)
+        arr = np.asarray(out)
+        assert np.isfinite(arr).all(), phi
+        imgs[phi] = arr
+    # φ=0 must exactly reproduce the pre-feature sampler path; φ>0 differs.
+    assert not np.array_equal(imgs[0.0], imgs[0.7])
+
+
+def test_cfg_rescale_validation():
+    import pytest
+
+    model, params, cond = _model_and_params()
+    dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8, cfg_rescale=1.5)
+    with pytest.raises(ValueError, match="cfg_rescale"):
+        make_sampler(model, make_schedule(dcfg), dcfg)
