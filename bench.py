@@ -288,6 +288,79 @@ def bench_analyze(preset_name: str, overrides=()) -> None:
     print(json.dumps(result))
 
 
+def bench_data(backend: str = "native", batches: int = 50,
+               batch_size: int = 32, sidelength: int = 64,
+               overrides=()) -> None:
+    """Host input-pipeline throughput (imgs/sec) on a synthetic SRN tree.
+
+    Backends: 'native' (C++ worker-pool loader), 'grain', 'python'
+    (in-process iterator — also the vs_baseline denominator, standing in
+    for the reference's single-threaded per-item path). Runs entirely on
+    CPU; useful for checking the loader keeps up with chip count × step
+    rate (HBM feeding, SURVEY.md §7 'keeping host input from starving
+    chips'). Honors `data.img_sidelength` and `train.batch_size` overrides;
+    anything else is rejected rather than silently ignored.
+    """
+    for ov in overrides:
+        key, val = ov.split("=", 1)
+        if key == "data.img_sidelength":
+            sidelength = int(val)
+        elif key == "train.batch_size":
+            batch_size = int(val)
+        else:
+            raise SystemExit(
+                f"bench data only honors data.img_sidelength and "
+                f"train.batch_size overrides; got {ov!r}")
+    import shutil
+    import tempfile
+
+    from novel_view_synthesis_3d_tpu.config import DataConfig
+    from novel_view_synthesis_3d_tpu.data.pipeline import (
+        iter_batches, make_dataset, make_grain_loader, cycle)
+    from novel_view_synthesis_3d_tpu.data.synthetic import write_synthetic_srn
+
+    tmp = tempfile.mkdtemp(prefix="nvs3d_databench_")
+    try:
+        root = os.path.join(tmp, "srn")
+        write_synthetic_srn(root, num_instances=8, views_per_instance=25,
+                            image_size=128)
+        ds = make_dataset(DataConfig(root_dir=root, img_sidelength=sidelength))
+
+        def make_iter(kind):
+            if kind == "native":
+                from novel_view_synthesis_3d_tpu.data import native_io
+                if not native_io.available():
+                    raise SystemExit("native IO library unavailable")
+                return iter(native_io.make_native_loader(
+                    ds, batch_size, n_threads=8, prefetch_depth=4, seed=0))
+            if kind == "grain":
+                return cycle(make_grain_loader(ds, batch_size, seed=0,
+                                               num_workers=4))
+            if kind == "python":
+                return iter_batches(ds, batch_size, seed=0)
+            raise SystemExit(f"unknown data backend {kind!r}")
+
+        def run(kind, n):
+            it = make_iter(kind)
+            next(it)  # warmup (spawns workers, fills prefetch)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                next(it)
+            return n * batch_size / (time.perf_counter() - t0)
+
+        ips = run(backend, batches)
+        base = run("python", max(5, batches // 10))
+        print(json.dumps({
+            "metric": f"data_imgs_per_sec_{backend}",
+            "value": round(ips, 1),
+            "unit": "imgs/sec",
+            "vs_baseline": round(ips / base, 3),
+            "baseline_value": round(base, 1),
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_profile(preset_name: str, steps: int, overrides=(),
                   out_dir: str = "./profile") -> None:
     """Capture a jax.profiler trace of the train step (XLA ops, HBM, fusion
@@ -321,6 +394,11 @@ def main():
     if args and args[0] == "analyze":
         preset = args[1] if len(args) > 1 else "tiny64"
         bench_analyze(preset, overrides)
+        return
+    if args and args[0] == "data":
+        backend = args[1] if len(args) > 1 else "native"
+        batches = int(args[2]) if len(args) > 2 else 50
+        bench_data(backend, batches, overrides=overrides)
         return
     preset = args[0] if args else "tiny64"
     steps = int(args[1]) if len(args) > 1 else 30

@@ -41,3 +41,17 @@ def test_bench_effective_accum_reexported():
     import bench
     assert callable(bench.build)
     assert callable(bench.bench_analyze)
+
+
+def test_bench_data_python_backend():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "data", "python", "3"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1])
+    assert result["metric"] == "data_imgs_per_sec_python"
+    assert result["value"] > 0
