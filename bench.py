@@ -319,6 +319,14 @@ def bench_data(backend: str = "native", batches: int = 50,
         iter_batches, make_dataset, make_grain_loader, cycle)
     from novel_view_synthesis_3d_tpu.data.synthetic import write_synthetic_srn
 
+    # Fail fast on a bad backend BEFORE paying the synthetic-dataset write.
+    if backend not in ("native", "grain", "python"):
+        raise SystemExit(f"unknown data backend {backend!r}")
+    if backend == "native":
+        from novel_view_synthesis_3d_tpu.data import native_io
+        if not native_io.available():
+            raise SystemExit("native IO library unavailable")
+
     tmp = tempfile.mkdtemp(prefix="nvs3d_databench_")
     try:
         root = os.path.join(tmp, "srn")

@@ -20,6 +20,7 @@ from novel_view_synthesis_3d_tpu.config import Config
 from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
 from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
 from novel_view_synthesis_3d_tpu.eval.metrics import fid, psnr, ssim
+from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
 from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
 
 
@@ -56,16 +57,29 @@ def evaluate_dataset(
     sample_steps: Optional[int] = None,
     batch_size: int = 8,
     compute_fid: bool = False,
+    mesh=None,
 ) -> EvalResult:
     """Sample novel views for held-out (cond, target) pairs and score them.
 
     For each of the first `num_instances` instances: condition on view
     `cond_view`, synthesize `views_per_instance` other views at their
     ground-truth poses, and score PSNR/SSIM against the real images.
+
+    `mesh`: a jax Mesh — the conditioning batch is sharded over its 'data'
+    axis and params replicated, so the reverse process runs data-parallel
+    across chips (batch_size must be a multiple of the data-axis size).
+    None = default-device sampling.
     """
     dcfg = config.diffusion
     schedule = sampling_schedule(dcfg, sample_steps)
     sampler = make_sampler(model, schedule, dcfg)
+    if mesh is not None:
+        shards = mesh_lib.num_data_shards(mesh)
+        if batch_size % shards != 0:
+            raise ValueError(
+                f"eval batch_size {batch_size} not divisible by the mesh "
+                f"data axis ({shards})")
+        params = mesh_lib.replicate(mesh, params)
 
     n_inst = (dataset.num_instances if num_instances is None
               else min(num_instances, dataset.num_instances))
@@ -101,7 +115,10 @@ def evaluate_dataset(
                                [chunk[-1][k]] * pad)
                    for k in chunk[0]}
         key, k_s = jax.random.split(key)
-        imgs = sampler(params, k_s, jax.tree.map(jnp.asarray, stacked))
+        device_batch = jax.tree.map(jnp.asarray, stacked)
+        if mesh is not None:
+            device_batch = mesh_lib.shard_batch(mesh, device_batch)
+        imgs = sampler(params, k_s, device_batch)
         imgs = imgs[:n]
         all_psnr.append(np.asarray(jax.device_get(
             psnr(imgs, jnp.asarray(truth)))))

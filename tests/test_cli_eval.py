@@ -245,3 +245,49 @@ def test_cli_rejects_invalid_config_with_clear_message(capsys):
     with pytest.raises(SystemExit) as ei:
         main(["config", "--preset", "tiny64", "model.ch=48"])
     assert "divisible by 32" in str(ei.value)
+
+
+def test_evaluate_dataset_mesh_matches_single_device(tmp_path):
+    """Sharding the eval sampler over the 8-device mesh must reproduce the
+    single-device scores (same key, same pairs)."""
+    from novel_view_synthesis_3d_tpu.config import (
+        Config, DataConfig, DiffusionConfig, MeshConfig, ModelConfig)
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+    from novel_view_synthesis_3d_tpu.eval.evaluate import evaluate_dataset
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+
+    root = str(tmp_path / "srn")
+    write_synthetic_srn(root, num_instances=2, views_per_instance=5,
+                        image_size=16)
+    cfg = Config(
+        model=ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                          attn_resolutions=(4,), dropout=0.0),
+        diffusion=DiffusionConfig(timesteps=8, sample_timesteps=2),
+        data=DataConfig(root_dir=root, img_sidelength=16),
+        mesh=MeshConfig(data=8),
+    )
+    ds = SRNDataset(root, img_sidelength=16)
+    model = XUNet(cfg.model)
+    rec = ds.pair(0, np.random.default_rng(0))
+    batch = {k: jnp.asarray(v[None]) for k, v in rec.items()}
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        {"x": batch["x"], "z": batch["target"],
+         "logsnr": jnp.zeros((1,)), "R1": batch["R1"], "t1": batch["t1"],
+         "R2": batch["R2"], "t2": batch["t2"], "K": batch["K"]},
+        cond_mask=jnp.ones((1,)), train=False)
+    params = variables["params"]
+
+    kwargs = dict(key=jax.random.PRNGKey(3), num_instances=2,
+                  views_per_instance=4, sample_steps=2, batch_size=8)
+    single = evaluate_dataset(cfg, model, params, ds, **kwargs)
+    mesh = mesh_lib.make_mesh(cfg.mesh)
+    sharded = evaluate_dataset(cfg, model, params, ds, mesh=mesh, **kwargs)
+    assert single.num_views == sharded.num_views == 8
+    np.testing.assert_allclose(sharded.per_view_psnr, single.per_view_psnr,
+                               rtol=1e-4)
+    # Indivisible batch is rejected loudly.
+    with pytest.raises(ValueError, match="not divisible"):
+        evaluate_dataset(cfg, model, params, ds, mesh=mesh,
+                         **dict(kwargs, batch_size=6))
