@@ -216,29 +216,25 @@ def cmd_eval(args, overrides: List[str]) -> int:
     params, step = _restore_params(cfg, model, sample_batch, args.step)
     print(f"restored checkpoint at step {step}")
 
-    # Multi-chip: shard the sampling batch over the mesh 'data' axis. The
-    # data axis is recomputed from the LOCAL device count (like bench.build)
-    # — a training config's mesh (e.g. mesh.data=32) must not crash an eval
-    # on a smaller host.
+    # Multi-chip: shard the sampling batch over the mesh 'data' axis; the
+    # data axis is refit to the LOCAL device count so a training config's
+    # mesh (e.g. mesh.data=32) doesn't crash an eval on a smaller host.
     mesh = None
     batch_size = args.batch_size
-    n_dev = len(jax.devices())
-    if n_dev > 1:
+    if len(jax.devices()) > 1:
         from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
 
-        claims = max(1, cfg.mesh.model) * max(1, cfg.mesh.seq)
-        if n_dev % claims == 0:
-            cfg_mesh = cfg.override(**{"mesh.data": n_dev // claims}).mesh
-            mesh = mesh_lib.make_mesh(cfg_mesh)
+        mesh = mesh_lib.fit_local_mesh(cfg.mesh)
+        if mesh is None:
+            print(f"note: {len(jax.devices())} devices not divisible by "
+                  f"mesh.model×mesh.seq claims; evaluating on the default "
+                  "device")
+        else:
             shards = mesh_lib.num_data_shards(mesh)
             batch_size = ((batch_size + shards - 1) // shards) * shards
             if batch_size != args.batch_size:
                 print(f"note: rounding eval batch {args.batch_size} -> "
                       f"{batch_size} (multiple of data axis {shards})")
-        else:
-            print(f"note: {n_dev} devices not divisible by "
-                  f"mesh.model×mesh.seq = {claims}; evaluating on the "
-                  "default device")
 
     result = evaluate_dataset(
         cfg, model, params, ds,

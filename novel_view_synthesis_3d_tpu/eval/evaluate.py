@@ -74,6 +74,14 @@ def evaluate_dataset(
     schedule = sampling_schedule(dcfg, sample_steps)
     sampler = make_sampler(model, schedule, dcfg)
     if mesh is not None:
+        if jax.process_count() > 1:
+            # Every process assembles the FULL batch here; the multi-process
+            # branch of shard_batch would treat it as a per-host shard and
+            # P-plicate the work, and the sharded psnr/ssim outputs would
+            # span non-addressable devices at device_get.
+            raise ValueError(
+                "evaluate_dataset(mesh=...) is single-process only; on a "
+                "pod, run eval on one host (or mesh=None)")
         shards = mesh_lib.num_data_shards(mesh)
         if batch_size % shards != 0:
             raise ValueError(

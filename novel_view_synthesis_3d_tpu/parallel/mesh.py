@@ -31,6 +31,26 @@ MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 
 
+def fit_local_mesh(config: Optional[MeshConfig] = None
+                   ) -> Optional[Mesh]:
+    """Mesh over the LOCAL device count, ignoring the config's data claim.
+
+    For tools (eval CLI, benches) that reuse a *training* config on whatever
+    host they run on: keeps model/seq claims but recomputes the data axis as
+    n_devices // (model×seq). Returns None when the devices don't divide the
+    model×seq claims (caller falls back to the default device) — a training
+    mesh like data=32 must not crash a 1-chip eval.
+    """
+    config = config or MeshConfig()
+    n = len(jax.devices())
+    claims = max(1, config.model) * max(1, config.seq)
+    if n % claims != 0:
+        return None
+    import dataclasses
+
+    return make_mesh(dataclasses.replace(config, data=n // claims))
+
+
 def make_mesh(config: Optional[MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the global device mesh.
