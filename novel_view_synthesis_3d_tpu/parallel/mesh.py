@@ -37,11 +37,15 @@ def fit_local_mesh(config: Optional[MeshConfig] = None
 
     For tools (eval CLI, benches) that reuse a *training* config on whatever
     host they run on: keeps model/seq claims but recomputes the data axis as
-    n_devices // (model×seq). Returns None when the devices don't divide the
-    model×seq claims (caller falls back to the default device) — a training
-    mesh like data=32 must not crash a 1-chip eval.
+    n_devices // (model×seq). Returns None — caller falls back to the
+    default device — when the devices don't divide the model×seq claims (a
+    training mesh like data=32 must not crash a 1-chip eval) or in
+    multi-process runs (these tools assemble full host-side batches, which
+    only a single-process mesh can shard safely).
     """
     config = config or MeshConfig()
+    if jax.process_count() > 1:
+        return None
     n = len(jax.devices())
     claims = max(1, config.model) * max(1, config.seq)
     if n % claims != 0:
