@@ -158,14 +158,12 @@ def cmd_sample(args, overrides: List[str]) -> int:
         # all N target poses (same pattern as eval/evaluate.py).
         traj_every = 0
         if args.denoise_gif:
-            # Aim for ~32 frames of the reverse process, hard-capped at 64:
-            # among the divisors of T that give ≤64 frames, pick the frame
-            # count closest to 32 (never fall back to every-step capture —
-            # at T=499 that would materialize a (499, N, H, W, 3) tensor).
+            # Aim for ~32 frames of the reverse process. The sampler accepts
+            # any stride (remainder steps are flat-scanned and the final
+            # state appended), so a near-uniform stride works for prime step
+            # counts too — no divisor hunt, never a single-frame "animation".
             T = schedule.num_timesteps
-            divisors = [d for d in range(1, T + 1)
-                        if T % d == 0 and T // d <= 64]
-            traj_every = min(divisors, key=lambda d: abs(T // d - 32))
+            traj_every = max(1, round(T / 32))
         sampler = make_sampler(model, schedule, dcfg,
                                trajectory_every=traj_every,
                                trajectory_views=1)
@@ -221,7 +219,7 @@ def cmd_eval(args, overrides: List[str]) -> int:
     # mesh (e.g. mesh.data=32) doesn't crash an eval on a smaller host.
     mesh = None
     batch_size = args.batch_size
-    if len(jax.devices()) > 1:
+    if len(jax.devices()) > 1 and args.protocol == "single":
         from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
 
         mesh = mesh_lib.fit_local_mesh(cfg.mesh)
@@ -245,6 +243,7 @@ def cmd_eval(args, overrides: List[str]) -> int:
         sample_steps=args.sample_steps,
         batch_size=batch_size,
         compute_fid=args.fid,
+        protocol=args.protocol,
         mesh=mesh,
     )
     print(json.dumps(dict(result.to_dict(), checkpoint_step=step)))
@@ -343,9 +342,16 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--step", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--protocol", choices=("single", "autoregressive"),
+                   default="single",
+                   help="'single': every target conditioned on the fixed "
+                        "view; 'autoregressive': 3DiM stochastic "
+                        "conditioning over the growing view pool")
     p.add_argument("--fid", action="store_true",
-                   help="also compute Fréchet distance (random-conv "
-                        "features; see eval/metrics.py on comparability)")
+                   help="also compute Fréchet distance — reported as "
+                        "'fid_random' (deterministic random-conv features, "
+                        "NOT comparable to published Inception-FID; see "
+                        "eval/metrics.py)")
 
     p = sub.add_parser("prep", help="offline dataset preparation")
     prep_sub = p.add_subparsers(dest="prep_command", required=True)

@@ -42,8 +42,17 @@ def _build() -> bool:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                        capture_output=True, timeout=120)
         return os.path.exists(_LIB_PATH)
-    except Exception:
-        return os.path.exists(_LIB_PATH)
+    except Exception as exc:
+        if os.path.exists(_LIB_PATH):
+            # The ABI gate below catches signature changes, but a stale
+            # binary with the same ABI number (behavior change only) would
+            # load silently — say so, so drift is diagnosable.
+            import warnings
+            warnings.warn(
+                f"native IO: `make` failed ({exc!r}); falling back to the "
+                f"pre-existing {_LIB_PATH}, which may be stale")
+            return True
+        return False
 
 
 def _load():

@@ -3,8 +3,16 @@
 The reference has no evaluation path at all (its sampling.py displays images
 in a blocking cv2 window, sampling.py:153-154, and computes nothing). This is
 the quality-measurement loop the 3DiM paper's SRN-cars protocol implies:
-condition on one view of an instance, synthesize another (ground-truth-posed)
-view, and score the synthesis against the held-out real image.
+condition on one view of an instance, synthesize other (ground-truth-posed)
+views, and score the synthesis against the held-out real images.
+
+Two protocols:
+- ``single`` — every target view is sampled in one reverse process
+  conditioned on the same fixed view (fast; one batched sampler call).
+- ``autoregressive`` — the 3DiM paper protocol: targets are generated in
+  sequence with stochastic conditioning over the growing pool of available
+  views (sample/ddpm.py:autoregressive_generate), so later views are
+  conditioned on earlier generated ones.
 """
 
 from __future__ import annotations
@@ -21,7 +29,11 @@ from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
 from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
 from novel_view_synthesis_3d_tpu.eval.metrics import fid, psnr, ssim
 from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
-from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
+from novel_view_synthesis_3d_tpu.sample.ddpm import (
+    autoregressive_generate,
+    make_sampler,
+    make_stochastic_sampler,
+)
 
 
 @dataclasses.dataclass
@@ -32,15 +44,23 @@ class EvalResult:
     per_view_psnr: np.ndarray
     per_view_ssim: np.ndarray
     fid: Optional[float] = None
+    # Honest labeling: the default Fréchet metric uses the deterministic
+    # random-conv feature extractor (eval/metrics.py), which is valid for
+    # relative comparisons but NOT comparable to published Inception-FID —
+    # so it is reported as "fid_random". A caller who supplies a pretrained
+    # feature_fn gets the plain "fid" key.
+    fid_label: str = "fid_random"
+    protocol: str = "single"
 
     def to_dict(self) -> dict:
         d = {
             "psnr": self.psnr,
             "ssim": self.ssim,
             "num_views": self.num_views,
+            "protocol": self.protocol,
         }
         if self.fid is not None:
-            d["fid"] = self.fid
+            d[self.fid_label] = self.fid
         return d
 
 
@@ -57,6 +77,8 @@ def evaluate_dataset(
     sample_steps: Optional[int] = None,
     batch_size: int = 8,
     compute_fid: bool = False,
+    fid_feature_fn=None,
+    protocol: str = "single",
     mesh=None,
 ) -> EvalResult:
     """Sample novel views for held-out (cond, target) pairs and score them.
@@ -65,14 +87,37 @@ def evaluate_dataset(
     `cond_view`, synthesize `views_per_instance` other views at their
     ground-truth poses, and score PSNR/SSIM against the real images.
 
+    `protocol`: "single" scores every target independently conditioned on
+    the fixed view; "autoregressive" runs the 3DiM stochastic-conditioning
+    protocol, where each generated view joins the conditioning pool for the
+    next (`batch_size` then counts instances per sampler call, and `mesh`
+    is unsupported).
+
+    `fid_feature_fn`: optional pretrained (B,H,W,C)→(B,D) embedder; when
+    given, the Fréchet metric is reported as "fid". Default None uses the
+    deterministic random-conv features and reports "fid_random" (not
+    comparable to published Inception-FID numbers).
+
     `mesh`: a jax Mesh — the conditioning batch is sharded over its 'data'
     axis and params replicated, so the reverse process runs data-parallel
     across chips (batch_size must be a multiple of the data-axis size).
     None = default-device sampling.
     """
+    if protocol not in ("single", "autoregressive"):
+        raise ValueError(f"unknown eval protocol {protocol!r}")
     dcfg = config.diffusion
     schedule = sampling_schedule(dcfg, sample_steps)
-    sampler = make_sampler(model, schedule, dcfg)
+    if protocol == "autoregressive":
+        if mesh is not None:
+            raise ValueError(
+                "protocol='autoregressive' does not support mesh-sharded "
+                "sampling; pass mesh=None")
+        if jax.process_count() > 1:
+            # Same hazard as the mesh path: every process would duplicate
+            # the full eval and race on any output file.
+            raise ValueError(
+                "evaluate_dataset(protocol='autoregressive') is "
+                "single-process only; on a pod, run eval on one host")
     if mesh is not None:
         if jax.process_count() > 1:
             # Every process assembles the FULL batch here; the multi-process
@@ -92,42 +137,26 @@ def evaluate_dataset(
     n_inst = (dataset.num_instances if num_instances is None
               else min(num_instances, dataset.num_instances))
 
-    # Assemble all (cond, target) pairs host-side.
-    conds, truths = [], []
+    # Assemble (cond view, target views) per instance host-side.
+    instances = []  # (cond_img, cond_pose, K, [(target_img, target_pose)])
     for i in range(n_inst):
         inst = dataset.instances[i]
         x, pose1 = inst.view(cond_view % len(inst))
         others = [v for v in range(len(inst)) if v != cond_view % len(inst)]
-        for v in others[:views_per_instance]:
-            target, pose2 = inst.view(v)
-            conds.append({
-                "x": x, "R1": pose1[:3, :3], "t1": pose1[:3, 3],
-                "R2": pose2[:3, :3], "t2": pose2[:3, 3], "K": inst.K,
-            })
-            truths.append(target)
-    if not conds:
+        targets = [inst.view(v) for v in others[:views_per_instance]]
+        if targets:
+            instances.append((x, pose1, inst.K, targets))
+    truths = [t for (_, _, _, targets) in instances for (t, _) in targets]
+    if not truths:
         raise ValueError("no evaluation pairs (need ≥2 views per instance)")
-    if compute_fid and len(conds) < 2:
+    if compute_fid and len(truths) < 2:
         raise ValueError(
             "FID needs ≥2 evaluation pairs for a covariance estimate; "
             "raise num_instances/views_per_instance or drop compute_fid")
 
-    # Batch through the sampler (pad the tail so one compilation serves all).
     all_psnr, all_ssim, all_imgs = [], [], []
-    for start in range(0, len(conds), batch_size):
-        chunk = conds[start:start + batch_size]
-        truth = np.stack(truths[start:start + batch_size])
-        n = len(chunk)
-        pad = batch_size - n
-        stacked = {k: np.stack([c[k] for c in chunk] +
-                               [chunk[-1][k]] * pad)
-                   for k in chunk[0]}
-        key, k_s = jax.random.split(key)
-        device_batch = jax.tree.map(jnp.asarray, stacked)
-        if mesh is not None:
-            device_batch = mesh_lib.shard_batch(mesh, device_batch)
-        imgs = sampler(params, k_s, device_batch)
-        imgs = imgs[:n]
+
+    def score(imgs, truth):
         all_psnr.append(np.asarray(jax.device_get(
             psnr(imgs, jnp.asarray(truth)))))
         all_ssim.append(np.asarray(jax.device_get(
@@ -135,11 +164,82 @@ def evaluate_dataset(
         if compute_fid:
             all_imgs.append(np.asarray(jax.device_get(imgs)))
 
+    if protocol == "autoregressive":
+        # 3DiM protocol: per instance, generate the target views in sequence
+        # with stochastic conditioning over the pool of available views.
+        # Batch instances together (autoregressive_generate is batched over
+        # its leading axis); the pool length must match within a call, so a
+        # short-tailed instance set falls back to the min target count. The
+        # stochastic sampler is built ONCE and the tail chunk padded to
+        # batch_size, so one compiled program serves every chunk.
+        n_targets = min(len(t) for (_, _, _, t) in instances)
+        if n_targets < views_per_instance:
+            print(f"note: autoregressive eval truncated to {n_targets} "
+                  f"target views per instance (requested "
+                  f"{views_per_instance}; shortest instance bounds all)")
+            truths = [t for (_, _, _, targets) in instances
+                      for (t, _) in targets[:n_targets]]
+        ar_sampler = make_stochastic_sampler(model, schedule, dcfg,
+                                             max_pool=n_targets + 1)
+        for start in range(0, len(instances), batch_size):
+            chunk = instances[start:start + batch_size]
+            n = len(chunk)
+            chunk = chunk + [chunk[-1]] * (batch_size - n)
+            first_view = {
+                "x": jnp.asarray(np.stack([c[0] for c in chunk])),
+                "R1": jnp.asarray(np.stack([c[1][:3, :3] for c in chunk])),
+                "t1": jnp.asarray(np.stack([c[1][:3, 3] for c in chunk])),
+                "K": jnp.asarray(np.stack([c[2] for c in chunk])),
+            }
+            target_poses = {
+                "R2": jnp.asarray(np.stack(
+                    [[p[:3, :3] for (_, p) in c[3][:n_targets]]
+                     for c in chunk])),
+                "t2": jnp.asarray(np.stack(
+                    [[p[:3, 3] for (_, p) in c[3][:n_targets]]
+                     for c in chunk])),
+            }
+            truth = np.stack([[t for (t, _) in c[3][:n_targets]]
+                              for c in chunk[:n]])  # (n, N, H, W, 3)
+            key, k_s = jax.random.split(key)
+            imgs = autoregressive_generate(
+                model, schedule, dcfg, params, k_s, first_view, target_poses,
+                max_pool=n_targets + 1, sampler=ar_sampler)
+            imgs = imgs[:n].reshape((-1,) + imgs.shape[2:])
+            score(imgs, truth.reshape((-1,) + truth.shape[2:]))
+    else:
+        # Flatten to (cond, target) pairs; batch through the sampler (pad
+        # the tail so one compilation serves all).
+        sampler = make_sampler(model, schedule, dcfg)
+        conds = []
+        for (x, pose1, K, targets) in instances:
+            for (_, pose2) in targets:
+                conds.append({
+                    "x": x, "R1": pose1[:3, :3], "t1": pose1[:3, 3],
+                    "R2": pose2[:3, :3], "t2": pose2[:3, 3], "K": K,
+                })
+        for start in range(0, len(conds), batch_size):
+            chunk = conds[start:start + batch_size]
+            truth = np.stack(truths[start:start + batch_size])
+            n = len(chunk)
+            pad = batch_size - n
+            stacked = {k: np.stack([c[k] for c in chunk] +
+                                   [chunk[-1][k]] * pad)
+                       for k in chunk[0]}
+            key, k_s = jax.random.split(key)
+            device_batch = jax.tree.map(jnp.asarray, stacked)
+            if mesh is not None:
+                device_batch = mesh_lib.shard_batch(mesh, device_batch)
+            imgs = sampler(params, k_s, device_batch)
+            imgs = imgs[:n]
+            score(imgs, truth)
+
     per_psnr = np.concatenate(all_psnr)
     per_ssim = np.concatenate(all_ssim)
     fid_value = None
     if compute_fid:
-        fid_value = fid(np.stack(truths), np.concatenate(all_imgs))
+        fid_value = fid(np.stack(truths), np.concatenate(all_imgs),
+                        feature_fn=fid_feature_fn)
     return EvalResult(
         psnr=float(per_psnr.mean()),
         ssim=float(per_ssim.mean()),
@@ -147,4 +247,6 @@ def evaluate_dataset(
         per_view_psnr=per_psnr,
         per_view_ssim=per_ssim,
         fid=fid_value,
+        fid_label="fid" if fid_feature_fn is not None else "fid_random",
+        protocol=protocol,
     )

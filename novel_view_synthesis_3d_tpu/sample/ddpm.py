@@ -126,24 +126,25 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
     sample(params, key, cond) -> (B, H, W, 3) images in [-1, 1], where cond
     holds x, R1, t1, R2, t2, K (the clean conditioning view(s) + poses).
 
-    `trajectory_every=k` (k > 0, k | num_timesteps) makes the sampler ALSO
-    return the partially-denoised z after every k-th reverse step:
+    `trajectory_every=k` (k > 0) makes the sampler ALSO return the
+    partially-denoised z after every k-th reverse step:
     sample(...) -> (final, trajectory) with trajectory
-    (num_timesteps//k, B', H, W, 3), final == trajectory[-1][:B'].
-    Implemented as a nested scan (inner k steps, outer collects), so the
-    RNG stream — and therefore the final image — is bit-identical to the
-    flat sampler. `trajectory_views` limits B' to the first n batch entries
-    so a consumer that only wants one view's denoising film doesn't buy the
-    whole batch's trajectory in HBM (B' = B when None).
+    (n_frames, B', H, W, 3) and final[:B'] == trajectory[-1], where
+    n_frames = ceil(num_timesteps / k). k need not divide num_timesteps:
+    the T//k full chunks run through a nested scan and any remainder steps
+    run as one flat scan whose end state is appended as the last frame, so
+    the final image is always captured. The RNG stream — and therefore the
+    final image — is bit-identical to the flat sampler in every case.
+    `trajectory_views` limits B' to the first n batch entries so a consumer
+    that only wants one view's denoising film doesn't buy the whole batch's
+    trajectory in HBM (B' = B when None).
     """
     w = config.guidance_weight
     update = _make_update(schedule, config)
     T = schedule.num_timesteps
-    if trajectory_every < 0 or (trajectory_every
-                                and T % trajectory_every != 0):
+    if trajectory_every < 0 or trajectory_every > T:
         raise ValueError(
-            f"trajectory_every must be 0 or a divisor of {T}; "
-            f"got {trajectory_every}")
+            f"trajectory_every must be in [0, {T}]; got {trajectory_every}")
 
     def body(cond, params, carry, t):
         z, key = carry
@@ -172,9 +173,16 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
             return carry, (z if trajectory_views is None
                            else z[:trajectory_views])
 
-        chunks = ts.reshape(T // trajectory_every, trajectory_every)
-        (z, _), traj = jax.lax.scan(outer, (z0, key), chunks)
-        return z, traj
+        n_chunks, rem = divmod(T, trajectory_every)
+        chunks = ts[:n_chunks * trajectory_every].reshape(
+            n_chunks, trajectory_every)
+        carry, traj = jax.lax.scan(outer, (z0, key), chunks)
+        if rem:
+            carry, _ = jax.lax.scan(step, carry, ts[-rem:])
+            z = carry[0]
+            last = z if trajectory_views is None else z[:trajectory_views]
+            traj = jnp.concatenate([traj, last[None]], axis=0)
+        return carry[0], traj
 
     return sample
 
@@ -231,7 +239,8 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
 def autoregressive_generate(model, schedule: DiffusionSchedule,
                             config: DiffusionConfig, params, key,
                             first_view: dict, target_poses: dict,
-                            max_pool: Optional[int] = None) -> jnp.ndarray:
+                            max_pool: Optional[int] = None,
+                            sampler=None) -> jnp.ndarray:
     """Generate a trajectory of novel views autoregressively.
 
     Starting from one real view (`first_view`: x (B,H,W,3), R1, t1, K), each
@@ -239,12 +248,15 @@ def autoregressive_generate(model, schedule: DiffusionSchedule,
     stochastic conditioning over ALL previously available views, and the
     result joins the pool — the 3DiM sampling strategy. Returns
     (B, N, H, W, 3). One compiled sampler serves every iteration (the pool
-    is padded to `max_pool`).
+    is padded to `max_pool`). A caller looping over many batches should
+    build the sampler once with `make_stochastic_sampler` and pass it as
+    `sampler` so each call reuses the same jit cache.
     """
     B, H, W, C = first_view["x"].shape
     N = target_poses["R2"].shape[1]
     max_pool = max_pool or (N + 1)
-    sampler = make_stochastic_sampler(model, schedule, config, max_pool)
+    if sampler is None:
+        sampler = make_stochastic_sampler(model, schedule, config, max_pool)
 
     # Pool padded with repeats of the first view (never selected: idx < n).
     pool = {

@@ -173,6 +173,7 @@ class Trainer:
         # the step loop checkpoint + exit cleanly; combined with
         # resume=True the run continues from the last step after reschedule.
         self._preempted = False
+        self._probe_warned = False
         if tcfg.handle_preemption:
             try:
                 signal.signal(signal.SIGTERM, self._on_preempt)
@@ -260,12 +261,14 @@ class Trainer:
                 self.ckpt.save(step_now, self.state)
 
             if tcfg.sample_every and step_now % tcfg.sample_every == 0:
-                self.dump_samples(step_now)
+                if self._probe_supported():
+                    self.dump_samples(step_now)
 
             if tcfg.eval_every and step_now % tcfg.eval_every == 0:
-                logged = self.eval_step(step_now)
-                print(f"{step_now}: eval psnr={logged['psnr']:.2f} "
-                      f"ssim={logged['ssim']:.4f}")
+                if self._probe_supported():
+                    logged = self.eval_step(step_now)
+                    print(f"{step_now}: eval psnr={logged['psnr']:.2f} "
+                          f"ssim={logged['ssim']:.4f}")
 
             if self._preempt_agreed():
                 print(f"preemption signal received at step {step_now}: "
@@ -282,6 +285,27 @@ class Trainer:
         timing = self.timer.summary()
         if timing:
             print(f"step timing: {timing}")
+
+    def _probe_supported(self) -> bool:
+        """In-loop sample/eval probes are single-process only.
+
+        The probe path (`_sample_cond`) jits a dense sampler over the
+        (possibly FSDP globally-sharded) params with a host-local probe
+        batch, then device_gets the output. In a multi-host run each
+        process would feed a *different* probe batch into a collective
+        program and fetch a non-fully-addressable array — a crash or hang
+        mid-training. `evaluate_dataset(mesh=...)` raises explicitly for
+        process_count>1; this gate skips the in-loop probes the same way
+        (with a one-time warning) instead of dying at step `eval_every`."""
+        if jax.process_count() == 1:
+            return True
+        if not self._probe_warned:
+            self._probe_warned = True
+            if jax.process_index() == 0:
+                print("warning: in-loop sample/eval probes are disabled for "
+                      "multi-process runs (use the `eval` CLI on a single "
+                      "host against a checkpoint instead)")
+        return False
 
     # ------------------------------------------------------------------
     def eval_step(self, step: int, num: int = 4) -> dict:

@@ -188,16 +188,32 @@ def test_cli_train_sample_eval_e2e(cli_workspace, capsys):
     assert result["num_views"] == 1
     assert result["checkpoint_step"] == 2
 
-    # --fid needs ≥2 pairs; 2 instances × 2 views each gives 4.
+    # --fid needs ≥2 pairs; 2 instances × 2 views each gives 4. The default
+    # extractor is random-conv, so the honest key is fid_random (plain
+    # "fid" is reserved for a pretrained feature_fn).
     fid_json = str(tmp / "eval_fid.json")
     assert main(["eval", root, "--out", fid_json, "--fid",
                  "--views-per-instance", "2", "--sample-steps", "2",
                  "--batch-size", "2"] + _tiny_overrides(tmp)) == 0
     with open(fid_json) as fh:
         result = json.load(fh)
-    assert "fid" in result and np.isfinite(result["fid"])
-    assert result["fid"] >= 0.0
+    assert "fid" not in result
+    assert "fid_random" in result and np.isfinite(result["fid_random"])
+    assert result["fid_random"] >= 0.0
     assert result["num_views"] == 4
+
+    # 3DiM autoregressive stochastic-conditioning protocol: same scoring
+    # surface, targets generated sequentially per instance.
+    ar_json = str(tmp / "eval_ar.json")
+    assert main(["eval", root, "--out", ar_json,
+                 "--protocol", "autoregressive",
+                 "--views-per-instance", "2", "--sample-steps", "2",
+                 "--batch-size", "2"] + _tiny_overrides(tmp)) == 0
+    with open(ar_json) as fh:
+        result = json.load(fh)
+    assert result["protocol"] == "autoregressive"
+    assert result["num_views"] == 4
+    assert np.isfinite(result["psnr"])
 
 
 def test_cli_sample_without_checkpoint_fails(cli_workspace, tmp_path):

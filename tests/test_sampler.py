@@ -230,9 +230,26 @@ def test_trajectory_every_validation():
     sched = make_schedule(dcfg)
     model, params, cond = _model_and_params()
     with pytest.raises(ValueError, match="trajectory_every"):
-        make_sampler(model, sched, dcfg, trajectory_every=3)
-    with pytest.raises(ValueError, match="trajectory_every"):
         make_sampler(model, sched, dcfg, trajectory_every=-1)
+    with pytest.raises(ValueError, match="trajectory_every"):
+        make_sampler(model, sched, dcfg, trajectory_every=9)
+
+
+def test_trajectory_non_divisor_stride():
+    # T=8, stride 3 → two full chunks (after steps 3 and 6) + the remainder
+    # end-state appended: 3 frames, final frame bit-identical to the flat
+    # sampler (same RNG stream). This is the prime-step-count gif fix.
+    dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8)
+    sched = make_schedule(dcfg)
+    model, params, cond = _model_and_params()
+    flat = make_sampler(model, sched, dcfg)
+    traj3 = make_sampler(model, sched, dcfg, trajectory_every=3)
+    key = jax.random.PRNGKey(11)
+    ref = np.asarray(flat(params, key, cond))
+    final, traj = traj3(params, key, cond)
+    assert traj.shape == (3, 2, 16, 16, 3)
+    np.testing.assert_array_equal(np.asarray(final), ref)
+    np.testing.assert_array_equal(np.asarray(traj)[-1], ref)
 
 
 def test_trajectory_views_limits_batch():
