@@ -71,9 +71,29 @@ def cmd_train(args, overrides: List[str]) -> int:
 # ---------------------------------------------------------------------------
 # sample
 # ---------------------------------------------------------------------------
-def _restore_params(cfg: Config, model, sample_batch: dict, step: Optional[int]):
-    """Latest (or `step`) checkpoint → params (EMA if trained with EMA)."""
+def _restore_params(cfg: Config, model, sample_batch: dict, step: Optional[int],
+                    reference_ckpt: Optional[str] = None):
+    """Latest (or `step`) checkpoint → params (EMA if trained with EMA).
+
+    `reference_ckpt`: path to a reference-format flax msgpack file (e.g.
+    the published pretrained model) — imported via compat/reference_ckpt.py
+    instead of reading this repo's Orbax checkpoints. Use with
+    `--preset reference` so the model carries the quirks the weights were
+    trained under.
+    """
     import jax
+
+    if reference_ckpt is not None:
+        # Before the Orbax/optax imports below — this path needs neither.
+        from novel_view_synthesis_3d_tpu.compat.reference_ckpt import (
+            load_reference_checkpoint)
+        if cfg.model.groupnorm_per_frame or cfg.model.attn_out_proj:
+            print("warning: --reference-ckpt weights were trained under the "
+                  "reference quirks (shared-frame GroupNorm stats, no attn "
+                  "out-projection) but the active config disables them — "
+                  "outputs will differ from the reference; use "
+                  "--preset reference")
+        return load_reference_checkpoint(reference_ckpt), 0
 
     from novel_view_synthesis_3d_tpu.train.checkpoint import CheckpointManager
     from novel_view_synthesis_3d_tpu.train.state import create_train_state
@@ -137,7 +157,8 @@ def cmd_sample(args, overrides: List[str]) -> int:
         "R2": poses2[0][None, :3, :3], "t2": poses2[0][None, :3, 3],
         "K": inst.K[None],
     })
-    params, step = _restore_params(cfg, model, sample_batch, args.step)
+    params, step = _restore_params(cfg, model, sample_batch, args.step,
+                                   reference_ckpt=args.reference_ckpt)
     print(f"restored checkpoint at step {step}")
 
     schedule = sampling_schedule(dcfg, args.sample_steps)
@@ -211,7 +232,8 @@ def cmd_eval(args, overrides: List[str]) -> int:
     rec = ds.pair(0, np.random.default_rng(0))
     sample_batch = _sample_model_batch(
         {k: v[None] for k, v in rec.items()})
-    params, step = _restore_params(cfg, model, sample_batch, args.step)
+    params, step = _restore_params(cfg, model, sample_batch, args.step,
+                                   reference_ckpt=args.reference_ckpt)
     print(f"restored checkpoint at step {step}")
 
     # Multi-chip: shard the sampling batch over the mesh 'data' axis; the
@@ -323,6 +345,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="respaced DDPM steps (default: config)")
     p.add_argument("--step", type=int, default=None,
                    help="checkpoint step (default: latest)")
+    p.add_argument("--reference-ckpt", default=None,
+                   help="load a reference-format flax msgpack checkpoint "
+                        "(e.g. the published pretrained model) instead of "
+                        "this repo's checkpoints; pair with "
+                        "--preset reference")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--gif", action="store_true",
                    help="also write a looping orbit.gif of the views")
@@ -341,6 +368,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--sample-steps", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--step", type=int, default=None)
+    p.add_argument("--reference-ckpt", default=None,
+                   help="load a reference-format flax msgpack checkpoint; "
+                        "pair with --preset reference")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--protocol", choices=("single", "autoregressive"),
                    default="single",

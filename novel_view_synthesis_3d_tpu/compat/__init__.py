@@ -1,0 +1,15 @@
+"""Interop with the reference implementation's artifact formats."""
+
+from novel_view_synthesis_3d_tpu.compat.reference_ckpt import (
+    export_reference_params,
+    import_reference_params,
+    load_reference_checkpoint,
+    strip_replica_axis,
+)
+
+__all__ = [
+    "export_reference_params",
+    "import_reference_params",
+    "load_reference_checkpoint",
+    "strip_replica_axis",
+]

@@ -1,0 +1,158 @@
+"""Import/export of reference-format X-UNet checkpoints.
+
+The reference saves flax msgpack checkpoints of its pmap-replicated param
+tree (`/root/reference/train.py:159-167`, restored at
+`sampling.py:104-114`; the README's pretrained model ships in this format).
+This module converts that tree to/from this repo's layout so reference
+checkpoints — including the published pretrained model — load directly.
+
+The two layouts differ in exactly three ways:
+
+1. **Replication axis.** The reference saves params straight out of pmap,
+   so every leaf carries a leading device axis (never unreplicated —
+   SURVEY.md §3.5). `strip_replica_axis` removes it.
+2. **Conv kernels.** The reference uses 3-D `nn.Conv(kernel=(1,3,3))` over
+   (B, F, H, W, C) — kernels shaped (1, 3, 3, Cin, Cout). This repo's
+   `FrameConv` runs a 2-D conv over (B·F, H, W, C) — kernels (3, 3, Cin,
+   Cout), identical math (models/layers.py). The frame axis is squeezed /
+   re-inserted.
+3. **Scope names for convs.** A reference `Conv_N` at some scope is this
+   repo's `FrameConv_N/Conv_0` at the same scope. Everything else (Dense,
+   DenseGeneral, GroupNorm wrappers, FiLM, XUNetBlock/ResnetBlock/AttnBlock
+   numbering, pos_emb/ref_pose_emb params) is name-identical because both
+   models instantiate submodules in the same order.
+
+Use the `reference` config preset with imported weights: it pins the
+behavior quirks the weights were trained under (shared-frame GroupNorm
+statistics, no attention out-projection, F=2).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+import numpy as np
+
+_CONV_RE = re.compile(r"^Conv_(\d+)$")
+_FRAMECONV_RE = re.compile(r"^FrameConv_(\d+)$")
+
+
+def strip_replica_axis(tree: dict, n_devices: Optional[int] = None) -> dict:
+    """Remove the pmap leading device axis from every leaf, if present.
+
+    The reference never unreplicates before saving, so a checkpoint from an
+    N-GPU run has every leaf shaped (N, ...). Detection: all leaves share
+    the same leading dimension AND every norm `scale` leaf is 2-D (an
+    unreplicated GroupNorm scale is 1-D; conv/Dense biases don't work as
+    the witness — DenseGeneral biases are legitimately 2-D). Pass
+    `n_devices` to skip detection.
+    Replica 0 is taken — NOT an average: the reference also never syncs its
+    replicas (SURVEY.md §2.3), so each device axis slot holds an
+    independently-trained model; slot 0 is "the" model by convention.
+    """
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return tree
+    if n_devices is None:
+        lead = {leaf.shape[0] if np.ndim(leaf) > 0 else None
+                for leaf in leaves}
+        if len(lead) != 1 or None in lead:
+            return tree
+        scales = [leaf for path, leaf in _iter_paths(tree)
+                  if path[-1] == "scale"]
+        if not scales or any(np.ndim(s) != 2 for s in scales):
+            return tree
+    return jax.tree.map(lambda leaf: np.asarray(leaf)[0], tree)
+
+
+def _iter_paths(tree: dict, prefix=()):
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            yield from _iter_paths(v, prefix + (k,))
+        else:
+            yield prefix + (k,), v
+
+
+def import_reference_params(ref_params: dict) -> dict:
+    """Reference param tree (unreplicated) → this repo's param layout."""
+
+    def convert(scope: dict) -> dict:
+        out = {}
+        for k, v in scope.items():
+            m = _CONV_RE.match(k)
+            if m and isinstance(v, dict) and "kernel" in v:
+                kernel = np.asarray(v["kernel"])
+                if kernel.ndim != 5 or kernel.shape[0] != 1:
+                    raise ValueError(
+                        f"reference conv {k}: expected (1, kh, kw, cin, "
+                        f"cout) kernel, got {kernel.shape}")
+                entry = {"kernel": kernel[0]}
+                if "bias" in v:
+                    entry["bias"] = np.asarray(v["bias"])
+                out[f"FrameConv_{m.group(1)}"] = {"Conv_0": entry}
+            elif isinstance(v, dict):
+                out[k] = convert(v)
+            else:
+                out[k] = np.asarray(v)
+        return out
+
+    return convert(ref_params)
+
+
+def export_reference_params(params: dict) -> dict:
+    """This repo's param layout → reference tree (3-D conv kernels)."""
+
+    def convert(scope: dict) -> dict:
+        out = {}
+        for k, v in scope.items():
+            m = _FRAMECONV_RE.match(k)
+            if m and isinstance(v, dict) and set(v) == {"Conv_0"}:
+                inner = v["Conv_0"]
+                entry = {"kernel": np.asarray(inner["kernel"])[None]}
+                if "bias" in inner:
+                    entry["bias"] = np.asarray(inner["bias"])
+                out[f"Conv_{m.group(1)}"] = entry
+            elif isinstance(v, dict):
+                out[k] = convert(v)
+            else:
+                out[k] = np.asarray(v)
+        return out
+
+    return convert(params)
+
+
+def load_reference_checkpoint(path: str) -> dict:
+    """Load a reference flax-msgpack checkpoint file → this repo's layout.
+
+    Accepts the raw bytes the reference's `checkpoints.save_checkpoint`
+    writes (msgpack of the bare param dict, possibly pmap-replicated,
+    possibly wrapped in a {'params': ...} or TrainState-shaped dict).
+    """
+    from flax import serialization
+
+    with open(path, "rb") as fh:
+        tree = serialization.msgpack_restore(fh.read())
+    # Unwrap TrainState-shaped saves down to the param dict.
+    while isinstance(tree, dict) and "params" in tree and (
+            set(tree) <= {"params", "step", "opt_state", "tx", "apply_fn"}):
+        tree = tree["params"]
+    tree = strip_replica_axis(tree)
+    return import_reference_params(tree)
+
+
+def assert_trees_match(a: dict, b: dict, rtol=0.0, atol=0.0) -> None:
+    """Structural + numerical equality check (test/debug helper)."""
+    pa = dict(_iter_paths(a))
+    pb = dict(_iter_paths(b))
+    if set(pa) != set(pb):
+        only_a = sorted(set(pa) - set(pb))[:5]
+        only_b = sorted(set(pb) - set(pa))[:5]
+        raise AssertionError(
+            f"param tree mismatch; only in first: {only_a}, "
+            f"only in second: {only_b}")
+    for path, leaf in pa.items():
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(pb[path]), rtol=rtol, atol=atol,
+            err_msg="/".join(path))

@@ -1,0 +1,144 @@
+"""Raytraced multi-view SRN-format dataset: geometrically REAL scenes.
+
+The blob fixture (data/synthetic.py) paints a pose-dependent pattern but is
+not a consistent 3-D scene — a model can fit it without learning geometry,
+so PSNR on held-out views says nothing about novel-view synthesis. This
+module renders actual 3-D scenes (colored spheres on a ground plane,
+lambertian shading) through the SAME pinhole camera model the framework
+uses everywhere (models/rays.py: pixel centers at +0.5, K = [[f,0,cx],
+[0,f,cy],[0,0,1]], cam→world (R, t)), so:
+
+  - every view of an instance is a true projection of one underlying scene;
+  - cross-view consistency is exactly what a novel-view model must learn;
+  - eval PSNR/SSIM on held-out poses measures real view synthesis.
+
+This is the in-environment stand-in for SRN ShapeNet cars (no network
+egress to fetch the real dump — BASELINE.md); the directory layout, pose
+files, and intrinsics match the SRN format byte-for-byte so the identical
+pipeline consumes either.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image
+
+from novel_view_synthesis_3d_tpu.data.synthetic import look_at_pose
+
+_LIGHT_DIR = np.array([0.4, 0.25, 0.88])
+_LIGHT_DIR = _LIGHT_DIR / np.linalg.norm(_LIGHT_DIR)
+
+
+def random_scene(rng: np.random.Generator, num_spheres: int = 4) -> dict:
+    """A random scene: spheres clustered near the origin + a ground plane."""
+    centers = np.stack([
+        rng.uniform(-0.7, 0.7, num_spheres),
+        rng.uniform(-0.7, 0.7, num_spheres),
+        rng.uniform(0.0, 0.8, num_spheres),
+    ], axis=1)
+    radii = rng.uniform(0.18, 0.45, num_spheres)
+    colors = rng.uniform(0.15, 0.95, (num_spheres, 3))
+    return {
+        "centers": centers.astype(np.float32),
+        "radii": radii.astype(np.float32),
+        "colors": colors.astype(np.float32),
+        "ground_color": rng.uniform(0.3, 0.8, 3).astype(np.float32),
+        "ground_z": np.float32(-0.5),
+    }
+
+
+def render_scene(scene: dict, pose: np.ndarray, K: np.ndarray,
+                 size: int) -> np.ndarray:
+    """Raytrace one view. pose: cam→world 4×4; returns uint8 (S, S, 3)."""
+    R, t = pose[:3, :3], pose[:3, 3]
+    v, u = np.mgrid[0:size, 0:size].astype(np.float64) + 0.5
+    x = (u - K[0, 2]) / K[0, 0]
+    y = (v - K[1, 2]) / K[1, 1]
+    d_cam = np.stack([x, y, np.ones_like(x)], axis=-1)
+    d = d_cam @ R.T
+    d = d / np.linalg.norm(d, axis=-1, keepdims=True)   # (S, S, 3)
+    o = t[None, None, :]
+
+    t_hit = np.full((size, size), np.inf)
+    color = np.ones((size, size, 3))                    # white background
+    normal = np.zeros((size, size, 3))
+
+    # Spheres: solve |o + s·d − c|² = r².
+    for c, r, col in zip(scene["centers"], scene["radii"], scene["colors"]):
+        oc = o - c[None, None, :]
+        b = np.sum(oc * d, axis=-1)
+        q = np.sum(oc * oc, axis=-1) - r * r
+        disc = b * b - q
+        hit = disc >= 0
+        s = -b - np.sqrt(np.where(hit, disc, 0.0))
+        hit &= (s > 1e-6) & (s < t_hit)
+        t_hit = np.where(hit, s, t_hit)
+        p = o + s[..., None] * d
+        n = (p - c[None, None, :]) / r
+        color = np.where(hit[..., None], col[None, None, :], color)
+        normal = np.where(hit[..., None], n, normal)
+
+    # Ground plane z = ground_z (only where no nearer sphere).
+    gz = float(scene["ground_z"])
+    denom = d[..., 2]
+    s_g = np.where(np.abs(denom) > 1e-9, (gz - o[..., 2]) / denom, np.inf)
+    p_g = o + s_g[..., None] * d
+    in_disk = (p_g[..., 0] ** 2 + p_g[..., 1] ** 2) < 4.0
+    hit_g = (s_g > 1e-6) & (s_g < t_hit) & in_disk
+    # Checker pattern so the plane carries pose-sensitive texture.
+    checker = ((np.floor(p_g[..., 0] * 2) + np.floor(p_g[..., 1] * 2)) % 2)
+    g_col = scene["ground_color"][None, None, :] * (0.6 + 0.4 * checker[..., None])
+    t_hit = np.where(hit_g, s_g, t_hit)
+    color = np.where(hit_g[..., None], g_col, color)
+    normal = np.where(hit_g[..., None],
+                      np.array([0.0, 0.0, 1.0])[None, None, :], normal)
+
+    # Lambertian shading with a fixed ambient floor; background stays white.
+    lam = np.clip(np.sum(normal * _LIGHT_DIR[None, None, :], axis=-1), 0, 1)
+    shaded = color * (0.35 + 0.65 * lam[..., None])
+    out = np.where(np.isfinite(t_hit)[..., None], shaded, color)
+    return (np.clip(out, 0, 1) * 255).astype(np.uint8)
+
+
+def write_raytraced_srn(root: str, num_instances: int = 8,
+                        views_per_instance: int = 24, image_size: int = 64,
+                        focal: float | None = None, seed: int = 0) -> str:
+    """Create an SRN directory tree of raytraced scenes.
+
+    Cameras orbit each scene at jittered azimuth/elevation/distance (views
+    cover the sphere the way SRN's cars trainset does), written in the same
+    layout as data/synthetic.py: root/inst_XX/{rgb,pose,intrinsics.txt}.
+    """
+    rng = np.random.default_rng(seed)
+    focal = focal if focal is not None else image_size * 1.2
+    K = np.array([[focal, 0, image_size / 2],
+                  [0, focal, image_size / 2],
+                  [0, 0, 1]], dtype=np.float64)
+    for i in range(num_instances):
+        inst = os.path.join(root, f"inst_{i:02d}")
+        os.makedirs(os.path.join(inst, "rgb"), exist_ok=True)
+        os.makedirs(os.path.join(inst, "pose"), exist_ok=True)
+        scene = random_scene(rng)
+        with open(os.path.join(inst, "intrinsics.txt"), "w") as fh:
+            fh.write(f"{focal} {image_size / 2} {image_size / 2} 0.\n")
+            fh.write("0. 0. 0.\n")
+            fh.write("1.\n")
+            fh.write(f"{image_size} {image_size}\n")
+        for v in range(views_per_instance):
+            az = 2 * np.pi * (v + rng.uniform(-0.3, 0.3)) / views_per_instance
+            el = rng.uniform(0.15, 0.7)
+            dist = rng.uniform(2.2, 3.0)
+            cam = np.array([
+                dist * np.cos(az) * np.cos(el),
+                dist * np.sin(az) * np.cos(el),
+                dist * np.sin(el),
+            ])
+            pose = look_at_pose(cam)
+            img = render_scene(scene, pose.astype(np.float64), K, image_size)
+            Image.fromarray(img).save(
+                os.path.join(inst, "rgb", f"{v:06d}.png"))
+            np.savetxt(os.path.join(inst, "pose", f"{v:06d}.txt"),
+                       pose, fmt="%.8f")
+    return root

@@ -1,0 +1,55 @@
+"""The pinned environment (constraints.txt) matches the running one.
+
+VERDICT r1 / SURVEY C13: the reference ships an exactly-pinned runtime
+(requirements.txt + Dockerfile); constraints.txt is this repo's equivalent.
+This test makes every CI/test run a check that the pins are real — if the
+environment drifts from the recorded known-good set, it fails loudly instead
+of silently validating an unrecorded combination.
+"""
+
+import importlib.metadata as md
+import os
+import re
+
+import pytest
+
+_CONSTRAINTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "constraints.txt")
+
+
+def _parse_pins():
+    pins = {}
+    with open(_CONSTRAINTS) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            line = line.split(";", 1)[0].strip()  # drop env markers
+            m = re.match(r"^([A-Za-z0-9_.-]+)==(\S+)$", line)
+            assert m, f"unparseable constraint line: {line!r}"
+            pins[m.group(1).lower()] = m.group(2)
+    return pins
+
+
+def test_constraints_file_parses_and_pins_core_stack():
+    pins = _parse_pins()
+    for core in ("jax", "jaxlib", "flax", "optax", "orbax-checkpoint",
+                 "numpy", "grain"):
+        assert core in pins, f"core dependency {core} missing a pin"
+
+
+def test_installed_versions_match_pins():
+    pins = _parse_pins()
+    mismatches = []
+    for name, want in pins.items():
+        try:
+            have = md.version(name)
+        except md.PackageNotFoundError:
+            continue  # optional on this platform (e.g. libtpu off-TPU)
+        if have != want:
+            mismatches.append(f"{name}: pinned {want}, installed {have}")
+    if mismatches:
+        pytest.fail(
+            "environment drifted from constraints.txt — update the pins "
+            "and re-validate, or fix the environment:\n  "
+            + "\n  ".join(mismatches))
