@@ -41,3 +41,8 @@ try:
     jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running (subprocess pod dryruns etc.)")

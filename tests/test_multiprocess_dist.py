@@ -47,21 +47,29 @@ from novel_view_synthesis_3d_tpu.train.state import create_train_state
 from novel_view_synthesis_3d_tpu.train.step import make_train_step
 from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
 
-# Establish the CPU (Gloo) collective context with a trivial all-reduce
-# BEFORE the big train-step compile: context setup requires both workers to
-# rendezvous within ~30s, and under heavy machine load the slower worker's
-# XUNet compile can miss that window. A tiny program compiles in <1s on
-# both sides, so the rendezvous happens while the workers are still in
-# lock-step; the context is cached for every later collective.
+# Gloo context rendezvous discipline: every NEW communicator clique does a
+# key-value rendezvous with a hard ~30s window (not configurable through
+# jax.distributed.initialize — only coordinator timeouts are). Any stage
+# where the two workers' wall-clock diverges by more than that (an XUNet
+# compile under machine load) must therefore be followed by a barrier()
+# BEFORE the next collective-creating call, so each fresh rendezvous starts
+# with the workers in lock-step. The warm all-reduce both establishes the
+# first context and doubles as that barrier (its program is cached after
+# the first call).
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 import numpy as np  # noqa: E402
 
 _warm_mesh = jax.sharding.Mesh(np.asarray(jax.devices()).reshape(8), ("d",))
-_warm = jax.make_array_from_process_local_data(
-    NamedSharding(_warm_mesh, P("d")), np.ones((4,), np.float32), (8,))
-_total = float(jax.device_get(jax.jit(
-    lambda x: x.sum(), out_shardings=NamedSharding(_warm_mesh, P()))(_warm)))
-assert _total == 8.0, _total
+_warm_sum = jax.jit(lambda x: x.sum(),
+                    out_shardings=NamedSharding(_warm_mesh, P()))
+
+def barrier():
+    w = jax.make_array_from_process_local_data(
+        NamedSharding(_warm_mesh, P("d")), np.ones((4,), np.float32), (8,))
+    total = float(jax.device_get(_warm_sum(w)))
+    assert total == 8.0, total
+
+barrier()
 
 cfg = Config(
     model=ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
@@ -79,13 +87,19 @@ local = {k: v[4 * pid:4 * pid + 4] for k, v in global_batch.items()}
 
 model = XUNet(cfg.model)
 state = create_train_state(cfg.train, model, _sample_model_batch(global_batch))
+barrier()  # init compile stagger ends here; replicate() rendezvouses fresh
 state = mesh_lib.replicate(mesh, state)
 step = make_train_step(cfg, model, make_schedule(cfg.diffusion), mesh)
 
 device_batch = mesh_lib.shard_batch(mesh, local)
+# AOT-compile the step so the heavy (possibly asymmetric-duration) compile
+# finishes BEFORE the execution that creates its communicators; the barrier
+# then bounds the rendezvous stagger to microseconds.
+compiled_step = step.lower(state, device_batch).compile()
+barrier()
 losses = []
 for _ in range(3):
-    state, m = step(state, device_batch)
+    state, m = compiled_step(state, device_batch)
     losses.append(float(jax.device_get(m["loss"])))
 assert np.isfinite(losses).all(), losses
 # Params must remain identical across processes: compare a checksum via a

@@ -1,0 +1,112 @@
+"""Real-geometry quality run: train on raytraced multi-view scenes, eval
+held-out views, commit the evidence (VERDICT r1 item 5).
+
+SRN ShapeNet cars (the external target, BASELINE.md) is not fetchable in
+this environment (no network egress), so the run uses data/raytrace.py —
+true 3-D scenes rendered through the framework's exact camera model, where
+held-out-view PSNR/SSIM genuinely measures novel-view synthesis (the model
+must map pose → appearance of a consistent scene, not recall a pattern).
+
+Scope note: with a handful of training instances the model fits the scenes
+it saw; the held-out VIEWS (1-in-3 split, data/prep.py) measure viewpoint
+generalization — the same protocol as eval on seen-instance SRN splits.
+
+Writes results/quality_r02/: eval_single.json, eval_autoregressive.json,
+samples_*.png grids, eval.csv (the in-training probe curve), summary.json.
+
+Usage: python tools/quality_run.py [out_dir] [steps] [size]
+       (defaults: results/quality_r02 3000 32; honors JAX_PLATFORMS)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "results", "quality_r02")
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3000
+    size = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+
+    from novel_view_synthesis_3d_tpu.cli import main as cli
+    from novel_view_synthesis_3d_tpu.data.prep import train_val_split
+    from novel_view_synthesis_3d_tpu.data.raytrace import write_raytraced_srn
+
+    work = tempfile.mkdtemp(prefix="quality_run_")
+    full = write_raytraced_srn(os.path.join(work, "full"), num_instances=6,
+                               views_per_instance=24, image_size=size,
+                               seed=7)
+    # 1-in-3 held-out view split per instance (reference semantics,
+    # data_util.py:75-98): train on 2/3 of each scene's views, evaluate on
+    # the unseen third.
+    train_root = os.path.join(work, "train")
+    val_root = os.path.join(work, "val")
+    for inst in sorted(os.listdir(full)):
+        train_val_split(os.path.join(full, inst),
+                        os.path.join(train_root, inst),
+                        os.path.join(val_root, inst))
+
+    overrides = [
+        "model.ch=32", "model.ch_mult=[1,2]", "model.emb_ch=64",
+        "model.num_res_blocks=2", f"model.attn_resolutions=[{size // 4}]",
+        f"data.img_sidelength={size}",
+        "train.batch_size=8", f"train.num_steps={steps}",
+        f"train.save_every={max(steps // 4, 1)}", "train.log_every=50",
+        f"train.eval_every={max(steps // 10, 1)}",
+        "train.eval_sample_steps=32",
+        f"train.sample_every={max(steps // 4, 1)}",
+        "diffusion.sample_timesteps=64",
+        f"train.checkpoint_dir={work}/ckpt",
+        f"train.results_folder={out_dir}",
+    ]
+    os.makedirs(out_dir, exist_ok=True)
+    print(f"training {steps} steps at {size}px on {train_root}", flush=True)
+    rc = cli(["train", train_root] + overrides)
+    if rc != 0:
+        raise SystemExit(f"train failed with rc={rc}")
+
+    results = {}
+    for protocol in ("single", "autoregressive"):
+        out_json = os.path.join(out_dir, f"eval_{protocol}.json")
+        rc = cli(["eval", val_root, "--out", out_json,
+                  "--protocol", protocol, "--views-per-instance", "4",
+                  "--sample-steps", "64", "--batch-size", "6", "--fid"]
+                 + overrides)
+        if rc != 0:
+            raise SystemExit(f"eval ({protocol}) failed with rc={rc}")
+        results[protocol] = json.load(open(out_json))
+        print(f"{protocol}: {results[protocol]}", flush=True)
+
+    # A sample grid from held-out conditioning for the eye.
+    cli(["sample", val_root, "--out", os.path.join(out_dir, "samples_val"),
+         "--num-views", "6", "--sample-steps", "64", "--gif"] + overrides)
+
+    with open(os.path.join(out_dir, "summary.json"), "w") as fh:
+        json.dump({
+            "dataset": "raytraced spheres+plane (data/raytrace.py), "
+                       "6 instances x 24 views, 1-in-3 held-out view split",
+            "img_size": size, "train_steps": steps,
+            "platform": jax.devices()[0].platform,
+            "eval": results,
+        }, fh, indent=2)
+    shutil.rmtree(work, ignore_errors=True)
+    print("quality run complete:", json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

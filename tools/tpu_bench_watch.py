@@ -1,0 +1,141 @@
+"""Wait for the TPU tunnel to revive, then run the round-2 bench matrix.
+
+Round-1 postmortem (docs/DESIGN.md, memory): the axon tunnel wedged mid-run
+and stayed dead for hours; children stuck on it enter uninterruptible sleep
+(SIGKILL unreapable). So this watcher:
+
+  - probes with a REAL computation in a disposable child (backend init has
+    been observed succeeding while the first execution hangs);
+  - uses Popen.wait(timeout) everywhere and abandons stuck children;
+  - runs the matrix SEQUENTIALLY with generous timeouts, never killing a
+    bench mid-computation unless its timeout expires (a killed mid-run
+    bench is the suspected round-1 wedge trigger);
+  - appends every result line to results/tpu_r02/log.txt and drops each
+    bench's JSON into results/tpu_r02/.
+
+Matrix (VERDICT r1 items 1-3):
+  tiny64 train, base128 remat={False,True,dots}, paper256 (the BASELINE
+  metric), tiny64 256-step sampling, base128 profile.
+
+Usage: python tools/tpu_bench_watch.py [max_wait_hours]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "results", "tpu_r02")
+PROBE_INTERVAL_S = 300
+PROBE_TIMEOUT_S = 120
+
+MATRIX = [
+    # (name, bench.py argv, timeout_s)
+    ("tiny64_train", ["tiny64", "30"], 1800),
+    ("base128_remat_off", ["base128", "20", "model.remat=False"], 2400),
+    ("base128_remat_full", ["base128", "20", "model.remat=True"], 2400),
+    ("base128_remat_dots", ["base128", "20", "model.remat=dots"], 2400),
+    ("paper256_train", ["paper256", "10"], 3600),
+    ("sample_tiny64_256", ["sample", "tiny64", "256"], 2400),
+    ("profile_base128", ["profile", "base128", "5"], 2400),
+]
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    os.makedirs(OUT, exist_ok=True)
+    with open(os.path.join(OUT, "log.txt"), "a") as fh:
+        fh.write(line + "\n")
+
+
+def probe_alive() -> bool:
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((256, 256)); "
+            "print(float((x @ x).sum()), jax.devices()[0].platform)")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = proc.communicate(timeout=PROBE_TIMEOUT_S)
+        if proc.returncode == 0 and "cpu" not in out:
+            log(f"probe OK: {out.strip()}")
+            return True
+        log(f"probe rc={proc.returncode} out={out.strip()!r} (cpu or fail)")
+        return False
+    except subprocess.TimeoutExpired:
+        proc.kill()  # child may be unreapable; abandon
+        log("probe timed out — tunnel still wedged")
+        return False
+
+
+def run_bench(name: str, argv: list, timeout_s: int) -> bool:
+    log(f"running {name}: bench.py {' '.join(argv)}")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # use the real accelerator
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/nvs3d_jax_cache")
+    out_path = os.path.join(OUT, f"{name}.out")
+    with open(out_path, "w") as fh:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "bench.py")] + argv,
+            stdout=fh, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            log(f"{name}: TIMED OUT after {timeout_s}s (output in {out_path})")
+            return False
+    tail = open(out_path).read().strip().splitlines()
+    result = next((ln for ln in reversed(tail) if ln.startswith("{")), None)
+    log(f"{name}: rc={rc} result={result}")
+    platform = None
+    if result:
+        try:
+            platform = json.loads(result).get("platform")
+        except json.JSONDecodeError:
+            pass
+        with open(os.path.join(OUT, f"{name}.json"), "w") as fh:
+            fh.write(result + "\n")
+    if platform == "cpu":
+        # bench.py's own liveness probe fell back to CPU mid-matrix: exit-0
+        # CPU numbers must NOT count as TPU evidence (VERDICT r1 weak #1).
+        log(f"{name}: completed on CPU fallback — counting as failure")
+        return False
+    return rc == 0
+
+
+def main() -> None:
+    max_wait_h = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
+    deadline = time.time() + max_wait_h * 3600
+    log(f"watching for TPU (max {max_wait_h:.1f}h)")
+    done = set()
+    while time.time() < deadline:
+        if probe_alive():
+            log("TPU alive — running matrix")
+            results = {}
+            for name, argv, timeout_s in MATRIX:
+                if name in done:
+                    continue  # resume after a mid-matrix tunnel death
+                ok = run_bench(name, argv, timeout_s)
+                results[name] = ok
+                if ok:
+                    done.add(name)
+                elif not probe_alive():
+                    log("tunnel died mid-matrix; resuming watch")
+                    break
+            else:
+                log(f"matrix complete: {json.dumps(sorted(done))}")
+                return
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            break
+        time.sleep(min(PROBE_INTERVAL_S, remaining))
+    log("deadline reached without completing the matrix")
+
+
+if __name__ == "__main__":
+    main()
