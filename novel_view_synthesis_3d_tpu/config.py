@@ -147,6 +147,14 @@ class TrainConfig:
     # Optimizer
     optimizer: str = "adam"
     grad_clip: float = 0.0  # 0 = off
+    # Adam first-moment (m) storage dtype. 'bfloat16' halves m's HBM
+    # footprint (0.5× param bytes saved) with negligible quality impact —
+    # m is a fast EMA (β₁=0.9) whose per-step relative increments are well
+    # above bf16 resolution. The second moment v stays f32 (its increments
+    # are squared-gradient-scale and underflow bf16), and so does the
+    # sampling EMA (decay 0.9999 increments sit below bf16 ulp — a bf16
+    # EMA would freeze). Default f32 = exact reference-equivalent Adam.
+    adam_mu_dtype: str = "float32"
     warmup_steps: int = 0
     # LR decay after warmup: 'constant' (reference behavior, train.py:46)
     # or 'cosine' (decay to lr_final_fraction·lr over num_steps).
@@ -269,6 +277,10 @@ class Config:
                 "train.eval_every is set")
         if t.batch_size < 1:
             errors.append("train.batch_size must be >= 1")
+        if t.adam_mu_dtype not in ("float32", "bfloat16"):
+            errors.append(
+                f"train.adam_mu_dtype={t.adam_mu_dtype!r} must be "
+                "'float32' or 'bfloat16'")
         if not 0.0 <= t.cond_drop_prob <= 1.0:
             errors.append(
                 f"train.cond_drop_prob={t.cond_drop_prob} outside [0, 1]")
@@ -396,7 +408,10 @@ def get_preset(name: str) -> Config:
             # chip with remat. On an N-chip mesh the effective accumulation
             # shrinks automatically (per-chip memory already scales as 1/N).
             train=TrainConfig(batch_size=8, ema_decay=0.9999,
-                              grad_accum_steps=8),
+                              grad_accum_steps=8,
+                              # 0.5x param bytes of HBM back on the 16G
+                              # chip; see TrainConfig.adam_mu_dtype.
+                              adam_mu_dtype="bfloat16"),
             diffusion=DiffusionConfig(sample_timesteps=256),
         )
     if name == "pod64":

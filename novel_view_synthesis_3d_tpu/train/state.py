@@ -60,7 +60,8 @@ def make_optimizer(cfg: TrainConfig, return_schedule: bool = False):
     parts = []
     if cfg.grad_clip > 0:
         parts.append(optax.clip_by_global_norm(cfg.grad_clip))
-    parts.append(optax.adam(schedule))
+    parts.append(optax.adam(
+        schedule, mu_dtype=jnp.dtype(cfg.adam_mu_dtype)))
     tx = optax.chain(*parts)
     return (tx, schedule) if return_schedule else tx
 

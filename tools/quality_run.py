@@ -93,8 +93,12 @@ def main() -> None:
         print(f"{protocol}: {results[protocol]}", flush=True)
 
     # A sample grid from held-out conditioning for the eye.
-    cli(["sample", val_root, "--out", os.path.join(out_dir, "samples_val"),
-         "--num-views", "6", "--sample-steps", "64", "--gif"] + overrides)
+    rc = cli(["sample", val_root,
+              "--out", os.path.join(out_dir, "samples_val"),
+              "--num-views", "6", "--sample-steps", "64", "--gif"]
+             + overrides)
+    if rc != 0:
+        raise SystemExit(f"sample failed with rc={rc}")
 
     with open(os.path.join(out_dir, "summary.json"), "w") as fh:
         json.dump({

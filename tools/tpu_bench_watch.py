@@ -57,7 +57,11 @@ def probe_alive() -> bool:
     code = ("import jax, jax.numpy as jnp; "
             "x = jnp.ones((256, 256)); "
             "print(float((x @ x).sum()), jax.devices()[0].platform)")
-    proc = subprocess.Popen([sys.executable, "-c", code],
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # probe the real accelerator, like
+    # run_bench does — an ambient cpu pin would otherwise make the probe
+    # report 'cpu' forever and the watcher would never run a bench.
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
                             stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, text=True)
     try:
@@ -113,28 +117,33 @@ def main() -> None:
     deadline = time.time() + max_wait_h * 3600
     log(f"watching for TPU (max {max_wait_h:.1f}h)")
     done = set()
+    failed = set()
     while time.time() < deadline:
         if probe_alive():
             log("TPU alive — running matrix")
-            results = {}
             for name, argv, timeout_s in MATRIX:
-                if name in done:
+                if name in done or name in failed:
                     continue  # resume after a mid-matrix tunnel death
-                ok = run_bench(name, argv, timeout_s)
-                results[name] = ok
-                if ok:
+                if run_bench(name, argv, timeout_s):
                     done.add(name)
-                elif not probe_alive():
+                elif probe_alive():
+                    # The bench itself failed (OOM, timeout, bug) with the
+                    # tunnel healthy — retrying won't change the outcome.
+                    failed.add(name)
+                    log(f"{name}: failed with tunnel alive — not retrying")
+                else:
                     log("tunnel died mid-matrix; resuming watch")
                     break
-            else:
-                log(f"matrix complete: {json.dumps(sorted(done))}")
+            if len(done) + len(failed) == len(MATRIX):
+                log(f"matrix finished: ok={json.dumps(sorted(done))} "
+                    f"failed={json.dumps(sorted(failed))}")
                 return
         remaining = deadline - time.time()
         if remaining <= 0:
             break
         time.sleep(min(PROBE_INTERVAL_S, remaining))
-    log("deadline reached without completing the matrix")
+    log(f"deadline reached: ok={json.dumps(sorted(done))} "
+        f"failed={json.dumps(sorted(failed))}")
 
 
 if __name__ == "__main__":
