@@ -306,6 +306,48 @@ def cmd_config(args, overrides: List[str]) -> int:
 
 
 # ---------------------------------------------------------------------------
+# export (checkpoint → reference format)
+# ---------------------------------------------------------------------------
+def cmd_export(args, overrides: List[str]) -> int:
+    """Write a trained checkpoint as a reference-format flax msgpack file.
+
+    The inverse of --reference-ckpt: a file the reference codebase's
+    restore path (sampling.py:104-114) can consume — bare param dict,
+    3-D (1,3,3) conv kernels, reference module naming. EMA params are
+    exported when present (they are what you sample with).
+    """
+    import jax
+    import numpy as np
+
+    from flax import serialization
+
+    from novel_view_synthesis_3d_tpu.compat.reference_ckpt import (
+        export_reference_params)
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    cfg = build_config(args, overrides)
+    if cfg.model.num_cond_frames != 1:
+        raise SystemExit(
+            "export: the reference format is strictly two-frame (k=1); "
+            f"model.num_cond_frames={cfg.model.num_cond_frames}")
+    model = XUNet(cfg.model)
+    sample_batch = _sample_model_batch(make_example_batch(
+        batch_size=1, sidelength=cfg.data.img_sidelength))
+    params, step = _restore_params(cfg, model, sample_batch, args.step)
+    ref_tree = export_reference_params(jax.tree.map(np.asarray, params))
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "wb") as fh:
+        fh.write(serialization.msgpack_serialize(ref_tree))
+    n = sum(np.asarray(leaf).size
+            for leaf in jax.tree.leaves(ref_tree))
+    print(f"exported step-{step} params ({n:,} values) to {args.out} "
+          "(reference flax msgpack layout)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -401,6 +443,15 @@ def make_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("config", help="print the resolved config JSON")
     _add_common(p)
 
+    p = sub.add_parser("export",
+                       help="write a checkpoint as a reference-format flax "
+                            "msgpack file (inverse of --reference-ckpt)")
+    _add_common(p)
+    p.add_argument("--out", required=True,
+                   help="output path (e.g. checkpoints_ref/model50000)")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: latest)")
+
     return parser
 
 
@@ -410,6 +461,7 @@ _COMMANDS = {
     "eval": cmd_eval,
     "prep": cmd_prep,
     "config": cmd_config,
+    "export": cmd_export,
 }
 
 

@@ -215,6 +215,49 @@ def test_cli_train_sample_eval_e2e(cli_workspace, capsys):
     assert result["num_views"] == 4
     assert np.isfinite(result["psnr"])
 
+    # Export back to the reference's msgpack layout and re-import: the
+    # round trip through compat/reference_ckpt must reproduce the trained
+    # params exactly.
+    import jax
+
+    from novel_view_synthesis_3d_tpu.compat.reference_ckpt import (
+        load_reference_checkpoint)
+
+    ref_path = str(tmp / "exported" / "model2")
+    assert main(["export", "--out", ref_path]
+                + _tiny_overrides(tmp)) == 0
+    reimported = load_reference_checkpoint(ref_path)
+    capsys.readouterr()  # drop the export notice
+
+    # The reimported tree must equal the TRAINED params leaf-for-leaf (a
+    # transposed kernel or misrouted scope would still be finite — compare
+    # against the checkpoint itself, via the same restore path export used).
+    from novel_view_synthesis_3d_tpu.cli import (
+        _restore_params, build_config)
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    class _A:  # minimal args shim for build_config
+        preset = None
+        config = None
+
+    cfg = build_config(_A(), _tiny_overrides(tmp))
+    trained, step = _restore_params(
+        cfg, XUNet(cfg.model),
+        _sample_model_batch(make_example_batch(batch_size=1, sidelength=16)),
+        None)
+    assert step == 2
+    flat_t = jax.tree.leaves(jax.tree.map(np.asarray, trained))
+    flat_r = jax.tree.leaves(jax.tree.map(np.asarray, reimported))
+    assert len(flat_t) == len(flat_r)
+    for a, b in zip(flat_t, flat_r):
+        np.testing.assert_array_equal(a, b)
+
+    assert main(["sample", root, "--out", str(tmp / "s2"), "--num-views",
+                 "1", "--sample-steps", "2", "--reference-ckpt", ref_path]
+                + _tiny_overrides(tmp)) == 0
+
 
 def test_cli_sample_without_checkpoint_fails(cli_workspace, tmp_path):
     root = str(cli_workspace / "srn")

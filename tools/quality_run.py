@@ -100,16 +100,22 @@ def main() -> None:
     if rc != 0:
         raise SystemExit(f"sample failed with rc={rc}")
 
+    summary = {
+        "metric": "quality_heldout_psnr",
+        "value": results["single"]["psnr"],
+        "unit": "dB",
+        "platform": jax.devices()[0].platform,
+        "dataset": "raytraced spheres+plane (data/raytrace.py), "
+                   "6 instances x 24 views, 1-in-3 held-out view split",
+        "img_size": size, "train_steps": steps,
+        "eval": results,
+    }
     with open(os.path.join(out_dir, "summary.json"), "w") as fh:
-        json.dump({
-            "dataset": "raytraced spheres+plane (data/raytrace.py), "
-                       "6 instances x 24 views, 1-in-3 held-out view split",
-            "img_size": size, "train_steps": steps,
-            "platform": jax.devices()[0].platform,
-            "eval": results,
-        }, fh, indent=2)
+        json.dump(summary, fh, indent=2)
     shutil.rmtree(work, ignore_errors=True)
-    print("quality run complete:", json.dumps(results), flush=True)
+    # Single JSON line LAST, with the platform tag: the bench watcher
+    # parses it and refuses to count a CPU-fallback run as TPU evidence.
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":
