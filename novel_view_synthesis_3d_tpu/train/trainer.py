@@ -173,7 +173,6 @@ class Trainer:
         # the step loop checkpoint + exit cleanly; combined with
         # resume=True the run continues from the last step after reschedule.
         self._preempted = False
-        self._probe_warned = False
         if tcfg.handle_preemption:
             try:
                 signal.signal(signal.SIGTERM, self._on_preempt)
@@ -260,15 +259,22 @@ class Trainer:
                 # crash on non-fully-addressable arrays in multi-host runs.
                 self.ckpt.save(step_now, self.state)
 
-            if tcfg.sample_every and step_now % tcfg.sample_every == 0:
-                if self._probe_supported():
-                    self.dump_samples(step_now)
-
-            if tcfg.eval_every and step_now % tcfg.eval_every == 0:
-                if self._probe_supported():
-                    logged = self.eval_step(step_now)
-                    print(f"{step_now}: eval psnr={logged['psnr']:.2f} "
-                          f"ssim={logged['ssim']:.4f}")
+            sample_due = (tcfg.sample_every
+                          and step_now % tcfg.sample_every == 0)
+            eval_due = tcfg.eval_every and step_now % tcfg.eval_every == 0
+            if sample_due or eval_due:
+                # Called on EVERY host: non-reporting hosts join the param
+                # replication collective and get None back. Gathered ONCE
+                # even when both probes fire (on a pod each gather is a
+                # full cross-host all-gather of the param tree).
+                probe_params = self._probe_host_params()
+                if sample_due:
+                    self.dump_samples(step_now, params=probe_params)
+                if eval_due:
+                    logged = self.eval_step(step_now, params=probe_params)
+                    if logged is not None:
+                        print(f"{step_now}: eval psnr={logged['psnr']:.2f} "
+                              f"ssim={logged['ssim']:.4f}")
 
             if self._preempt_agreed():
                 print(f"preemption signal received at step {step_now}: "
@@ -286,29 +292,34 @@ class Trainer:
         if timing:
             print(f"step timing: {timing}")
 
-    def _probe_supported(self) -> bool:
-        """In-loop sample/eval probes are single-process only.
+    def _probe_host_params(self):
+        """Sampling params for the in-loop probes, pod-safe.
 
-        The probe path (`_sample_cond`) jits a dense sampler over the
-        (possibly FSDP globally-sharded) params with a host-local probe
-        batch, then device_gets the output. In a multi-host run each
-        process would feed a *different* probe batch into a collective
-        program and fetch a non-fully-addressable array — a crash or hang
-        mid-training. `evaluate_dataset(mesh=...)` raises explicitly for
-        process_count>1; this gate skips the in-loop probes the same way
-        (with a one-time warning) instead of dying at step `eval_every`."""
+        Single-process: returns the live (possibly device-sharded) params.
+        Multi-process (pods): the naive probe would feed per-host batches
+        into a collective program and device_get non-addressable outputs —
+        a mid-training crash or hang. Instead EVERY host joins one
+        replication collective here (FSDP shards → fully-replicated,
+        riding ICI/DCN — so the train loop must call the probe on every
+        host at the same step), then process 0 alone fetches the now
+        host-addressable copy and samples on its own devices with zero
+        collectives inside the sampler; other hosts get None and return
+        early — no multi-writer eval.csv, no mismatched collectives."""
+        params = (self.state.ema_params if self.state.ema_params is not None
+                  else self.state.params)
         if jax.process_count() == 1:
-            return True
-        if not self._probe_warned:
-            self._probe_warned = True
-            if jax.process_index() == 0:
-                print("warning: in-loop sample/eval probes are disabled for "
-                      "multi-process runs (use the `eval` CLI on a single "
-                      "host against a checkpoint instead)")
-        return False
+            return params
+        replicated = mesh_lib.replicate(self.mesh, params)
+        jax.block_until_ready(replicated)
+        if jax.process_index() != 0:
+            return None
+        return jax.device_get(replicated)
 
     # ------------------------------------------------------------------
-    def eval_step(self, step: int, num: int = 4) -> dict:
+    _UNSET = object()  # "gather the probe params yourself" sentinel
+
+    def eval_step(self, step: int, num: int = 4,
+                  params=_UNSET) -> Optional[dict]:
         """In-loop quality probe on a FIXED batch of training views.
 
         Samples the probe batch's target poses and scores PSNR/SSIM against
@@ -320,6 +331,10 @@ class Trainer:
         training (SURVEY.md §5.5)."""
         from novel_view_synthesis_3d_tpu.eval.metrics import psnr, ssim
 
+        if params is Trainer._UNSET:
+            params = self._probe_host_params()  # collective: all hosts call
+        if params is None:
+            return None  # non-reporting host of a multi-process run
         if self._eval_batch is None:  # direct eval_step call, eval_every=0
             self._eval_batch = jax.tree.map(np.array, self._peek_batch())
         batch = self._eval_batch
@@ -327,7 +342,8 @@ class Trainer:
         imgs = self._sample_cond(
             {k: jnp.asarray(batch[k][:num])
              for k in ("x", "R1", "t1", "R2", "t2", "K")},
-            seed=step, sample_steps=self.config.train.eval_sample_steps)
+            seed=step, sample_steps=self.config.train.eval_sample_steps,
+            params=params)
         truth = np.asarray(batch["target"][:num])
         logged = {
             "psnr": float(np.mean(psnr(imgs, truth))),
@@ -336,7 +352,7 @@ class Trainer:
         self.metrics.log_eval(step, logged)
         return logged
 
-    def _sample_cond(self, cond: dict, seed: int,
+    def _sample_cond(self, cond: dict, seed: int, *, params,
                      sample_steps: Optional[int] = None) -> np.ndarray:
         """Sample novel views for a conditioning dict with current params.
 
@@ -344,7 +360,10 @@ class Trainer:
         and identical params, but free of the batch/'data'-axis
         divisibility constraint the ring path imposes (a 4-view probe need
         not divide the mesh). Samplers are cached per sample_steps — a
-        fresh make_sampler closure would recompile its scan on every call."""
+        fresh make_sampler closure would recompile its scan on every call.
+
+        `params` comes from `_probe_host_params` (host-local on pods, so
+        the sampler never emits a cross-host collective)."""
         key = (self.config.diffusion.sample_timesteps
                if sample_steps is None else sample_steps)
         sampler = self._samplers.get(key)
@@ -359,18 +378,25 @@ class Trainer:
                                    sampling_schedule(dcfg, sample_steps),
                                    dcfg)
             self._samplers[key] = sampler
-        params = (self.state.ema_params if self.state.ema_params is not None
-                  else self.state.params)
         imgs = sampler(params, jax.random.PRNGKey(seed), cond)
         return np.asarray(jax.device_get(imgs))
 
     def dump_samples(self, step: int, num: int = 4,
-                     sample_steps: Optional[int] = None) -> str:
-        """Sample novel views for the first records and write a PNG grid."""
+                     sample_steps: Optional[int] = None,
+                     params=_UNSET) -> Optional[str]:
+        """Sample novel views for the first records and write a PNG grid.
+
+        Call on every host (the param gather inside is collective); only
+        process 0 writes and returns a path."""
+        if params is Trainer._UNSET:
+            params = self._probe_host_params()
+        if params is None:
+            return None
         batch = self._peek_batch()
         cond = {k: jnp.asarray(batch[k][:num])
                 for k in ("x", "R1", "t1", "R2", "t2", "K")}
-        imgs = self._sample_cond(cond, seed=step, sample_steps=sample_steps)
+        imgs = self._sample_cond(cond, seed=step, sample_steps=sample_steps,
+                                 params=params)
         path = os.path.join(self.results_folder, f"samples_{step:07d}.png")
         save_image_grid(imgs, path)
         return path

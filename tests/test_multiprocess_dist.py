@@ -109,6 +109,36 @@ checksum = float(jax.tree.reduce(
     lambda a, b: a + b,
     jax.tree.map(lambda x: float(np.sum(np.abs(x))), host_params)))
 print(f"RESULT {pid} losses={losses} checksum={checksum:.6f}", flush=True)
+
+# --- pod-safe in-loop probe (trainer._probe_host_params path) ---
+# Every host joins the replication collective; only process 0 samples.
+# Build a minimal Trainer around a synthetic iterator on this topology.
+import itertools, tempfile
+from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+tdir = tempfile.mkdtemp(prefix=f"probe{pid}_")
+probe_cfg = cfg.override(**{
+    "diffusion.sample_timesteps": 2, "train.eval_sample_steps": 2,
+    "train.num_steps": 1, "train.save_every": 0, "train.log_every": 1,
+    "train.eval_every": 0, "train.sample_every": 0,
+    # FSDP so the probe's replicate() is a REAL cross-process all-gather
+    # of non-fully-addressable shards, not a no-op reshard.
+    "train.fsdp": True,
+    "train.results_folder": tdir, "train.checkpoint_dir": tdir + "/ck",
+    "train.handle_preemption": False, "train.resume": False,
+})
+local_iter = itertools.repeat(local)
+barrier()
+trainer = Trainer(config=probe_cfg, data_iter=local_iter)
+barrier()  # trainer setup (init compile) staggers; resync before probing
+out_eval = trainer.eval_step(0)
+path = trainer.dump_samples(0, num=2, sample_steps=2)
+if pid == 0:
+    assert out_eval is not None and np.isfinite(out_eval["psnr"])
+    assert path is not None and __import__("os").path.exists(path)
+else:
+    assert out_eval is None and path is None
+print(f"PROBE {pid} ok={out_eval}", flush=True)
 """
 
 
