@@ -313,7 +313,12 @@ class Trainer:
         jax.block_until_ready(replicated)
         if jax.process_index() != 0:
             return None
-        return jax.device_get(replicated)
+        # Pin the gathered copy on ONE local device: the probe samplers are
+        # single-device programs, and handing them host numpy would re-pay
+        # the host→device transfer per sampler call (2× when sample and
+        # eval probes coincide).
+        return jax.device_put(jax.device_get(replicated),
+                              jax.local_devices()[0])
 
     # ------------------------------------------------------------------
     _UNSET = object()  # "gather the probe params yourself" sentinel

@@ -38,9 +38,8 @@ GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "golden", "reference_xunet.npz")
 
 
-@pytest.fixture(scope="module")
-def golden():
-    data = np.load(GOLDEN)
+def _load_golden(path):
+    data = np.load(path)
     ref_params = {}
     batch = {}
     for key in data.files:
@@ -58,6 +57,11 @@ def golden():
         "cond_mask": data["cond_mask"],
         "output": data["output"],
     }
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return _load_golden(GOLDEN)
 
 
 @pytest.fixture(scope="module")
@@ -125,6 +129,28 @@ def test_strip_replica_axis(golden):
     # Already-unreplicated trees pass through untouched.
     assert_trees_match(strip_replica_axis(golden["ref_params"]),
                        golden["ref_params"])
+
+
+def test_forward_parity_with_learned_embeddings():
+    """Same parity proof with use_pos_emb + use_ref_pose_emb ON — covers
+    the optional pos_emb / ref_pose_emb_{first,other} param mapping that
+    the default golden never creates."""
+    import dataclasses
+
+    g = _load_golden(GOLDEN.replace(".npz", "_posemb.npz"))
+    cfg = get_preset("reference")
+    model = XUNet(dataclasses.replace(
+        cfg.model, use_pos_emb=True, use_ref_pose_emb=True))
+    imported = import_reference_params(g["ref_params"])
+    template = jax.tree.map(
+        np.asarray, _init_template(model, g["batch"], g["cond_mask"]))
+    assert _paths(imported) == _paths(template)
+    out = model.apply(
+        {"params": jax.tree.map(jnp.asarray, imported)},
+        {k: jnp.asarray(v) for k, v in g["batch"].items()},
+        cond_mask=jnp.asarray(g["cond_mask"]), train=False)
+    np.testing.assert_allclose(np.asarray(out), g["output"],
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_load_reference_checkpoint_file(golden, ref_model, tmp_path):

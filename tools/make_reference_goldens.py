@@ -106,14 +106,8 @@ def make_batch(B=2, S=16, seed=0):
     }
 
 
-def main() -> None:
-    jax.config.update("jax_platforms", "cpu")
-    _install_visu3d_shim()
-    ref = _load_reference_model()
-
-    batch = make_batch()
-    cond_mask = np.array([1.0, 0.0], np.float32)  # exercise the CFG zeroing
-    model = ref.XUNet()  # reference defaults: ch=32, ch_mult=(1,2), emb 32
+def _capture(ref, batch, cond_mask, out_path, **model_kwargs) -> None:
+    model = ref.XUNet(**model_kwargs)
     variables = model.init(
         {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
         {k: jnp.asarray(v) for k, v in batch.items()},
@@ -138,11 +132,28 @@ def main() -> None:
         arrays[f"batch:{k}"] = v
     arrays["cond_mask"] = cond_mask
     arrays["output"] = np.asarray(out)
-    os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    np.savez_compressed(OUT, **arrays)
-    print(f"wrote {OUT}: {len(flat)} param leaves, {n_params:,} params, "
-          f"output shape {np.asarray(out).shape}, "
-          f"{os.path.getsize(OUT) / 1e6:.2f} MB")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    np.savez_compressed(out_path, **arrays)
+    print(f"wrote {out_path}: {len(flat)} param leaves, {n_params:,} "
+          f"params, output shape {np.asarray(out).shape}, "
+          f"{os.path.getsize(out_path) / 1e6:.2f} MB")
+
+
+def main() -> None:
+    jax.config.update("jax_platforms", "cpu")
+    _install_visu3d_shim()
+    ref = _load_reference_model()
+
+    batch = make_batch()
+    cond_mask = np.array([1.0, 0.0], np.float32)  # exercise the CFG zeroing
+    # Reference defaults (ch=32, ch_mult=(1,2), emb 32) — the published
+    # pretrained model's config.
+    _capture(ref, batch, cond_mask, OUT)
+    # Optional learned embeddings ON — covers the pos_emb /
+    # ref_pose_emb_{first,other} param mapping the defaults never create.
+    _capture(ref, batch, cond_mask,
+             OUT.replace(".npz", "_posemb.npz"),
+             use_pos_emb=True, use_ref_pose_emb=True)
 
 
 if __name__ == "__main__":
