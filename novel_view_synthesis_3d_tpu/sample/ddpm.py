@@ -37,17 +37,35 @@ import jax.numpy as jnp
 
 from novel_view_synthesis_3d_tpu.config import DiffusionConfig
 from novel_view_synthesis_3d_tpu.diffusion.schedules import DiffusionSchedule
+from novel_view_synthesis_3d_tpu.models.xunet import precompute_pose_embs
 
 
-def _cfg_eps(model, params, model_batch: dict, w: float, dropout_rng=None):
+def _cfg_eps(model, params, model_batch: dict, w: float,
+             pose_embs=None):
     """(guided, conditional) network outputs; CFG via one doubled-batch
-    forward. The conditional output rides along for cfg_rescale."""
+    forward. The conditional output rides along for cfg_rescale.
+
+    `pose_embs`: per-level pose embeddings already computed for the
+    DOUBLED (cond+uncond) layout — injected after the doubling so they are
+    not concatenated twice. See models/xunet.precompute_pose_embs."""
     B = model_batch["z"].shape[0]
     doubled = jax.tree.map(lambda a: jnp.concatenate([a, a], axis=0), model_batch)
     mask = jnp.concatenate([jnp.ones((B,)), jnp.zeros((B,))])
+    if pose_embs is not None:
+        doubled["pose_embs"] = pose_embs
     eps = model.apply({"params": params}, doubled, cond_mask=mask, train=False)
     eps_cond, eps_uncond = jnp.split(eps, 2, axis=0)
     return (1.0 + w) * eps_cond - w * eps_uncond, eps_cond
+
+
+def _doubled_pose_embs(model, params, cond: dict):
+    """Pose embeddings for _cfg_eps's doubled layout, computed once per
+    trajectory: conditional half with the mask on, unconditional half with
+    the pose embedding zeroed — exactly what the in-loop mask produced."""
+    B = cond["x"].shape[0]
+    doubled = jax.tree.map(lambda a: jnp.concatenate([a, a], axis=0), cond)
+    mask = jnp.concatenate([jnp.ones((B,)), jnp.zeros((B,))])
+    return precompute_pose_embs(model, params, doubled, mask)
 
 
 def _posterior_sample(schedule: DiffusionSchedule, x0, z, t, key):
@@ -146,12 +164,12 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
         raise ValueError(
             f"trajectory_every must be in [0, {T}]; got {trajectory_every}")
 
-    def body(cond, params, carry, t):
+    def body(cond, params, pose_embs, carry, t):
         z, key = carry
         key, k_step = jax.random.split(key)
         batch = dict(cond, z=z,
                      logsnr=jnp.full((z.shape[0],), schedule.logsnr(t)))
-        outs = _cfg_eps(model, params, batch, w)
+        outs = _cfg_eps(model, params, batch, w, pose_embs=pose_embs)
         z = update(z, t, outs, k_step)
         return (z, key), None
 
@@ -161,7 +179,11 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
         key, k_init = jax.random.split(key)
         z0 = jax.random.normal(k_init, z_shape)
         ts = jnp.arange(T - 1, -1, -1)
-        step = partial(body, cond, params)
+        # Cameras are fixed for the whole reverse process: compute the
+        # pose-conditioning path (rays → posenc → per-level convs) ONCE
+        # here instead of every scan step — pure win, identical math.
+        pose_embs = _doubled_pose_embs(model, params, cond)
+        step = partial(body, cond, params, pose_embs)
 
         if not trajectory_every:
             (z, _), _ = jax.lax.scan(step, (z0, key), ts)
@@ -188,13 +210,24 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
 
 
 def make_stochastic_sampler(model, schedule: DiffusionSchedule,
-                            config: DiffusionConfig, max_pool: int):
+                            config: DiffusionConfig, max_pool: int,
+                            precompute_pose: Optional[bool] = None):
     """Sampler with 3DiM stochastic conditioning over a view pool.
 
     cond pool: x (B, max_pool, H, W, 3), R1 (B, max_pool, 3, 3),
     t1 (B, max_pool, 3); `num_views` (traced scalar ≤ max_pool) bounds the
     per-step random choice, so one compiled program serves a growing pool
     (autoregressive generation never recompiles).
+
+    `precompute_pose`: hoist the pose-conditioning path out of the scan —
+    embeddings for every (pool view, target) pair are computed once and
+    indexed per step, and the unconditional CFG half is computed once
+    through the real masked pipeline (conv biases and learned pos/ref
+    embeddings survive the mask, so it is NOT zeros). Identical math to
+    the in-loop path; costs max_pool× pose-embedding HBM residency for the
+    whole trajectory, so None (default) auto-disables when that exceeds
+    ~512 MB (e.g. 256px paper-scale pools) and falls back to in-loop
+    computation.
     """
     w = config.guidance_weight
     update = _make_update(schedule, config)
@@ -202,10 +235,46 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
     @partial(jax.jit, static_argnames=())
     def sample(params, key, pool: dict, target_pose: dict,
                num_views: jnp.ndarray) -> jnp.ndarray:
-        B, _, H, W, C = pool["x"].shape
+        B, P, H, W, C = pool["x"].shape
         key, k_init = jax.random.split(key)
         z0 = jax.random.normal(k_init, (B, H, W, C))
         ts = jnp.arange(schedule.num_timesteps - 1, -1, -1)
+
+        do_pre = precompute_pose
+        if do_pre is None:
+            # Level-0 embedding is (B, P, F, H, W, emb_ch); finer levels
+            # add ~1/3 more. Auto-disable past ~512 MB residency.
+            mcfg = model.config
+            itemsize = jnp.dtype(mcfg.dtype).itemsize
+            est = (4 / 3) * B * P * 2 * H * W * mcfg.emb_ch * itemsize
+            do_pre = est <= 512 * 1024 * 1024
+
+        pose_all = uncond_embs = None
+        if do_pre:
+            flat = {
+                "x": pool["x"].reshape(B * P, H, W, C),
+                "R1": pool["R1"].reshape(B * P, 3, 3),
+                "t1": pool["t1"].reshape(B * P, 3),
+                "R2": jnp.broadcast_to(target_pose["R2"][:, None],
+                                       (B, P, 3, 3)).reshape(B * P, 3, 3),
+                "t2": jnp.broadcast_to(target_pose["t2"][:, None],
+                                       (B, P, 3)).reshape(B * P, 3),
+                "K": jnp.broadcast_to(target_pose["K"][:, None],
+                                      (B, P, 3, 3)).reshape(B * P, 3, 3),
+            }
+            pose_all = [p.reshape((B, P) + p.shape[1:])
+                        for p in precompute_pose_embs(
+                            model, params, flat, jnp.ones((B * P,)))]
+            # Unconditional half ONCE through the real masked path; it is
+            # pool-independent (the mask zeroes the pose embedding before
+            # the convs), so any single pair serves.
+            pair0 = {
+                "x": pool["x"][:, 0], "R1": pool["R1"][:, 0],
+                "t1": pool["t1"][:, 0], "R2": target_pose["R2"],
+                "t2": target_pose["t2"], "K": target_pose["K"],
+            }
+            uncond_embs = precompute_pose_embs(model, params, pair0,
+                                               jnp.zeros((B,)))
 
         def body(carry, t):
             z, key = carry
@@ -213,6 +282,14 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
             # Stochastic conditioning: uniform over the first num_views
             # entries of the pool, re-drawn EVERY denoising step.
             idx = jax.random.randint(k_pick, (), 0, num_views)
+            doubled_emb = None
+            if do_pre:
+                doubled_emb = tuple(
+                    jnp.concatenate(
+                        [jax.lax.dynamic_index_in_dim(p, idx, axis=1,
+                                                      keepdims=False), u],
+                        axis=0)
+                    for p, u in zip(pose_all, uncond_embs))
             batch = {
                 "x": jax.lax.dynamic_index_in_dim(pool["x"], idx, axis=1,
                                                   keepdims=False),
@@ -226,7 +303,7 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
                 "z": z,
                 "logsnr": jnp.full((B,), schedule.logsnr(t)),
             }
-            outs = _cfg_eps(model, params, batch, w)
+            outs = _cfg_eps(model, params, batch, w, pose_embs=doubled_emb)
             z = update(z, t, outs, k_step)
             return (z, key), None
 

@@ -296,3 +296,80 @@ def test_cfg_rescale_validation():
     dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8, cfg_rescale=1.5)
     with pytest.raises(ValueError, match="cfg_rescale"):
         make_sampler(model, make_schedule(dcfg), dcfg)
+
+
+def test_precomputed_pose_embs_match_inline():
+    """The hoisted pose-conditioning path (batch['pose_embs']) reproduces
+    the in-loop computation exactly — params untouched, identical math.
+    The model's output head is zero-init, so perturb params first to get a
+    non-trivial output."""
+    from novel_view_synthesis_3d_tpu.models.xunet import precompute_pose_embs
+
+    B = 2
+    model, params, cond = _model_and_params(B=B)
+    params = jax.tree.map(
+        lambda p: p + 0.01 * jnp.arange(p.size, dtype=p.dtype
+                                        ).reshape(p.shape) / p.size, params)
+    batch = dict(cond, z=jnp.asarray(
+        np.random.default_rng(0).normal(size=(B, 16, 16, 3))
+    ).astype(jnp.float32), logsnr=jnp.linspace(-4.0, 7.0, B))
+    mask = jnp.asarray([1.0, 0.0])  # exercise the CFG zeroing too
+
+    out_inline = model.apply({"params": params}, batch, cond_mask=mask,
+                             train=False)
+    pose_embs = precompute_pose_embs(model, params, cond, mask)
+    out_pre = model.apply({"params": params},
+                          dict(batch, pose_embs=pose_embs),
+                          cond_mask=mask, train=False)
+    np.testing.assert_allclose(np.asarray(out_pre), np.asarray(out_inline),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_stochastic_precompute_matches_inline_path():
+    """The stochastic sampler's hoisted pose path (precompute_pose=True)
+    must reproduce the in-loop path exactly — including the unconditional
+    CFG half, which is NOT zeros (conv biases and learned embeddings
+    survive the mask). Perturbed params make biases nonzero; learned
+    pos/ref embeddings exercise the additive paths the mask doesn't kill."""
+    import dataclasses
+
+    for flags in ({}, {"use_pos_emb": True, "use_ref_pose_emb": True}):
+        cfg = dataclasses.replace(TINY, **flags)
+        batch = make_example_batch(batch_size=2, sidelength=16)
+        model = XUNet(cfg)
+        model_batch = {
+            "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+            "logsnr": jnp.zeros((2,)), "R1": jnp.asarray(batch["R1"]),
+            "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+            "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+        }
+        params = model.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(1)},
+            model_batch, cond_mask=jnp.ones((2,)), train=False)["params"]
+        params = jax.tree.map(
+            lambda p: p + 0.02 * jax.random.normal(
+                jax.random.PRNGKey(7), p.shape, p.dtype), params)
+        cond = {k: model_batch[k] for k in ("x", "R1", "t1", "R2", "t2", "K")}
+
+        dcfg = DiffusionConfig(timesteps=3)
+        sched = make_schedule(dcfg)
+        B, H, max_pool = 2, 16, 3
+        pool = {
+            "x": jnp.broadcast_to(cond["x"][:, None],
+                                  (B, max_pool, H, H, 3)),
+            "R1": jnp.broadcast_to(cond["R1"][:, None], (B, max_pool, 3, 3)),
+            "t1": jnp.broadcast_to(cond["t1"][:, None], (B, max_pool, 3)),
+        }
+        target_pose = {"R2": cond["R2"], "t2": cond["t2"], "K": cond["K"]}
+        key = jax.random.PRNGKey(11)
+        args = (pool, target_pose, jnp.asarray(2, jnp.int32))
+        out_pre = make_stochastic_sampler(
+            model, sched, dcfg, max_pool, precompute_pose=True)(
+                params, key, *args)
+        out_inline = make_stochastic_sampler(
+            model, sched, dcfg, max_pool, precompute_pose=False)(
+                params, key, *args)
+        np.testing.assert_allclose(np.asarray(out_pre),
+                                   np.asarray(out_inline),
+                                   rtol=2e-5, atol=2e-5)
