@@ -105,6 +105,15 @@ def iter_batches(dataset: SRNDataset, batch_size: int, *, seed: int = 0,
     rng = np.random.default_rng(seed + shard_index)
     n = len(dataset)
     local = np.arange(shard_index, n, shard_count)
+    if len(local) < batch_size:
+        # Drop-last semantics (matching the Grain path and the reference's
+        # DataLoader(drop_last=True)) would yield ZERO batches here; without
+        # this check the while-True below would spin forever producing
+        # nothing — a silent 100%-CPU hang instead of an error.
+        raise ValueError(
+            f"dataset shard has {len(local)} records but batch_size is "
+            f"{batch_size} — with drop-last batching no batch can ever be "
+            "formed; lower train.batch_size or provide more data")
     while True:
         order = rng.permutation(local)
         for start in range(0, len(order) - batch_size + 1, batch_size):

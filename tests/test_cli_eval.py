@@ -258,6 +258,15 @@ def test_cli_train_sample_eval_e2e(cli_workspace, capsys):
                  "1", "--sample-steps", "2", "--reference-ckpt", ref_path]
                 + _tiny_overrides(tmp)) == 0
 
+    # eval also consumes reference-format checkpoints directly.
+    rj = str(tmp / "eval_ref.json")
+    assert main(["eval", root, "--out", rj, "--num-instances", "1",
+                 "--sample-steps", "2", "--batch-size", "2",
+                 "--reference-ckpt", ref_path] + _tiny_overrides(tmp)) == 0
+    with open(rj) as fh:
+        r = json.load(fh)
+    assert np.isfinite(r["psnr"]) and r["checkpoint_step"] == 0
+
 
 def test_cli_sample_without_checkpoint_fails(cli_workspace, tmp_path):
     root = str(cli_workspace / "srn")
@@ -350,3 +359,60 @@ def test_evaluate_dataset_mesh_matches_single_device(tmp_path):
     with pytest.raises(ValueError, match="not divisible"):
         evaluate_dataset(cfg, model, params, ds, mesh=mesh,
                          **dict(kwargs, batch_size=6))
+
+
+def test_export_uses_ema_params(tmp_path):
+    """With EMA on, `export` writes the EMA params (what you sample with),
+    matching _restore_params' own selection."""
+    import jax
+
+    from novel_view_synthesis_3d_tpu.cli import _restore_params, build_config
+    from novel_view_synthesis_3d_tpu.compat.reference_ckpt import (
+        load_reference_checkpoint)
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    root = str(tmp_path / "srn")
+    write_synthetic_srn(root, num_instances=1, views_per_instance=3,
+                        image_size=16)
+    overrides = _TINY + [
+        "train.ema_decay=0.5", "train.batch_size=2",  # 3-record dataset
+        "mesh.data=1",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"train.results_folder={tmp_path}/results",
+    ]
+    assert main(["train", root, "--no-grain"] + overrides) == 0
+    out = str(tmp_path / "ref" / "model2")
+    assert main(["export", "--out", out] + overrides) == 0
+
+    class _A:
+        preset = None
+        config = None
+
+    cfg = build_config(_A(), overrides)
+    ema, _ = _restore_params(
+        cfg, XUNet(cfg.model),
+        _sample_model_batch(make_example_batch(batch_size=1, sidelength=16)),
+        None)
+    reimported = load_reference_checkpoint(out)
+
+    # EMA must actually differ from the raw params (otherwise "export
+    # writes EMA" is indistinguishable from "export writes params").
+    from novel_view_synthesis_3d_tpu.train.checkpoint import CheckpointManager
+    from novel_view_synthesis_3d_tpu.train.state import create_train_state
+
+    template = create_train_state(
+        cfg.train, XUNet(cfg.model),
+        _sample_model_batch(make_example_batch(batch_size=1, sidelength=16)))
+    ckpt = CheckpointManager(cfg.train.checkpoint_dir)
+    state = ckpt.restore(template)
+    ckpt.close()
+    raw = jax.tree.leaves(jax.tree.map(np.asarray, state.params))
+    ema_leaves = jax.tree.leaves(jax.tree.map(np.asarray, ema))
+    assert any(not np.array_equal(a, b) for a, b in zip(ema_leaves, raw))
+
+    re_leaves = jax.tree.leaves(jax.tree.map(np.asarray, reimported))
+    assert len(ema_leaves) == len(re_leaves)
+    for a, b in zip(ema_leaves, re_leaves):
+        np.testing.assert_array_equal(a, b)

@@ -116,6 +116,19 @@ def test_iter_batches_shapes_and_sharding(srn_root):
     assert next(i1)["x"].shape == (2, 16, 16, 3)
 
 
+def test_iter_batches_rejects_batch_larger_than_shard(srn_root):
+    # Drop-last batching can never form a batch when the (sharded) record
+    # count is below batch_size; this must raise, not spin forever (the
+    # pre-fix behavior was an infinite 100%-CPU loop yielding nothing).
+    ds = SRNDataset(srn_root, img_sidelength=16)
+    with pytest.raises(ValueError, match="batch_size"):
+        next(iter_batches(ds, batch_size=len(ds) + 1, seed=0))
+    with pytest.raises(ValueError, match="shard"):
+        # 18 records over 10 shards → shard 0 has 2 records < batch 3.
+        next(iter_batches(ds, batch_size=3, seed=0,
+                          shard_index=0, shard_count=10))
+
+
 def test_grain_loader(srn_root):
     ds = SRNDataset(srn_root, img_sidelength=16)
     loader = make_grain_loader(ds, batch_size=4, seed=0, num_workers=0,
