@@ -254,6 +254,63 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
     }))
 
 
+def bench_sample_ar(preset_name: str, num_views: int = 4,
+                    overrides=()) -> None:
+    """Autoregressive 3DiM-protocol sampling sec/view: stochastic
+    conditioning over the growing pool (sample/ddpm.autoregressive_generate)
+    — the protocol the paper evaluates with. One compiled stochastic
+    sampler serves every view; reported per GENERATED view so the number is
+    comparable to the plain `sample` bench."""
+    from novel_view_synthesis_3d_tpu.config import get_preset
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        sampling_schedule)
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.sample.ddpm import autoregressive_generate
+    from novel_view_synthesis_3d_tpu.train.state import create_train_state
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+    from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+
+    cfg = get_preset(preset_name)
+    if overrides:
+        cfg = cfg.apply_cli(list(overrides))
+    cfg.validate()
+    sample_steps = cfg.diffusion.sample_timesteps
+    raw = make_example_batch(batch_size=1,
+                             sidelength=cfg.data.img_sidelength, seed=0)
+    model = XUNet(cfg.model)
+    state = create_train_state(cfg.train, model, _sample_model_batch(raw))
+    params = jax.device_put(state.params, jax.devices()[0])
+    first_view = {k: jnp.asarray(raw[k]) for k in ("x", "R1", "t1", "K")}
+    orbit = orbit_poses(num_views, radius=2.5, elevation=0.3)  # (N, 4, 4)
+    target_poses = {
+        "R2": jnp.asarray(orbit[None, :, :3, :3]),
+        "t2": jnp.asarray(orbit[None, :, :3, 3]),
+    }
+    schedule = sampling_schedule(cfg.diffusion, sample_steps)
+
+    def run(key):
+        out = autoregressive_generate(model, schedule, cfg.diffusion,
+                                      params, key, first_view, target_poses)
+        float(jax.device_get(out.sum()))  # real host fetch
+        return out
+
+    run(jax.random.PRNGKey(0))  # compile
+    t0 = time.perf_counter()
+    reps = 2
+    for i in range(reps):
+        run(jax.random.PRNGKey(i + 1))
+    sec_view = (time.perf_counter() - t0) / reps / num_views
+    print(json.dumps({
+        "metric": (f"ar_{sample_steps}step_{num_views}view_sample_"
+                   f"sec_per_view_{preset_name}"),
+        "value": round(sec_view, 3),
+        "unit": "sec/view",
+        "vs_baseline": None,  # the reference has no autoregressive sampler
+        "platform": jax.default_backend(),
+    }))
+
+
 def bench_analyze(preset_name: str, overrides=()) -> None:
     """Static roofline analysis of the jitted train step via XLA's own
     cost model: FLOPs, HBM bytes accessed, arithmetic intensity, and peak
@@ -439,6 +496,11 @@ def main():
         preset = args[1] if len(args) > 1 else "tiny64"
         steps = int(args[2]) if len(args) > 2 else 256
         bench_sample(preset, steps, overrides)
+        return
+    if args and args[0] == "sample-ar":
+        preset = args[1] if len(args) > 1 else "tiny64"
+        views = int(args[2]) if len(args) > 2 else 4
+        bench_sample_ar(preset, views, overrides)
         return
     if args and args[0] == "profile":
         preset = args[1] if len(args) > 1 else "tiny64"

@@ -46,6 +46,7 @@ MATRIX = [
                             "model.remat=dots"], 2400),
     ("paper256_train", ["bench.py", "paper256", "10"], 3600),
     ("sample_tiny64_256", ["bench.py", "sample", "tiny64", "256"], 2400),
+    ("sample_ar_tiny64", ["bench.py", "sample-ar", "tiny64", "8"], 2400),
     ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
     ("quality_tpu_64px", ["tools/quality_run.py",
                           "results/quality_tpu_r02", "20000", "64"], 7200),
