@@ -187,29 +187,13 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
     un-jitted applies, 2 CFG forwards each; measured over a short prefix and
     scaled linearly, which favors the baseline by excluding its dispatch
     warm-up)."""
-    from novel_view_synthesis_3d_tpu.config import get_preset
-    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
     from novel_view_synthesis_3d_tpu.diffusion.schedules import (
         sampling_schedule)
-    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
     from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
-    from novel_view_synthesis_3d_tpu.train.state import create_train_state
-    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
 
-    cfg = get_preset(preset_name).override(
-        **{"diffusion.sample_timesteps": sample_steps})
-    if overrides:  # explicit overrides win, including sample_timesteps
-        cfg = cfg.apply_cli(list(overrides))
-    cfg.validate()
+    cfg, model, params, raw = _sampling_setup(preset_name, sample_steps,
+                                              overrides)
     sample_steps = cfg.diffusion.sample_timesteps
-    raw = make_example_batch(batch_size=1,
-                             sidelength=cfg.data.img_sidelength, seed=0)
-    model = XUNet(cfg.model)
-    state = create_train_state(cfg.train, model, _sample_model_batch(raw))
-    # Commit params to the default device: host-side init leaves them on
-    # CPU, and timing the sampler with uncommitted params would re-upload
-    # the full parameter set every rep.
-    params = jax.device_put(state.params, jax.devices()[0])
     cond = {k: jnp.asarray(raw[k]) for k in ("x", "R1", "t1", "R2", "t2", "K")}
 
     schedule = sampling_schedule(cfg.diffusion, sample_steps)
@@ -254,33 +238,49 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
     }))
 
 
-def bench_sample_ar(preset_name: str, num_views: int = 4,
-                    overrides=()) -> None:
-    """Autoregressive 3DiM-protocol sampling sec/view: stochastic
-    conditioning over the growing pool (sample/ddpm.autoregressive_generate)
-    — the protocol the paper evaluates with. One compiled stochastic
-    sampler serves every view; reported per GENERATED view so the number is
-    comparable to the plain `sample` bench."""
+def _sampling_setup(preset_name: str, sample_steps: int, overrides):
+    """Shared setup for the sampling benches: config (with `sample_steps`
+    as the default, explicit overrides winning), example record, model,
+    device-committed params. Returns (cfg, model, params, raw batch)."""
     from novel_view_synthesis_3d_tpu.config import get_preset
     from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
-    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
-        sampling_schedule)
     from novel_view_synthesis_3d_tpu.models.xunet import XUNet
-    from novel_view_synthesis_3d_tpu.sample.ddpm import autoregressive_generate
     from novel_view_synthesis_3d_tpu.train.state import create_train_state
     from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
-    from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
 
-    cfg = get_preset(preset_name)
+    cfg = get_preset(preset_name).override(
+        **{"diffusion.sample_timesteps": sample_steps})
     if overrides:
         cfg = cfg.apply_cli(list(overrides))
     cfg.validate()
-    sample_steps = cfg.diffusion.sample_timesteps
     raw = make_example_batch(batch_size=1,
                              sidelength=cfg.data.img_sidelength, seed=0)
     model = XUNet(cfg.model)
     state = create_train_state(cfg.train, model, _sample_model_batch(raw))
+    # Commit params to the default device: host-side init leaves them on
+    # CPU, and timing with uncommitted params would re-upload per rep.
     params = jax.device_put(state.params, jax.devices()[0])
+    return cfg, model, params, raw
+
+
+def bench_sample_ar(preset_name: str, num_views: int = 4,
+                    sample_steps: int = 256, overrides=()) -> None:
+    """Autoregressive 3DiM-protocol sampling sec/view: stochastic
+    conditioning over the growing pool (sample/ddpm.autoregressive_generate)
+    — the protocol the paper evaluates with. One compiled stochastic
+    sampler serves every view and every rep (built once and passed in;
+    autoregressive_generate would otherwise rebuild its jit closure per
+    call); reported per GENERATED view at the same 256-step default as the
+    plain `sample` bench so the two are comparable."""
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        sampling_schedule)
+    from novel_view_synthesis_3d_tpu.sample.ddpm import (
+        autoregressive_generate, make_stochastic_sampler)
+    from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+
+    cfg, model, params, raw = _sampling_setup(preset_name, sample_steps,
+                                              overrides)
+    sample_steps = cfg.diffusion.sample_timesteps
     first_view = {k: jnp.asarray(raw[k]) for k in ("x", "R1", "t1", "K")}
     orbit = orbit_poses(num_views, radius=2.5, elevation=0.3)  # (N, 4, 4)
     target_poses = {
@@ -288,10 +288,14 @@ def bench_sample_ar(preset_name: str, num_views: int = 4,
         "t2": jnp.asarray(orbit[None, :, :3, 3]),
     }
     schedule = sampling_schedule(cfg.diffusion, sample_steps)
+    max_pool = num_views + 1
+    sampler = make_stochastic_sampler(model, schedule, cfg.diffusion,
+                                      max_pool)
 
     def run(key):
         out = autoregressive_generate(model, schedule, cfg.diffusion,
-                                      params, key, first_view, target_poses)
+                                      params, key, first_view, target_poses,
+                                      max_pool=max_pool, sampler=sampler)
         float(jax.device_get(out.sum()))  # real host fetch
         return out
 
@@ -500,7 +504,8 @@ def main():
     if args and args[0] == "sample-ar":
         preset = args[1] if len(args) > 1 else "tiny64"
         views = int(args[2]) if len(args) > 2 else 4
-        bench_sample_ar(preset, views, overrides)
+        steps = int(args[3]) if len(args) > 3 else 256
+        bench_sample_ar(preset, views, steps, overrides)
         return
     if args and args[0] == "profile":
         preset = args[1] if len(args) > 1 else "tiny64"
