@@ -230,7 +230,8 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
     ref_sec_view = (time.perf_counter() - t0) / probe * sample_steps
 
     print(json.dumps({
-        "metric": f"ddpm_{sample_steps}step_sample_sec_per_view_{preset_name}",
+        "metric": (f"{cfg.diffusion.sampler}_{sample_steps}step_"
+                   f"sample_sec_per_view_{preset_name}"),
         "value": round(sec_view, 3),
         "unit": "sec/view",
         "vs_baseline": round(ref_sec_view / sec_view, 3),

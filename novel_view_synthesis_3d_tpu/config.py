@@ -99,6 +99,9 @@ class DiffusionConfig:
     # 'ddpm' = ancestral (the reference's sampler); 'ddim' = Song et al.
     # 2021 non-Markovian update — deterministic at ddim_eta=0, ancestral-like
     # at ddim_eta=1; pairs well with aggressive respacing (sample_timesteps).
+    # 'dpm++' = DPM-Solver++(2M) (Lu et al. 2022) — deterministic
+    # second-order multistep solver; comparable quality at ~8× fewer steps
+    # (sample_timesteps 25–50 instead of 256+).
     sampler: str = "ddpm"
     ddim_eta: float = 0.0
 

@@ -201,6 +201,54 @@ class DiffusionSchedule:
             jnp.shape(t) + (1,) * (z_t.ndim - jnp.ndim(t)))
         return jnp.sqrt(acp_prev) * x0 + dir_zt + nonzero * sigma * noise
 
+    def dpmpp_2m_step(self, x0, x0_prev, z_t, t, first):
+        """One DPM-Solver++(2M) update z_t → z_{t−1} (Lu et al. 2022,
+        arXiv 2211.01095, Algorithm 2, data-prediction form).
+
+        Second-order multistep: extrapolate the denoised prediction with the
+        PREVIOUS step's x̂₀ (`x0_prev`, the network's x̂₀ at t+1) before the
+        exponential-integrator update. In half-logsnr λ = log(α/σ):
+
+          h = λ_{t−1} − λ_t,  r = (λ_t − λ_{t+1}) / h
+          D̄ = x̂₀ + (x̂₀ − x̂₀_prev) / (2r)
+          z_{t−1} = (σ_{t−1}/σ_t) z_t + α_{t−1}(1 − e^{−h}) D̄
+
+        The update line is algebraically the η=0 DDIM step with D̄ in place
+        of x̂₀ (substitute σ_{t−1}α_t/σ_t = α_{t−1}e^{−h} into ddim_step), so
+        it reuses `ddim_step` — one home for the exponential-integrator
+        algebra. The first step (`first`, no history yet) and the final step
+        (t=0, where h = λ_0⁺ − λ_0 is unbounded and r → 0 would blow up the
+        extrapolation) fall back to the first-order update D̄ = x̂₀ — the
+        standard `lower_order_final` stabilization. Deterministic: no noise
+        is consumed. The reference has only the 1000-step ancestral host
+        loop (sampling.py:116-167); this is a framework extension for
+        ~8× fewer sampling steps at comparable quality.
+        """
+        acp_t = self._extract(self.alphas_cumprod, t, z_t)
+        acp_prev = self._extract(self.alphas_cumprod_prev, t, z_t)
+        t_last = jnp.minimum(jnp.asarray(t) + 1, self.num_timesteps - 1)
+        acp_last = self._extract(self.alphas_cumprod, t_last, z_t)
+
+        def lam(a):
+            # Clip so λ stays finite even where an f32 table rounds ᾱ to
+            # exactly 1 (shifted-cosine near t=0) or 0; only the ratio r
+            # sees these values, and the affected steps are the low-order
+            # fallbacks anyway.
+            a = jnp.clip(a, 1e-20, 1.0 - 1e-7)
+            return 0.5 * (jnp.log(a) - jnp.log1p(-a))
+
+        h = lam(acp_prev) - lam(acp_t)
+        h_last = lam(acp_t) - lam(acp_last)
+        low_order = jnp.asarray(first) | (t == 0)
+        low_order = jnp.reshape(
+            low_order,
+            jnp.shape(low_order) + (1,) * (z_t.ndim - jnp.ndim(low_order)))
+        r = jnp.where(low_order, 1.0, h_last / jnp.maximum(h, 1e-20))
+        d_bar = jnp.where(
+            low_order, x0,
+            x0 + (x0 - x0_prev) / jnp.maximum(2.0 * r, 1e-20))
+        return self.ddim_step(d_bar, z_t, t, 0.0, 0.0)
+
     # -- conditioning signal --------------------------------------------
     def logsnr(self, t) -> jnp.ndarray:
         """logsnr at (respaced) integer timestep t, evaluated at original t/T.

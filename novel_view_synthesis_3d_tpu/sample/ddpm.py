@@ -23,8 +23,10 @@ Per-step math (reference sampling.py:119-151):
 
 `diffusion.sampler='ddim'` swaps the ancestral update for the DDIM
 non-Markovian one (Song et al. 2021) — deterministic at `ddim_eta=0`,
-ancestral-variance at `ddim_eta=1`; the reference has only the 1000-step
-ancestral loop.
+ancestral-variance at `ddim_eta=1`; `diffusion.sampler='dpm++'` uses the
+DPM-Solver++(2M) second-order multistep solver (Lu et al. 2022) for
+comparable quality at ~8× fewer steps. The reference has only the
+1000-step ancestral loop.
 """
 
 from __future__ import annotations
@@ -88,9 +90,25 @@ def _make_x0_fn(schedule: DiffusionSchedule, objective: str):
     raise ValueError(f"unknown objective {objective!r}")
 
 
-def _make_update(schedule: DiffusionSchedule, config: DiffusionConfig):
-    """Bind the configured reverse-process update (ddpm | ddim), converting
-    the network output (eps | x0 | v per diffusion.objective) to x̂₀ first.
+def _make_update(schedule: DiffusionSchedule, config: DiffusionConfig,
+                 memoryless: bool = False):
+    """Bind the configured reverse-process update (ddpm | ddim | dpm++),
+    converting the network output (eps | x0 | v per diffusion.objective) to
+    x̂₀ first. Returns `(update, init_aux)`:
+
+      update(z, t, outs, key, aux) -> (z_next, aux_next)
+      init_aux(z0) -> initial per-trajectory solver state
+
+    `aux` is empty for the memoryless samplers (ddpm, ddim) and carries the
+    previous step's x̂₀ for the multistep dpm++ solver (DPM-Solver++(2M),
+    Lu et al. 2022) — the scan carry threads it across steps.
+
+    `memoryless=True` declares that the caller changes the conditioning
+    between steps (stochastic conditioning re-draws the pool view every
+    denoise step), so consecutive x̂₀ predictions are NOT samples of one
+    ODE trajectory: the 2M extrapolation would read the conditioning jump
+    as curvature and deterministically amplify it. dpm++ then degrades to
+    its first-order update (= η=0 DDIM); ddpm/ddim are unaffected.
 
     CFG is applied in the network's output space before this conversion
     (guidance in eps-space and v-space coincide up to the linear maps here).
@@ -119,20 +137,41 @@ def _make_update(schedule: DiffusionSchedule, config: DiffusionConfig):
             x0 = jnp.clip(x0, -1.0, 1.0)
         return x0
 
+    def no_aux(z0):
+        return ()
+
     if config.sampler == "ddim":
         eta = config.ddim_eta
 
-        def update(z, t, outs, key):
+        def update(z, t, outs, key, aux):
             noise = jax.random.normal(key, z.shape)
-            return schedule.ddim_step(to_x0(z, t, outs), z, t, noise, eta)
+            return schedule.ddim_step(to_x0(z, t, outs), z, t, noise, eta), aux
 
-        return update
+        return update, no_aux
     if config.sampler == "ddpm":
 
-        def update(z, t, outs, key):
-            return _posterior_sample(schedule, to_x0(z, t, outs), z, t, key)
+        def update(z, t, outs, key, aux):
+            return _posterior_sample(schedule, to_x0(z, t, outs), z, t,
+                                     key), aux
 
-        return update
+        return update, no_aux
+    if config.sampler == "dpm++":
+        if memoryless:
+
+            def update(z, t, outs, key, aux):
+                return schedule.ddim_step(to_x0(z, t, outs), z, t,
+                                          0.0, 0.0), aux
+
+            return update, no_aux
+
+        def update(z, t, outs, key, aux):
+            x0 = to_x0(z, t, outs)
+            first = t >= schedule.num_timesteps - 1
+            return schedule.dpmpp_2m_step(x0, aux, z, t, first), x0
+
+        # The first step is first-order (no history); the zeros are never
+        # read, they just give the scan carry a stable structure.
+        return update, jnp.zeros_like
     raise ValueError(f"unknown sampler {config.sampler!r}")
 
 
@@ -158,20 +197,20 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
     trajectory in HBM (B' = B when None).
     """
     w = config.guidance_weight
-    update = _make_update(schedule, config)
+    update, init_aux = _make_update(schedule, config)
     T = schedule.num_timesteps
     if trajectory_every < 0 or trajectory_every > T:
         raise ValueError(
             f"trajectory_every must be in [0, {T}]; got {trajectory_every}")
 
     def body(cond, params, pose_embs, carry, t):
-        z, key = carry
+        z, key, aux = carry
         key, k_step = jax.random.split(key)
         batch = dict(cond, z=z,
                      logsnr=jnp.full((z.shape[0],), schedule.logsnr(t)))
         outs = _cfg_eps(model, params, batch, w, pose_embs=pose_embs)
-        z = update(z, t, outs, k_step)
-        return (z, key), None
+        z, aux = update(z, t, outs, k_step, aux)
+        return (z, key, aux), None
 
     @jax.jit
     def sample(params, key, cond: dict) -> jnp.ndarray:
@@ -184,9 +223,10 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
         # here instead of every scan step — pure win, identical math.
         pose_embs = _doubled_pose_embs(model, params, cond)
         step = partial(body, cond, params, pose_embs)
+        carry0 = (z0, key, init_aux(z0))
 
         if not trajectory_every:
-            (z, _), _ = jax.lax.scan(step, (z0, key), ts)
+            (z, _, _), _ = jax.lax.scan(step, carry0, ts)
             return z
 
         def outer(carry, ts_chunk):
@@ -198,7 +238,7 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
         n_chunks, rem = divmod(T, trajectory_every)
         chunks = ts[:n_chunks * trajectory_every].reshape(
             n_chunks, trajectory_every)
-        carry, traj = jax.lax.scan(outer, (z0, key), chunks)
+        carry, traj = jax.lax.scan(outer, carry0, chunks)
         if rem:
             carry, _ = jax.lax.scan(step, carry, ts[-rem:])
             z = carry[0]
@@ -230,7 +270,9 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
     computation.
     """
     w = config.guidance_weight
-    update = _make_update(schedule, config)
+    # memoryless: the conditioning view is re-drawn every denoise step, so
+    # multistep solver history is invalid here (see _make_update).
+    update, init_aux = _make_update(schedule, config, memoryless=True)
 
     @partial(jax.jit, static_argnames=())
     def sample(params, key, pool: dict, target_pose: dict,
@@ -277,7 +319,7 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
                                                jnp.zeros((B,)))
 
         def body(carry, t):
-            z, key = carry
+            z, key, aux = carry
             key, k_pick, k_step = jax.random.split(key, 3)
             # Stochastic conditioning: uniform over the first num_views
             # entries of the pool, re-drawn EVERY denoising step.
@@ -304,10 +346,10 @@ def make_stochastic_sampler(model, schedule: DiffusionSchedule,
                 "logsnr": jnp.full((B,), schedule.logsnr(t)),
             }
             outs = _cfg_eps(model, params, batch, w, pose_embs=doubled_emb)
-            z = update(z, t, outs, k_step)
-            return (z, key), None
+            z, aux = update(z, t, outs, k_step, aux)
+            return (z, key, aux), None
 
-        (z, _), _ = jax.lax.scan(body, (z0, key), ts)
+        (z, _, _), _ = jax.lax.scan(body, (z0, key, init_aux(z0)), ts)
         return z
 
     return sample

@@ -125,16 +125,16 @@ def test_ddim_eta0_ignores_step_noise():
     z = jnp.asarray(rng.standard_normal((2, 16, 16, 3)), jnp.float32)
     eps = jnp.asarray(rng.standard_normal((2, 16, 16, 3)), jnp.float32)
     t = jnp.asarray([5, 5])
-    upd0 = _make_update(sched, DiffusionConfig(
+    upd0, _ = _make_update(sched, DiffusionConfig(
         timesteps=16, sampler="ddim", ddim_eta=0.0))
-    a = upd0(z, t, (eps, eps), jax.random.PRNGKey(0))
-    b = upd0(z, t, (eps, eps), jax.random.PRNGKey(123))
+    a, _ = upd0(z, t, (eps, eps), jax.random.PRNGKey(0), ())
+    b, _ = upd0(z, t, (eps, eps), jax.random.PRNGKey(123), ())
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # …and at η=1 the noise branch must be live.
-    upd1 = _make_update(sched, DiffusionConfig(
+    upd1, _ = _make_update(sched, DiffusionConfig(
         timesteps=16, sampler="ddim", ddim_eta=1.0))
-    c = upd1(z, t, (eps, eps), jax.random.PRNGKey(0))
-    d = upd1(z, t, (eps, eps), jax.random.PRNGKey(123))
+    c, _ = upd1(z, t, (eps, eps), jax.random.PRNGKey(0), ())
+    d, _ = upd1(z, t, (eps, eps), jax.random.PRNGKey(123), ())
     assert np.abs(np.asarray(c) - np.asarray(d)).max() > 1e-4
 
 
@@ -164,6 +164,100 @@ def test_ddim_respaced_matches_shapes():
     assert np.isfinite(imgs).all()
 
 
+def test_dpmpp_step_reduces_to_ddim_on_constant_x0():
+    # With x̂₀_cur == x̂₀_prev the 2M extrapolation is the identity, so every
+    # dpm++ step must equal the η=0 DDIM step on the same x̂₀ — including the
+    # low-order first/final steps.
+    sched = make_schedule(DiffusionConfig(timesteps=16))
+    rng = np.random.default_rng(1)
+    z = jnp.asarray(rng.standard_normal((2, 8, 8, 3)), jnp.float32)
+    c = jnp.asarray(rng.uniform(-1, 1, (2, 8, 8, 3)), jnp.float32)
+    for t_val, first in [(15, True), (7, False), (0, False)]:
+        t = jnp.asarray([t_val, t_val])
+        got = sched.dpmpp_2m_step(c, c, z, t, jnp.asarray(first))
+        want = sched.ddim_step(c, z, t, 0.0, 0.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+
+
+def test_dpmpp_exact_on_constant_denoiser():
+    # If the denoiser is exact and constant (x̂₀ ≡ c at every step), the
+    # solver must land exactly on c at t=0 regardless of z_T — pins the
+    # update algebra and the low-order final step in one go.
+    sched = make_schedule(DiffusionConfig(timesteps=12))
+    rng = np.random.default_rng(2)
+    z = jnp.asarray(rng.standard_normal((1, 8, 8, 3)), jnp.float32)
+    c = jnp.asarray(rng.uniform(-0.9, 0.9, (1, 8, 8, 3)), jnp.float32)
+    aux = jnp.zeros_like(z)
+    for i, t_val in enumerate(range(11, -1, -1)):
+        t = jnp.asarray(t_val)
+        z = sched.dpmpp_2m_step(c, aux, z, t, jnp.asarray(i == 0))
+        aux = c
+        assert np.isfinite(np.asarray(z)).all(), f"non-finite at t={t_val}"
+    np.testing.assert_allclose(np.asarray(z), np.asarray(c), atol=1e-5)
+
+
+def test_dpmpp_sampler_finite_and_deterministic():
+    dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8, sampler="dpm++",
+                           guidance_weight=3.0)
+    sched = make_schedule(dcfg)
+    model, params, cond = _model_and_params()
+    sampler = make_sampler(model, sched, dcfg)
+    a = sampler(params, jax.random.PRNGKey(0), cond)
+    b = sampler(params, jax.random.PRNGKey(0), cond)
+    assert a.shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(a)).all()
+    # Deterministic ODE solver: same key (hence same z_T) → same image.
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Respaced from a long training schedule — the production usage.
+    sched50 = respace(DiffusionConfig(timesteps=1000, sampler="dpm++"), 6)
+    sampler50 = make_sampler(model, sched50,
+                             DiffusionConfig(timesteps=1000, sampler="dpm++"))
+    out = sampler50(params, jax.random.PRNGKey(1), cond)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_dpmpp_stochastic_sampler_finite():
+    dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8, sampler="dpm++")
+    sched = make_schedule(dcfg)
+    model, params, cond = _model_and_params()
+    pool = {
+        "x": jnp.stack([cond["x"], cond["x"]], axis=1),
+        "R1": jnp.stack([cond["R1"], cond["R2"]], axis=1),
+        "t1": jnp.stack([cond["t1"], cond["t2"]], axis=1),
+    }
+    target = {"R2": cond["R2"], "t2": cond["t2"], "K": cond["K"]}
+    sampler = make_stochastic_sampler(model, sched, dcfg, max_pool=2)
+    img = sampler(params, jax.random.PRNGKey(0), pool, target,
+                  jnp.asarray(2, jnp.int32))
+    assert img.shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(img)).all()
+    # Stochastic conditioning re-draws the view each step, so dpm++ must
+    # degrade to its first-order update there — bit-identical to η=0 DDIM
+    # (2M history would read the per-step conditioning jump as curvature).
+    ddim_cfg = DiffusionConfig(timesteps=8, sample_timesteps=8,
+                               sampler="ddim", ddim_eta=0.0)
+    ddim = make_stochastic_sampler(model, make_schedule(ddim_cfg), ddim_cfg,
+                                   max_pool=2)
+    ref = ddim(params, jax.random.PRNGKey(0), pool, target,
+               jnp.asarray(2, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(img), np.asarray(ref))
+
+
+def test_dpmpp_trajectory_matches_flat():
+    dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8, sampler="dpm++")
+    sched = make_schedule(dcfg)
+    model, params, cond = _model_and_params()
+    flat = make_sampler(model, sched, dcfg)
+    traj = make_sampler(model, sched, dcfg, trajectory_every=3)
+    a = flat(params, jax.random.PRNGKey(0), cond)
+    b, frames = traj(params, jax.random.PRNGKey(0), cond)
+    # The aux (prev-x̂₀) carry must thread identically through the chunked
+    # trajectory scans — final image bit-identical to the flat solver.
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(frames[-1]), np.asarray(b))
+
+
 def test_unknown_sampler_rejected():
     import pytest
 
@@ -177,11 +271,11 @@ def test_unknown_sampler_rejected():
 
 def test_objectives_sample_finite():
     # x0- and v-objective samplers produce finite in-envelope images with
-    # both ddpm and ddim updates (the model is untrained; this pins the
-    # output→x̂₀ conversion plumbing, not quality).
+    # every update rule (the model is untrained; this pins the output→x̂₀
+    # conversion plumbing, not quality).
     model, params, cond = _model_and_params()
     for objective in ("x0", "v"):
-        for sampler_kind in ("ddpm", "ddim"):
+        for sampler_kind in ("ddpm", "ddim", "dpm++"):
             dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8,
                                    objective=objective, sampler=sampler_kind)
             sched = make_schedule(dcfg)
