@@ -244,6 +244,34 @@ def test_dpmpp_stochastic_sampler_finite():
     np.testing.assert_array_equal(np.asarray(img), np.asarray(ref))
 
 
+def test_dpmpp_convergence_to_ode_solution():
+    # Solver-order check on the REAL network ODE: with a fixed probability
+    # flow (deterministic, w=0, perturbed params so the zero-init head is
+    # live), coarse dpm++ solutions must approach the fine-grained DDIM
+    # reference as steps double — a property of the solver, independent of
+    # training.
+    model, params, cond = _model_and_params()
+    params = jax.tree.map(
+        lambda p: p + 0.05 * jax.random.normal(
+            jax.random.PRNGKey(7), p.shape, p.dtype), params)
+    base = dict(timesteps=128, guidance_weight=0.0)
+    key = jax.random.PRNGKey(3)
+
+    def run(sampler_kind, steps):
+        dcfg = DiffusionConfig(sampler=sampler_kind, **base)
+        sched = (respace(dcfg, steps) if steps != base["timesteps"]
+                 else make_schedule(dcfg))
+        return np.asarray(
+            make_sampler(model, sched, dcfg)(params, key, cond))
+
+    ref = run("ddim", 128)  # fine-grained first-order reference solution
+    err = {n: np.abs(run("dpm++", n) - ref).mean() for n in (8, 32)}
+    assert err[32] < err[8], f"dpm++ not converging: {err}"
+    # Second order beats first order at the same coarse step count.
+    err_ddim8 = np.abs(run("ddim", 8) - ref).mean()
+    assert err[8] < err_ddim8, (err, err_ddim8)
+
+
 def test_dpmpp_trajectory_matches_flat():
     dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8, sampler="dpm++")
     sched = make_schedule(dcfg)
