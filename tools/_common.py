@@ -1,0 +1,22 @@
+"""Shared start-up for the tools/ entry points (and bench.py's twin block).
+
+One place for the JAX environment dance every standalone script needs:
+honor a JAX_PLATFORMS=cpu pin set after interpreter start (the container
+sitecustomize imports jax first, so the env var alone is not enough), and
+wire the persistent compilation cache when configured.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def init_jax_env() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -24,7 +24,6 @@ import json
 import os
 import shutil
 import sys
-import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -36,18 +35,19 @@ def main() -> None:
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3000
     size = int(sys.argv[3]) if len(sys.argv) > 3 else 32
 
+    from _common import init_jax_env
+    init_jax_env()
     import jax
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ["JAX_COMPILATION_CACHE_DIR"])
 
     from novel_view_synthesis_3d_tpu.cli import main as cli
     from novel_view_synthesis_3d_tpu.data.prep import train_val_split
     from novel_view_synthesis_3d_tpu.data.raytrace import write_raytraced_srn
 
-    work = tempfile.mkdtemp(prefix="quality_run_")
+    # Under out_dir (not a tempdir) and retained after exit — see the note
+    # at the end of main(). A stale workdir from a previous run is cleared.
+    work = os.path.join(out_dir, "work")
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work, exist_ok=True)
     full = write_raytraced_srn(os.path.join(work, "full"), num_instances=6,
                                views_per_instance=24, image_size=size,
                                seed=7)
@@ -75,6 +75,12 @@ def main() -> None:
         f"train.results_folder={out_dir}",
     ]
     os.makedirs(out_dir, exist_ok=True)
+    # Persist the RESOLVED config next to the checkpoint so follow-up tools
+    # (tools/sampler_comparison.py --config) reload exactly this model
+    # shape instead of hand-mirroring the override list.
+    from novel_view_synthesis_3d_tpu.config import get_preset
+    with open(os.path.join(work, "config.json"), "w") as fh:
+        fh.write(get_preset("tiny64").apply_cli(overrides).to_json())
     print(f"training {steps} steps at {size}px on {train_root}", flush=True)
     rc = cli(["train", train_root] + overrides)
     if rc != 0:
@@ -112,7 +118,13 @@ def main() -> None:
     }
     with open(os.path.join(out_dir, "summary.json"), "w") as fh:
         json.dump(summary, fh, indent=2)
-    shutil.rmtree(work, ignore_errors=True)
+    # The workdir (dataset splits + checkpoint) is RETAINED under out_dir
+    # so follow-up tools can reuse the trained model — in particular
+    # tools/sampler_comparison.py, which must run as a SEPARATE process
+    # AFTER this one exits (libtpu is single-process-exclusive: a child
+    # spawned here could never initialize the TPU while this process holds
+    # it). tools/tpu_extra_watch.py runs that comparison as its own matrix
+    # entry with its own timeout.
     # Single JSON line LAST, with the platform tag: the bench watcher
     # parses it and refuses to count a CPU-fallback run as TPU evidence.
     print(json.dumps(summary), flush=True)

@@ -1,0 +1,120 @@
+"""Phase-2 TPU experiments: run after tools/tpu_bench_watch.py finishes.
+
+Waits until the phase-1 watcher's log says the matrix is finished (or its
+deadline passed), then reuses its probe/run machinery on a second matrix:
+batch-scaling on base128 and the fast dpm++ sampling benches.
+
+Usage: python tools/tpu_extra_watch.py [max_wait_h]
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import tpu_bench_watch as tbw  # noqa: E402
+
+PHASE1_LOG = os.path.join(tbw.OUT, "log.txt")
+
+EXTRA = [
+    ("base128_bs16", ["bench.py", "base128", "20",
+                      "train.batch_size=16"], 2400),
+    ("sample_dpmpp32_tiny64", ["bench.py", "sample", "tiny64", "32",
+                               "diffusion.sampler=dpm++"], 2400),
+    ("sample_dpmpp32_base128", ["bench.py", "sample", "base128", "32",
+                                "diffusion.sampler=dpm++"], 2400),
+    ("sample_base128_256", ["bench.py", "sample", "base128", "256"], 2400),
+    # Sampler quality/speed table on the checkpoint the phase-1 quality run
+    # retained under its out_dir; --config reloads the exact resolved model
+    # shape that run trained (checkpoint dir included). Runs as its own
+    # process AFTER quality_run exited — libtpu is single-process-exclusive.
+    ("sampler_comparison_quality64",
+     ["tools/sampler_comparison.py", "results/quality_tpu_r02/work/val",
+      "results/quality_tpu_r02/sampler_comparison.json",
+      "--config", "results/quality_tpu_r02/work/config.json",
+      "--num-instances", "6", "--views-per-instance", "2"], 3600),
+]
+
+
+def phase1_running() -> bool:
+    # Module-name substring, not a path: matches any launch spelling
+    # ("python tools/tpu_bench_watch.py", "cd tools && python
+    # tpu_bench_watch.py", ...). Our own cmdline (tpu_extra_watch.py)
+    # does not contain it.
+    try:
+        return subprocess.run(
+            ["pgrep", "-f", "tpu_bench_watch"],
+            stdout=subprocess.DEVNULL).returncode == 0
+    except OSError:
+        return False  # no pgrep: assume dead rather than waiting forever
+
+
+PIDFILE = os.path.join(tbw.OUT, "extra_watch.pid")
+
+
+def another_phase2_running() -> bool:
+    """True if a DIFFERENT tpu_extra_watch process is alive (double-launch
+    guard: two instances would run the EXTRA matrix concurrently on one
+    chip and truncate each other's result files). Pidfile-based: a pgrep
+    pattern would match the `sh -c` wrapper of our own launch command."""
+    try:
+        pid = int(open(PIDFILE).read().strip())
+    except (OSError, ValueError):
+        return False
+    if pid == os.getpid():
+        return False
+    try:
+        cmdline = open(f"/proc/{pid}/cmdline", "rb").read().decode(
+            "utf-8", "replace")
+    except OSError:
+        return False  # stale pidfile: process is gone
+    return "tpu_extra_watch" in cmdline and "sh" != os.path.basename(
+        cmdline.split("\0", 1)[0])
+
+
+def phase1_finished() -> bool:
+    # A dead phase-1 process is finished no matter what its log says (it
+    # may have been killed mid-matrix without writing a terminal marker) —
+    # the process check also covers "phase-1 never ran at all", since by
+    # the time this is polled our own tbw.log() banner has already created
+    # the log file.
+    if not phase1_running():
+        return True
+    try:
+        text = open(PHASE1_LOG).read()
+    except OSError:
+        return True
+    # Only count markers after the LAST "watching for TPU" banner (earlier
+    # sessions' deadline lines would otherwise satisfy the check).
+    i = text.rfind("watching for TPU")
+    tail = text if i < 0 else text[i:]
+    return "matrix finished" in tail or "deadline reached" in tail
+
+
+def main() -> None:
+    max_wait_h = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
+    if another_phase2_running():
+        print("another tpu_extra_watch instance is alive — exiting",
+              flush=True)
+        return
+    os.makedirs(tbw.OUT, exist_ok=True)
+    with open(PIDFILE, "w") as fh:
+        fh.write(str(os.getpid()))
+    tbw.MATRIX = EXTRA
+    tbw.log(f"phase-2: waiting for phase-1 matrix (max {max_wait_h:.1f}h)")
+    deadline = time.time() + max_wait_h * 3600
+    while time.time() < deadline and not phase1_finished():
+        time.sleep(120)
+    if not phase1_finished():
+        tbw.log("phase-2: gave up waiting for phase-1")
+        return
+    remaining_h = max((deadline - time.time()) / 3600, 0.1)
+    sys.argv = [sys.argv[0], f"{remaining_h:.2f}"]
+    tbw.main()
+
+
+if __name__ == "__main__":
+    main()
