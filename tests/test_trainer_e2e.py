@@ -61,6 +61,30 @@ def test_train_checkpoint_resume_roundtrip(srn_root, tmp_path):
     t2.ckpt.close()
 
 
+def test_finite_data_iter_exactly_num_steps(srn_root, tmp_path):
+    # A user-injected iterator yielding EXACTLY num_steps batches must
+    # complete training and write the final checkpoint — the depth-1
+    # device prefetch may not demand an extra batch (its StopIteration on
+    # the last step's lookahead is caught and only re-raised if another
+    # step actually needs data).
+    from novel_view_synthesis_3d_tpu.data.pipeline import iter_batches
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+
+    tmp = str(tmp_path)
+    cfg = _config(srn_root, tmp, num_steps=3, resume=False)
+    ds = SRNDataset(srn_root, img_sidelength=16)
+    src = iter_batches(ds, 8, seed=0)
+    finite = iter([next(src) for _ in range(3)])
+    t = Trainer(config=cfg, data_iter=finite, use_grain=False)
+    t.train()
+    assert t.step == 3
+    t.ckpt.wait()
+    assert t.ckpt.latest_step() == 3
+    # The dead prefetch slot is released for post-training sampling/eval.
+    assert t._device_batch is None
+    t.ckpt.close()
+
+
 def test_metrics_csv_written(srn_root, tmp_path):
     tmp = str(tmp_path)
     cfg = _config(srn_root, tmp, num_steps=2, resume=False)
