@@ -241,7 +241,7 @@ def cmd_eval(args, overrides: List[str]) -> int:
     # mesh (e.g. mesh.data=32) doesn't crash an eval on a smaller host.
     mesh = None
     batch_size = args.batch_size
-    if len(jax.devices()) > 1 and args.protocol == "single":
+    if len(jax.devices()) > 1:
         from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
 
         mesh = mesh_lib.fit_local_mesh(cfg.mesh)

@@ -90,8 +90,7 @@ def evaluate_dataset(
     `protocol`: "single" scores every target independently conditioned on
     the fixed view; "autoregressive" runs the 3DiM stochastic-conditioning
     protocol, where each generated view joins the conditioning pool for the
-    next (`batch_size` then counts instances per sampler call, and `mesh`
-    is unsupported).
+    next (`batch_size` then counts instances per sampler call).
 
     `fid_feature_fn`: optional pretrained (B,H,W,C)→(B,D) embedder; when
     given, the Fréchet metric is reported as "fid". Default None uses the
@@ -107,17 +106,12 @@ def evaluate_dataset(
         raise ValueError(f"unknown eval protocol {protocol!r}")
     dcfg = config.diffusion
     schedule = sampling_schedule(dcfg, sample_steps)
-    if protocol == "autoregressive":
-        if mesh is not None:
-            raise ValueError(
-                "protocol='autoregressive' does not support mesh-sharded "
-                "sampling; pass mesh=None")
-        if jax.process_count() > 1:
-            # Same hazard as the mesh path: every process would duplicate
-            # the full eval and race on any output file.
-            raise ValueError(
-                "evaluate_dataset(protocol='autoregressive') is "
-                "single-process only; on a pod, run eval on one host")
+    if protocol == "autoregressive" and jax.process_count() > 1:
+        # Every process would duplicate the full eval and race on any
+        # output file (the batched pool/target inputs here are host-local).
+        raise ValueError(
+            "evaluate_dataset(protocol='autoregressive') is "
+            "single-process only; on a pod, run eval on one host")
     if mesh is not None:
         if jax.process_count() > 1:
             # Every process assembles the FULL batch here; the multi-process
@@ -199,6 +193,13 @@ def evaluate_dataset(
                     [[p[:3, 3] for (_, p) in c[3][:n_targets]]
                      for c in chunk])),
             }
+            if mesh is not None:
+                # Shard the instance batch over the mesh 'data' axis; the
+                # growing view pool inside autoregressive_generate inherits
+                # the sharding from these inputs, so every reverse process
+                # runs data-parallel across chips.
+                first_view = mesh_lib.shard_batch(mesh, first_view)
+                target_poses = mesh_lib.shard_batch(mesh, target_poses)
             truth = np.stack([[t for (t, _) in c[3][:n_targets]]
                               for c in chunk[:n]])  # (n, N, H, W, 3)
             key, k_s = jax.random.split(key)

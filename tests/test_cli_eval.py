@@ -360,6 +360,15 @@ def test_evaluate_dataset_mesh_matches_single_device(tmp_path):
         evaluate_dataset(cfg, model, params, ds, mesh=mesh,
                          **dict(kwargs, batch_size=6))
 
+    # The 3DiM autoregressive protocol shards over the mesh too (the pool
+    # inputs carry the 'data' sharding into every stochastic-sampler call).
+    ar = dict(kwargs, protocol="autoregressive")
+    ar_single = evaluate_dataset(cfg, model, params, ds, **ar)
+    ar_sharded = evaluate_dataset(cfg, model, params, ds, mesh=mesh, **ar)
+    assert ar_single.num_views == ar_sharded.num_views == 8
+    np.testing.assert_allclose(ar_sharded.per_view_psnr,
+                               ar_single.per_view_psnr, rtol=1e-4)
+
 
 def test_export_uses_ema_params(tmp_path):
     """With EMA on, `export` writes the EMA params (what you sample with),
