@@ -27,6 +27,12 @@ EXTRA = [
     ("sample_dpmpp32_base128", ["bench.py", "sample", "base128", "32",
                                 "diffusion.sampler=dpm++"], 2400),
     ("sample_base128_256", ["bench.py", "sample", "base128", "256"], 2400),
+    # bf16 A/B on the f32 tiny64 preset (train + 256-step sample): the
+    # compute-dtype lever measured at the small end of the ladder.
+    ("tiny64_bf16_train", ["bench.py", "tiny64", "30",
+                           "model.dtype=bfloat16"], 1800),
+    ("sample_bf16_tiny64_256", ["bench.py", "sample", "tiny64", "256",
+                                "model.dtype=bfloat16"], 2400),
     # Sampler quality/speed table on the checkpoint the phase-1 quality run
     # retained under its out_dir; --config reloads the exact resolved model
     # shape that run trained (checkpoint dir included). Runs as its own
