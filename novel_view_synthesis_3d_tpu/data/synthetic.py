@@ -9,7 +9,6 @@ actually reduce loss and a restored pipeline reproduces identical records.
 from __future__ import annotations
 
 import os
-from typing import Tuple
 
 import numpy as np
 from PIL import Image
