@@ -88,9 +88,12 @@ def build(preset_name: str, overrides=()):
     return cfg, mesh, model, schedule, state, step, batch, device_batch
 
 
-REPEATS = 3  # median-of-N timing: the remote-TPU tunnel adds bimodal
+REPEATS = 5  # median-of-N timing: the remote-TPU tunnel adds bimodal
 # dispatch-latency noise that a single short loop can't average out (and a
-# min would chase fast-direction artifacts).
+# min would chase fast-direction artifacts). Applies to the TRAIN benches
+# (bench_framework / bench_reference_style, ~seconds per rep at 20-30
+# steps); the sampling benches keep their own small rep counts since one
+# rep is already a full multi-hundred-step reverse process.
 
 
 def _median(xs):

@@ -1,0 +1,90 @@
+"""Unit tests for the tools/ harness logic that runs unattended on TPU
+windows — the pure-Python parts (marker parsing, sweep dedupe, guards)
+whose failures would silently waste hardware time."""
+
+import importlib
+import os
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+@pytest.fixture()
+def extra_watch(monkeypatch, tmp_path):
+    monkeypatch.syspath_prepend(TOOLS)
+    import tpu_bench_watch as tbw
+    mod = importlib.import_module("tpu_extra_watch")
+    # Isolate filesystem state: phase-1 log + pidfile under tmp.
+    monkeypatch.setattr(tbw, "OUT", str(tmp_path))
+    monkeypatch.setattr(mod, "PHASE1_LOG", str(tmp_path / "log.txt"))
+    monkeypatch.setattr(mod, "PIDFILE", str(tmp_path / "extra_watch.pid"))
+    return mod
+
+
+def test_phase1_finished_requires_marker_after_last_banner(extra_watch,
+                                                           monkeypatch,
+                                                           tmp_path):
+    mod = extra_watch
+    monkeypatch.setattr(mod, "phase1_running", lambda: True)
+    log = tmp_path / "log.txt"
+    # Marker from an EARLIER session must not count once a new banner opens.
+    log.write_text("[01:00] watching for TPU (max 10h)\n"
+                   "[02:00] matrix finished: ok=[...]\n"
+                   "[03:00] watching for TPU (max 10h)\n"
+                   "[03:05] probe timed out\n")
+    assert not mod.phase1_finished()
+    log.write_text(log.read_text() + "[04:00] deadline reached: ok=[]\n")
+    assert mod.phase1_finished()
+
+
+def test_phase1_finished_when_process_dead_despite_no_marker(extra_watch,
+                                                             monkeypatch,
+                                                             tmp_path):
+    mod = extra_watch
+    (tmp_path / "log.txt").write_text(
+        "[01:00] watching for TPU (max 10h)\n"
+        "[01:30] tunnel died mid-matrix; resuming watch\n")
+    monkeypatch.setattr(mod, "phase1_running", lambda: False)
+    assert mod.phase1_finished()  # killed phase-1 must not block forever
+    monkeypatch.setattr(mod, "phase1_running", lambda: True)
+    assert not mod.phase1_finished()
+
+
+def test_phase1_finished_no_banner_at_all(extra_watch, monkeypatch,
+                                          tmp_path):
+    # A log that contains only OUR phase-2 banner (tbw.log creates the file
+    # before the first poll): rfind miss must not reduce the search window.
+    mod = extra_watch
+    (tmp_path / "log.txt").write_text("[01:00] phase-2: waiting\n")
+    monkeypatch.setattr(mod, "phase1_running", lambda: True)
+    assert not mod.phase1_finished()
+
+
+def test_double_launch_guard_pidfile(extra_watch, monkeypatch, tmp_path):
+    mod = extra_watch
+    # No pidfile: free to run.
+    assert not mod.another_phase2_running()
+    # Our own pid: not "another".
+    (tmp_path / "extra_watch.pid").write_text(str(os.getpid()))
+    assert not mod.another_phase2_running()
+    # A live pid whose cmdline is NOT tpu_extra_watch (this pytest process
+    # stands in): guard must not trip on recycled pids.
+    monkeypatch.setattr(mod.os, "getpid", lambda: 1)
+    assert not mod.another_phase2_running()
+    # Stale pid (no such process).
+    (tmp_path / "extra_watch.pid").write_text("999999999")
+    assert not mod.another_phase2_running()
+
+
+def test_sampler_comparison_sweep_dedupes_after_clamp(monkeypatch):
+    monkeypatch.syspath_prepend(TOOLS)
+    import sampler_comparison as sc
+
+    # A short training schedule must collapse the sweep to one entry per
+    # sampler, preserving order (this is the helper main() actually calls).
+    assert sc.clamped_sweep(sc.SWEEP, 8) == [
+        ("ddpm", 8), ("ddim", 8), ("dpm++", 8)]
+    # No clamping: the full ladder survives untouched.
+    assert sc.clamped_sweep(sc.SWEEP, 1000) == sc.SWEEP

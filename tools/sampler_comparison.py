@@ -40,6 +40,17 @@ SWEEP = [
 ]
 
 
+def clamped_sweep(sweep, timesteps: int):
+    """Clamp step counts to the training schedule and drop the duplicate
+    (sampler, steps) pairs clamping creates, preserving order."""
+    out = []
+    for sampler, steps in sweep:
+        pair = (sampler, min(steps, timesteps))
+        if pair not in out:
+            out.append(pair)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("folder")
@@ -103,14 +114,8 @@ def main() -> int:
                                          None) is not None else state.params
     print(f"restored checkpoint at step {step}", flush=True)
 
-    sweep = []
-    for sampler, steps in SWEEP:
-        pair = (sampler, min(steps, cfg.diffusion.timesteps))
-        if pair not in sweep:  # clamping can collapse entries
-            sweep.append(pair)
-
     rows = []
-    for sampler, steps in sweep:
+    for sampler, steps in clamped_sweep(SWEEP, cfg.diffusion.timesteps):
         run_cfg = dataclasses.replace(
             cfg, diffusion=dataclasses.replace(cfg.diffusion, sampler=sampler))
         t0 = time.perf_counter()
