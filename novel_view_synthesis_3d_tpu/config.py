@@ -280,6 +280,17 @@ class Config:
                 "train.eval_every is set")
         if t.batch_size < 1:
             errors.append("train.batch_size must be >= 1")
+        if self.data.samples_per_instance < 1:
+            errors.append(
+                f"data.samples_per_instance={self.data.samples_per_instance}"
+                " must be >= 1")
+        elif t.batch_size % self.data.samples_per_instance != 0:
+            # Each index draw contributes samples_per_instance consecutive
+            # batch slots (reference data_loader.py:183-195 semantics).
+            errors.append(
+                f"train.batch_size={t.batch_size} must be a multiple of "
+                f"data.samples_per_instance="
+                f"{self.data.samples_per_instance}")
         if t.adam_mu_dtype not in ("float32", "bfloat16"):
             errors.append(
                 f"train.adam_mu_dtype={t.adam_mu_dtype!r} must be "

@@ -261,6 +261,12 @@ def make_native_loader(dataset, batch_size: int, *, num_cond: int = 1,
                        shard_index: int = 0,
                        shard_count: int = 1) -> NativePairLoader:
     """Build a NativePairLoader from a data/srn.SRNDataset."""
+    if getattr(dataset, "samples_per_instance", 1) > 1:
+        # Only the in-process iterator implements instance grouping;
+        # silently batching per-record would drop the configured semantics.
+        raise ValueError(
+            "samples_per_instance > 1 is not supported by the native "
+            "loader; use the in-process backend (data.loader='python')")
     rgb: List[str] = []
     pose: List[str] = []
     inst: List[int] = []

@@ -34,6 +34,7 @@ def make_dataset(cfg: DataConfig) -> SRNDataset:
         max_num_instances=cfg.max_num_instances,
         max_observations_per_instance=cfg.max_observations_per_instance,
         specific_observation_idcs=cfg.specific_observation_idcs,
+        samples_per_instance=cfg.samples_per_instance,
     )
 
 
@@ -63,6 +64,12 @@ def make_grain_loader(dataset: SRNDataset, batch_size: int,
                       drop_remainder: bool = True,
                       num_cond: int = 1):
     """Grain DataLoader yielding batched numpy dicts (per-host shard)."""
+    if getattr(dataset, "samples_per_instance", 1) > 1:
+        # Only the in-process iterator implements instance grouping;
+        # silently batching per-record would drop the configured semantics.
+        raise ValueError(
+            "samples_per_instance > 1 is not supported by the Grain "
+            "loader; use the in-process backend (data.loader='python')")
     import grain.python as pygrain
     import jax
 
@@ -101,24 +108,42 @@ def make_grain_loader(dataset: SRNDataset, batch_size: int,
 def iter_batches(dataset: SRNDataset, batch_size: int, *, seed: int = 0,
                  shard_index: int = 0, shard_count: int = 1,
                  num_cond: int = 1) -> Iterator[dict]:
-    """Infinite shuffled batch iterator without worker processes."""
+    """Infinite shuffled batch iterator without worker processes.
+
+    With dataset.samples_per_instance > 1 each index draw contributes that
+    many consecutive batch slots from ONE instance (reference
+    data_loader.py:183-195 semantics, where the torch collate flattens the
+    per-item observation list); batch_size still counts MODEL samples, so
+    it must be a multiple of samples_per_instance.
+    """
     rng = np.random.default_rng(seed + shard_index)
+    spi = getattr(dataset, "samples_per_instance", 1)
+    if batch_size % spi != 0:
+        raise ValueError(
+            f"batch_size {batch_size} not divisible by "
+            f"samples_per_instance {spi}")
+    draws = batch_size // spi
     n = len(dataset)
     local = np.arange(shard_index, n, shard_count)
-    if len(local) < batch_size:
+    if len(local) < draws:
         # Drop-last semantics (matching the Grain path and the reference's
         # DataLoader(drop_last=True)) would yield ZERO batches here; without
         # this check the while-True below would spin forever producing
         # nothing — a silent 100%-CPU hang instead of an error.
         raise ValueError(
-            f"dataset shard has {len(local)} records but batch_size is "
-            f"{batch_size} — with drop-last batching no batch can ever be "
-            "formed; lower train.batch_size or provide more data")
+            f"dataset shard has {len(local)} records but the batch needs "
+            f"{draws} index draws — with drop-last batching no batch can "
+            "ever be formed; lower train.batch_size or provide more data")
     while True:
         order = rng.permutation(local)
-        for start in range(0, len(order) - batch_size + 1, batch_size):
-            records = [dataset.pair(int(i), rng, num_cond=num_cond)
-                       for i in order[start:start + batch_size]]
+        for start in range(0, len(order) - draws + 1, draws):
+            if spi == 1:  # any dataset exposing .pair() works here
+                records = [dataset.pair(int(i), rng, num_cond=num_cond)
+                           for i in order[start:start + draws]]
+            else:
+                records = [r for i in order[start:start + draws]
+                           for r in dataset.samples(int(i), rng,
+                                                    num_cond=num_cond)]
             yield {k: np.stack([r[k] for r in records]) for k in records[0]}
 
 

@@ -181,9 +181,14 @@ class SRNDataset:
     def __init__(self, root_dir: str, img_sidelength: int = 64,
                  max_num_instances: int = -1,
                  max_observations_per_instance: int = -1,
-                 specific_observation_idcs: Optional[Sequence[int]] = None):
+                 specific_observation_idcs: Optional[Sequence[int]] = None,
+                 samples_per_instance: int = 1):
+        if samples_per_instance < 1:
+            raise ValueError(
+                f"samples_per_instance must be >= 1, got {samples_per_instance}")
         self.root_dir = root_dir
         self.img_sidelength = img_sidelength
+        self.samples_per_instance = samples_per_instance
         instance_dirs = sorted(glob(os.path.join(root_dir, "*/")))
         if not instance_dirs:
             raise FileNotFoundError(f"no instances under {root_dir!r}")
@@ -262,3 +267,20 @@ class SRNDataset:
             "t2": pose2[:3, 3],
             "K": inst.K,
         }
+
+    def samples(self, flat_idx: int, rng: np.random.Generator,
+                num_cond: int = 1) -> List[dict]:
+        """`samples_per_instance` records from flat_idx's instance.
+
+        Reference semantics (data_loader.py:183-195): the indexed
+        observation first, then samples_per_instance−1 observations at
+        uniformly random view indices of the SAME instance — the torch
+        collate then flattens them into the batch. Callers stack the list
+        into consecutive batch slots (pipeline.iter_batches)."""
+        records = [self.pair(flat_idx, rng, num_cond=num_cond)]
+        obj, _ = self.locate(flat_idx)
+        base = int(self._offsets[obj])
+        for _ in range(self.samples_per_instance - 1):
+            v = int(rng.integers(len(self.instances[obj])))
+            records.append(self.pair(base + v, rng, num_cond=num_cond))
+        return records

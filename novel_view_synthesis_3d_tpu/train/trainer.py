@@ -102,7 +102,23 @@ class Trainer:
             assert len(self.dataset) > 0
             local_bs = dist.local_batch_size(tcfg.batch_size)
             num_cond = config.model.num_cond_frames
+            spi = config.data.samples_per_instance
+            if spi > 1 and local_bs % spi != 0:
+                # Config.validate checks the GLOBAL batch (it has no process
+                # topology); the per-host slice must divide too.
+                raise ValueError(
+                    f"per-host batch {local_bs} (train.batch_size="
+                    f"{tcfg.batch_size} over {jax.process_count()} "
+                    f"processes) is not divisible by "
+                    f"data.samples_per_instance={spi}")
             backend = config.data.loader if use_grain else "python"
+            if spi > 1 and backend != "python":
+                # Instance-grouped sampling (reference data_loader.py:183-195)
+                # is implemented by the in-process iterator only; the Grain
+                # and native loaders batch per-record.
+                print(f"note: data.samples_per_instance={spi} uses the "
+                      f"in-process loader (requested {backend!r})")
+                backend = "python"
             if backend == "native":
                 from novel_view_synthesis_3d_tpu.data import native_io
                 if native_io.available():

@@ -129,6 +129,34 @@ def test_iter_batches_rejects_batch_larger_than_shard(srn_root):
                           shard_index=0, shard_count=10))
 
 
+def test_samples_per_instance_groups_records(srn_root):
+    # Reference data_loader.py:183-195: each index draw yields the indexed
+    # observation plus N-1 random observations of the SAME instance.
+    import numpy as np
+
+    ds = SRNDataset(srn_root, img_sidelength=16, samples_per_instance=3)
+    rng = np.random.default_rng(0)
+    flat_idx = 7  # instance 1 (6 views per instance)
+    obj, view = ds.locate(flat_idx)
+    recs = ds.samples(flat_idx, rng)
+    assert len(recs) == 3
+    inst = ds.instances[obj]
+    inst_views = np.stack([inst.view(v)[0] for v in range(len(inst))])
+    for r in recs:
+        # Every record's conditioning view is one of THIS instance's views.
+        assert (np.abs(inst_views - r["x"][None]).reshape(
+            len(inst), -1).max(axis=1) < 1e-6).any()
+    # The first record is the indexed observation itself.
+    np.testing.assert_allclose(recs[0]["x"], inst.view(view)[0], atol=1e-6)
+
+    # iter_batches flattens the groups into consecutive batch slots and
+    # keeps batch_size counting MODEL samples.
+    b = next(iter_batches(ds, batch_size=6, seed=0))
+    assert b["x"].shape == (6, 16, 16, 3)
+    with pytest.raises(ValueError, match="samples_per_instance"):
+        next(iter_batches(ds, batch_size=4, seed=0))
+
+
 def test_grain_loader(srn_root):
     ds = SRNDataset(srn_root, img_sidelength=16)
     loader = make_grain_loader(ds, batch_size=4, seed=0, num_workers=0,
