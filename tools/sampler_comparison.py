@@ -143,6 +143,9 @@ def main() -> int:
         "checkpoint_step": step,
         "preset": args.preset,
         "platform": jax.default_backend(),
+        "timing_note": "wall_sec_per_view includes each config's jit "
+                       "compile — compare rows relatively, not as "
+                       "deployment latency (bench.py sample measures that)",
         "rows": rows,
     }
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
