@@ -127,6 +127,9 @@ def cmd_sample(args, overrides: List[str]) -> int:
     if args.stochastic and args.denoise_gif:
         # Fail fast — before dataset IO and checkpoint restore.
         raise SystemExit("--denoise-gif is not supported with --stochastic")
+    if args.pool_views != 1 and not args.stochastic:
+        raise SystemExit("--pool-views requires --stochastic (it seeds the "
+                         "stochastic-conditioning pool)")
     cfg = build_config(args, overrides)
     dcfg = cfg.diffusion
     ds = SRNDataset(args.folder or cfg.data.root_dir,
@@ -166,7 +169,26 @@ def cmd_sample(args, overrides: List[str]) -> int:
 
     if args.stochastic:
         # Autoregressive 3DiM sampling: each generated view joins the
-        # conditioning pool for the next (sample/ddpm.py).
+        # conditioning pool for the next (sample/ddpm.py). --pool-views
+        # seeds the pool with that many REAL dataset views (cond_view
+        # first, then views that are not sampling targets).
+        if args.pool_views > 1:
+            cand = [args.cond_view % len(inst)]
+            targets = set(idcs) if args.poses == "dataset" else set()
+            cand += [v for v in range(len(inst))
+                     if v not in cand and v not in targets]
+            if len(cand) < args.pool_views:
+                print(f"note: only {len(cand)} non-target views available "
+                      f"for --pool-views {args.pool_views}")
+            pool_views = [inst.view(v) for v in cand[:args.pool_views]]
+            first_view = {
+                "x": jnp.asarray(np.stack([x for x, _ in pool_views]))[None],
+                "R1": jnp.asarray(np.stack(
+                    [p[:3, :3] for _, p in pool_views]))[None],
+                "t1": jnp.asarray(np.stack(
+                    [p[:3, 3] for _, p in pool_views]))[None],
+                "K": first_view["K"],
+            }
         target_poses = {
             "R2": jnp.asarray(poses2[None, :, :3, :3]),
             "t2": jnp.asarray(poses2[None, :, :3, 3]),
@@ -379,6 +401,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--cond-view", type=int, default=0)
     p.add_argument("--num-views", type=int, default=8)
     p.add_argument("--poses", choices=("dataset", "orbit"), default="dataset")
+    p.add_argument("--pool-views", type=int, default=1,
+                   help="with --stochastic: seed the conditioning pool "
+                        "with this many REAL dataset views (default 1, "
+                        "the 3DiM paper protocol)")
     p.add_argument("--elevation", type=float, default=0.3,
                    help="orbit elevation (radians), --poses orbit only")
     p.add_argument("--stochastic", action="store_true",

@@ -362,29 +362,42 @@ def autoregressive_generate(model, schedule: DiffusionSchedule,
                             sampler=None) -> jnp.ndarray:
     """Generate a trajectory of novel views autoregressively.
 
-    Starting from one real view (`first_view`: x (B,H,W,3), R1, t1, K), each
-    target pose in `target_poses` (R2/t2: (B, N, …)) is sampled with
-    stochastic conditioning over ALL previously available views, and the
-    result joins the pool — the 3DiM sampling strategy. Returns
-    (B, N, H, W, 3). One compiled sampler serves every iteration (the pool
-    is padded to `max_pool`). A caller looping over many batches should
-    build the sampler once with `make_stochastic_sampler` and pass it as
-    `sampler` so each call reuses the same jit cache.
+    Starting from the real view(s) in `first_view` (x (B,H,W,3) for one
+    view — the 3DiM paper protocol — or (B,P0,H,W,3) for a pool of P0 real
+    captures; R1/t1 ranked alike; K (B,3,3)), each target pose in
+    `target_poses` (R2/t2: (B, N, …)) is sampled with stochastic
+    conditioning over ALL available views, and the result joins the pool.
+    Returns (B, N, H, W, 3). One compiled sampler serves every iteration
+    (the pool is padded to `max_pool`). A caller looping over many batches
+    should build the sampler once with `make_stochastic_sampler` and pass
+    it as `sampler` so each call reuses the same jit cache.
     """
-    B, H, W, C = first_view["x"].shape
+    if first_view["x"].ndim == 4:  # single real view → pool of one
+        first_view = dict(
+            first_view,
+            x=first_view["x"][:, None],
+            R1=first_view["R1"][:, None],
+            t1=first_view["t1"][:, None],
+        )
+    B, P0, H, W, C = first_view["x"].shape
     N = target_poses["R2"].shape[1]
-    max_pool = max_pool or (N + 1)
+    max_pool = max_pool or (N + P0)
+    if max_pool < P0:
+        raise ValueError(f"max_pool {max_pool} < {P0} initial views")
     if sampler is None:
         sampler = make_stochastic_sampler(model, schedule, config, max_pool)
 
     # Pool padded with repeats of the first view (never selected: idx < n).
     pool = {
-        "x": jnp.broadcast_to(first_view["x"][:, None],
-                              (B, max_pool, H, W, C)).copy(),
-        "R1": jnp.broadcast_to(first_view["R1"][:, None],
-                               (B, max_pool, 3, 3)).copy(),
-        "t1": jnp.broadcast_to(first_view["t1"][:, None],
-                               (B, max_pool, 3)).copy(),
+        "x": jnp.concatenate(
+            [first_view["x"], jnp.broadcast_to(
+                first_view["x"][:, :1], (B, max_pool - P0, H, W, C))], 1),
+        "R1": jnp.concatenate(
+            [first_view["R1"], jnp.broadcast_to(
+                first_view["R1"][:, :1], (B, max_pool - P0, 3, 3))], 1),
+        "t1": jnp.concatenate(
+            [first_view["t1"], jnp.broadcast_to(
+                first_view["t1"][:, :1], (B, max_pool - P0, 3))], 1),
     }
     outs = []
     for i in range(N):
@@ -394,11 +407,15 @@ def autoregressive_generate(model, schedule: DiffusionSchedule,
             "t2": target_poses["t2"][:, i],
             "K": first_view["K"],
         }
+        # Valid slots: views generated past a small max_pool are not stored
+        # (guard below), so the draw range must cap at capacity — an
+        # uncapped count would make randint exceed the pool and JAX's index
+        # clamping would silently bias selection toward the last slot.
         img = sampler(params, k_i, pool, target_pose,
-                      jnp.asarray(i + 1, jnp.int32))
+                      jnp.asarray(min(P0 + i, max_pool), jnp.int32))
         outs.append(img)
-        if i + 1 < max_pool:
-            pool["x"] = pool["x"].at[:, i + 1].set(img)
-            pool["R1"] = pool["R1"].at[:, i + 1].set(target_pose["R2"])
-            pool["t1"] = pool["t1"].at[:, i + 1].set(target_pose["t2"])
+        if P0 + i < max_pool:
+            pool["x"] = pool["x"].at[:, P0 + i].set(img)
+            pool["R1"] = pool["R1"].at[:, P0 + i].set(target_pose["R2"])
+            pool["t1"] = pool["t1"].at[:, P0 + i].set(target_pose["t2"])
     return jnp.stack(outs, axis=1)

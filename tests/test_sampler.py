@@ -164,6 +164,46 @@ def test_ddim_respaced_matches_shapes():
     assert np.isfinite(imgs).all()
 
 
+def test_autoregressive_multi_view_pool_seed():
+    # first_view with a pool axis (B, P0, ...) seeds stochastic
+    # conditioning with P0 REAL views; the single-view form (B, ...) is
+    # the P0=1 special case and must produce identical results.
+    dcfg = DiffusionConfig(timesteps=6, sample_timesteps=6)
+    sched = make_schedule(dcfg)
+    model, params, cond = _model_and_params()
+    N = 2
+    target_poses = {
+        "R2": jnp.stack([cond["R2"]] * N, axis=1),
+        "t2": jnp.stack([cond["t2"]] * N, axis=1),
+    }
+    single = {"x": cond["x"], "R1": cond["R1"], "t1": cond["t1"],
+              "K": cond["K"]}
+    as_pool1 = {"x": cond["x"][:, None], "R1": cond["R1"][:, None],
+                "t1": cond["t1"][:, None], "K": cond["K"]}
+    a = autoregressive_generate(model, sched, dcfg, params,
+                                jax.random.PRNGKey(0), single, target_poses)
+    b = autoregressive_generate(model, sched, dcfg, params,
+                                jax.random.PRNGKey(0), as_pool1,
+                                target_poses)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # P0=2 real views: output differs (more conditioning) and stays finite.
+    pool2 = {
+        "x": jnp.stack([cond["x"], cond["x"] * 0.5], axis=1),
+        "R1": jnp.stack([cond["R1"], cond["R2"]], axis=1),
+        "t1": jnp.stack([cond["t1"], cond["t2"]], axis=1),
+        "K": cond["K"],
+    }
+    c = autoregressive_generate(model, sched, dcfg, params,
+                                jax.random.PRNGKey(0), pool2, target_poses)
+    assert c.shape == (2, N, 16, 16, 3)
+    assert np.isfinite(np.asarray(c)).all()
+    import pytest
+    with pytest.raises(ValueError, match="max_pool"):
+        autoregressive_generate(model, sched, dcfg, params,
+                                jax.random.PRNGKey(0), pool2, target_poses,
+                                max_pool=1)
+
+
 def test_dpmpp_step_reduces_to_ddim_on_constant_x0():
     # With x̂₀_cur == x̂₀_prev the 2M extrapolation is the identity, so every
     # dpm++ step must equal the η=0 DDIM step on the same x̂₀ — including the
