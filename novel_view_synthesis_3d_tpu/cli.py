@@ -120,7 +120,8 @@ def cmd_sample(args, overrides: List[str]) -> int:
     from novel_view_synthesis_3d_tpu.sample.ddpm import (
         autoregressive_generate, make_sampler)
     from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
-    from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+    from novel_view_synthesis_3d_tpu.utils.geometry import (
+        interpolate_poses, orbit_poses)
     from novel_view_synthesis_3d_tpu.utils.images import (
         save_animation, save_image, save_image_grid)
 
@@ -137,11 +138,17 @@ def cmd_sample(args, overrides: List[str]) -> int:
     inst = ds.instances[args.instance % ds.num_instances]
     x, pose1 = inst.view(args.cond_view % len(inst))
 
-    # Target poses: dataset ground-truth poses or a synthetic orbit.
+    # Target poses: dataset ground-truth poses, a synthetic orbit, or a
+    # smooth slerp path through the instance's dataset poses.
     if args.poses == "dataset":
         idcs = [v for v in range(len(inst))
                 if v != args.cond_view % len(inst)][:args.num_views]
         poses2 = np.stack([inst.view(v)[1] for v in idcs])
+    elif args.poses == "interp":
+        # Poses only — inst.view() would decode every RGB just to drop it.
+        from novel_view_synthesis_3d_tpu.data.srn import load_pose
+        keyframes = np.stack([load_pose(p) for p in inst.pose_paths])
+        poses2 = interpolate_poses(keyframes, args.num_views)
     else:
         radius = float(np.linalg.norm(pose1[:3, 3]))
         poses2 = orbit_poses(args.num_views, radius=radius,
@@ -400,7 +407,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--instance", type=int, default=0)
     p.add_argument("--cond-view", type=int, default=0)
     p.add_argument("--num-views", type=int, default=8)
-    p.add_argument("--poses", choices=("dataset", "orbit"), default="dataset")
+    p.add_argument("--poses", choices=("dataset", "orbit", "interp"),
+                   default="dataset",
+                   help="targets: dataset ground-truth poses, a synthetic "
+                        "orbit, or a smooth slerp path through the "
+                        "instance's poses")
     p.add_argument("--pool-views", type=int, default=1,
                    help="with --stochastic: seed the conditioning pool "
                         "with this many REAL dataset views (default 1, "

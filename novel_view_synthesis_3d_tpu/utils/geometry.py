@@ -91,6 +91,83 @@ def orbit_poses(num: int, radius: float, elevation: float = 0.0,
     return np.stack(poses).astype(np.float32)
 
 
+def _mat_to_quat(R: np.ndarray) -> np.ndarray:
+    """Rotation matrix → unit quaternion (w, x, y, z), Shepperd's method."""
+    m = np.asarray(R, dtype=np.float64)
+    t = np.trace(m)
+    if t > 0:
+        s = np.sqrt(t + 1.0) * 2.0
+        q = np.array([0.25 * s, (m[2, 1] - m[1, 2]) / s,
+                      (m[0, 2] - m[2, 0]) / s, (m[1, 0] - m[0, 1]) / s])
+    else:
+        i = int(np.argmax(np.diag(m)))
+        j, k = (i + 1) % 3, (i + 2) % 3
+        s = np.sqrt(max(m[i, i] - m[j, j] - m[k, k] + 1.0, 0.0)) * 2.0
+        q = np.empty(4)
+        q[0] = (m[k, j] - m[j, k]) / s
+        q[1 + i] = 0.25 * s
+        q[1 + j] = (m[j, i] + m[i, j]) / s
+        q[1 + k] = (m[k, i] + m[i, k]) / s
+    return q / np.linalg.norm(q)
+
+
+def _quat_to_mat(q: np.ndarray) -> np.ndarray:
+    w, x, y, z = q / np.linalg.norm(q)
+    return np.array([
+        [1 - 2 * (y * y + z * z), 2 * (x * y - w * z), 2 * (x * z + w * y)],
+        [2 * (x * y + w * z), 1 - 2 * (x * x + z * z), 2 * (y * z - w * x)],
+        [2 * (x * z - w * y), 2 * (y * z + w * x), 1 - 2 * (x * x + y * y)],
+    ])
+
+
+def _slerp(qa: np.ndarray, qb: np.ndarray, u: float) -> np.ndarray:
+    """Spherical interpolation between unit quaternions (shortest arc)."""
+    dot = float(np.dot(qa, qb))
+    if dot < 0.0:  # q and -q are the same rotation; take the short way
+        qb, dot = -qb, -dot
+    if dot > 0.9995:  # nearly parallel: lerp avoids a 0/0
+        q = qa + u * (qb - qa)
+        return q / np.linalg.norm(q)
+    theta = np.arccos(np.clip(dot, -1.0, 1.0))
+    return (np.sin((1.0 - u) * theta) * qa
+            + np.sin(u * theta) * qb) / np.sin(theta)
+
+
+def interpolate_poses(keyframes: np.ndarray, num: int,
+                      closed: bool = True) -> np.ndarray:
+    """(num, 4, 4) smooth path through (M, 4, 4) keyframe cam→world poses.
+
+    Rotations take the quaternion slerp shortest arc between consecutive
+    keyframes; translations interpolate linearly. `closed=True` loops back
+    to the first keyframe (seamless turntable GIFs); False ends at the last
+    keyframe. Framework extension — the reference can only replay dataset
+    poses (sampling.py uses the loader's poses verbatim).
+    """
+    keyframes = np.asarray(keyframes, dtype=np.float64)
+    M = keyframes.shape[0]
+    if M < 2:
+        raise ValueError(f"need >= 2 keyframes, got {M}")
+    if num < 1:
+        raise ValueError(f"num must be >= 1, got {num}")
+    quats = [_mat_to_quat(k[:3, :3]) for k in keyframes]
+    n_seg = M if closed else M - 1
+    # Global parameter s ∈ [0, n_seg): endpoint excluded when closed (the
+    # loop wraps), included when open (end exactly at the last keyframe).
+    s_vals = (np.arange(num) * n_seg / num if closed
+              else np.linspace(0.0, n_seg, num))
+    poses = []
+    for s in s_vals:
+        seg = min(int(np.floor(s)), n_seg - 1)
+        u = s - seg
+        a, b = seg % M, (seg + 1) % M
+        pose = np.eye(4)
+        pose[:3, :3] = _quat_to_mat(_slerp(quats[a], quats[b], u))
+        pose[:3, 3] = ((1.0 - u) * keyframes[a][:3, 3]
+                       + u * keyframes[b][:3, 3])
+        poses.append(pose)
+    return np.stack(poses).astype(np.float32)
+
+
 def transform_viewpoint(v: np.ndarray) -> np.ndarray:
     """(N, 5) [x, y, z, yaw, pitch] → (N, 7) [x, y, z, cos/sin yaw, cos/sin
     pitch] — the consistent viewpoint representation of data_util.py:145-152."""

@@ -15,6 +15,7 @@ from novel_view_synthesis_3d_tpu.data.prep import (
 from novel_view_synthesis_3d_tpu.data.srn import load_depth, load_params
 from novel_view_synthesis_3d_tpu.utils.geometry import (
     euler2mat,
+    interpolate_poses,
     look_at,
     orbit_poses,
     pose_from_look_at,
@@ -202,3 +203,32 @@ def test_save_animation_rejects_bad_fps(tmp_path):
     for fps in (0, -5):
         with pytest.raises(ValueError, match="fps"):
             save_animation(imgs, str(tmp_path / "x.gif"), fps=fps)
+
+
+def test_interpolate_poses_hits_keyframes_and_halves_rotation():
+    # Two keyframes 90 deg apart around z, same radius: the open path's
+    # endpoints are the keyframes and its midpoint rotation is 45 deg with
+    # linearly interpolated translation; every sample stays a rigid pose.
+    k0 = np.eye(4)
+    k1 = np.eye(4)
+    k1[:3, :3] = euler2mat(z=np.pi / 2)
+    k0[:3, 3] = [1.0, 0.0, 0.0]
+    k1[:3, 3] = [0.0, 1.0, 0.0]
+    path = interpolate_poses(np.stack([k0, k1]), 3, closed=False)
+    assert path.shape == (3, 4, 4)
+    np.testing.assert_allclose(path[0], k0, atol=1e-6)
+    np.testing.assert_allclose(path[-1], k1, atol=1e-6)
+    assert abs(rotation_angle(k0[:3, :3], path[1][:3, :3])
+               - np.pi / 4) < 1e-5
+    np.testing.assert_allclose(path[1][:3, 3], [0.5, 0.5, 0.0], atol=1e-6)
+    for p in path:
+        R = p[:3, :3]
+        np.testing.assert_allclose(R @ R.T, np.eye(3), atol=1e-5)
+        assert abs(np.linalg.det(R) - 1.0) < 1e-5
+
+    # Closed path starts at keyframe 0 and wraps (no duplicate endpoint).
+    closed = interpolate_poses(np.stack([k0, k1]), 8, closed=True)
+    np.testing.assert_allclose(closed[0], k0, atol=1e-6)
+    assert not np.allclose(closed[-1], k0, atol=1e-6)
+    with pytest.raises(ValueError, match="keyframes"):
+        interpolate_poses(k0[None], 4)
