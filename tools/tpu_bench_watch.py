@@ -37,6 +37,17 @@ MATRIX = [
     # (name, argv after `python`, timeout_s). "bench.py ..." entries emit
     # the one-line JSON; the quality entry trains on the raytraced dataset
     # at 64px on the real chip (VERDICT r1 item 5 at full scale).
+    # Completed on 2026-07-31 (artifacts committed in results/tpu_r02/):
+    # tiny64_train, base128_remat_{off,full,dots}. The remaining entries
+    # are ordered cheap-headline-first so a SHORT tunnel revival still
+    # banks the BASELINE metric-2 sample bench before paper256's long
+    # compile.
+    ("sample_tiny64_256", ["bench.py", "sample", "tiny64", "256"], 2400),
+    ("paper256_train", ["bench.py", "paper256", "10"], 3600),
+    ("sample_ar_tiny64", ["bench.py", "sample-ar", "tiny64", "8"], 2400),
+    ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
+    ("quality_tpu_64px", ["tools/quality_run.py",
+                          "results/quality_tpu_r02", "20000", "64"], 7200),
     ("tiny64_train", ["bench.py", "tiny64", "30"], 1800),
     ("base128_remat_off", ["bench.py", "base128", "20",
                            "model.remat=False"], 2400),
@@ -44,12 +55,6 @@ MATRIX = [
                             "model.remat=True"], 2400),
     ("base128_remat_dots", ["bench.py", "base128", "20",
                             "model.remat=dots"], 2400),
-    ("paper256_train", ["bench.py", "paper256", "10"], 3600),
-    ("sample_tiny64_256", ["bench.py", "sample", "tiny64", "256"], 2400),
-    ("sample_ar_tiny64", ["bench.py", "sample-ar", "tiny64", "8"], 2400),
-    ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
-    ("quality_tpu_64px", ["tools/quality_run.py",
-                          "results/quality_tpu_r02", "20000", "64"], 7200),
 ]
 
 
