@@ -128,6 +128,10 @@ def cmd_sample(args, overrides: List[str]) -> int:
     if args.stochastic and args.denoise_gif:
         # Fail fast — before dataset IO and checkpoint restore.
         raise SystemExit("--denoise-gif is not supported with --stochastic")
+    if args.pool_views < 1:
+        # Unconditional: with --stochastic, 0/negative would silently
+        # behave as 1 (the seeding branch only fires for pool_views > 1).
+        raise SystemExit("--pool-views must be >= 1")
     if args.pool_views != 1 and not args.stochastic:
         raise SystemExit("--pool-views requires --stochastic (it seeds the "
                          "stochastic-conditioning pool)")

@@ -45,14 +45,36 @@ def fit_local_mesh(config: Optional[MeshConfig] = None
     """
     config = config or MeshConfig()
     if jax.process_count() > 1:
+        _warn_fallback("multi-process run: falling back to the default "
+                       "device (host-side batches can't shard a global mesh)")
         return None
     n = len(jax.devices())
     claims = max(1, config.model) * max(1, config.seq)
     if n % claims != 0:
+        _warn_fallback(
+            f"{n} local device(s) not divisible by the config's "
+            f"model×seq = {claims}: falling back to the default device — "
+            "this run is UNSHARDED despite the sharded config")
         return None
     import dataclasses
 
+    if config.data not in (-1, n // claims):
+        _warn_fallback(
+            f"config mesh.data={config.data} replaced by {n // claims} "
+            f"(all {n} local devices minus model×seq = {claims} claims)")
     return make_mesh(dataclasses.replace(config, data=n // claims))
+
+
+def _warn_fallback(msg: str) -> None:
+    """Mesh-fit decisions must be LOUD: a bench/eval that silently drops its
+    sharded-mesh request would report single-device numbers under a sharded
+    label (VERDICT r2 weak #5). Printed to stderr and sent through warnings
+    so tools and test harnesses both see it."""
+    import sys
+    import warnings
+
+    warnings.warn(f"fit_local_mesh: {msg}", stacklevel=3)
+    print(f"warning: fit_local_mesh: {msg}", file=sys.stderr)
 
 
 def make_mesh(config: Optional[MeshConfig] = None,

@@ -16,6 +16,7 @@ TINY = ["model.ch=32", "model.ch_mult=[1,2]", "model.emb_ch=32",
         "diffusion.timesteps=8", "diffusion.sample_timesteps=8"]
 
 
+@pytest.mark.slow
 def test_bench_analyze_emits_roofline_json():
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8",

@@ -160,6 +160,7 @@ def _tiny_overrides(tmp):
     ]
 
 
+@pytest.mark.slow
 def test_cli_train_sample_eval_e2e(cli_workspace, capsys):
     tmp = cli_workspace
     root = str(tmp / "srn")
@@ -315,6 +316,7 @@ def test_cli_rejects_invalid_config_with_clear_message(capsys):
     assert "divisible by 32" in str(ei.value)
 
 
+@pytest.mark.slow
 def test_evaluate_dataset_mesh_matches_single_device(tmp_path):
     """Sharding the eval sampler over the 8-device mesh must reproduce the
     single-device scores (same key, same pairs)."""
@@ -370,6 +372,7 @@ def test_evaluate_dataset_mesh_matches_single_device(tmp_path):
                                ar_single.per_view_psnr, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_export_uses_ema_params(tmp_path):
     """With EMA on, `export` writes the EMA params (what you sample with),
     matching _restore_params' own selection."""

@@ -23,6 +23,7 @@ from novel_view_synthesis_3d_tpu.train.step import make_train_step
 from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
 
 from jax.sharding import PartitionSpec as P
+import pytest
 
 
 def _tiny_cfg(**over):
@@ -48,6 +49,7 @@ def test_fsdp_spec_rules():
     assert fsdp_spec(mesh, ()) == P()
 
 
+@pytest.mark.slow
 def test_fsdp_step_matches_replicated():
     cfg = _tiny_cfg()
     schedule = make_schedule(cfg.diffusion)
@@ -97,6 +99,7 @@ def test_fsdp_actually_shards_large_params():
         assert int(np.prod(db)) == x.size // 8
 
 
+@pytest.mark.slow
 def test_sequence_parallel_forward_matches_dense():
     mesh = mesh_lib.make_mesh(MeshConfig(data=2, model=1, seq=4))
     mcfg = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
@@ -148,6 +151,7 @@ def test_host_side_init_matches_default():
         s_default.ema_params)
 
 
+@pytest.mark.slow
 def test_pod64_preset_scaled_one_step():
     """pod64 (BASELINE ladder step 5) structure: data=-1 mesh absorption +
     FSDP + bf16/remat flags — executed scaled-down on the 8-device mesh."""
@@ -175,6 +179,7 @@ def test_pod64_preset_scaled_one_step():
     assert np.isfinite(float(jax.device_get(m["loss"])))
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_entrypoint():
     import importlib.util
     import os
@@ -184,3 +189,39 @@ def test_dryrun_multichip_entrypoint():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.dryrun_multichip(8)
+
+
+class TestFitLocalMeshWarnings:
+    """fit_local_mesh must be loud about every fallback/recompute decision
+    (VERDICT r2 weak #5: a silently dropped mesh request turns a 'sharded'
+    bench into an unlabeled single-device run)."""
+
+    def test_non_divisible_claims_warn_and_return_none(self):
+        # 8 virtual devices, model×seq = 3 doesn't divide → None + warning.
+        import warnings
+
+        with warnings.catch_warnings(record=True) as ws:
+            warnings.simplefilter("always")
+            mesh = mesh_lib.fit_local_mesh(MeshConfig(data=4, model=3, seq=1))
+        assert mesh is None
+        assert any("UNSHARDED" in str(w.message) for w in ws)
+
+    def test_data_axis_recompute_warns(self):
+        # Config claims data=2 but 8 devices / (model=1×seq=1) = 8 → warn.
+        import warnings
+
+        with warnings.catch_warnings(record=True) as ws:
+            warnings.simplefilter("always")
+            mesh = mesh_lib.fit_local_mesh(MeshConfig(data=2, model=1, seq=1))
+        assert mesh is not None
+        assert mesh.devices.size == 8
+        assert any("mesh.data=2 replaced by 8" in str(w.message) for w in ws)
+
+    def test_matching_config_is_silent(self):
+        import warnings
+
+        with warnings.catch_warnings(record=True) as ws:
+            warnings.simplefilter("always")
+            mesh = mesh_lib.fit_local_mesh(MeshConfig(data=-1, model=2, seq=1))
+        assert mesh is not None
+        assert not [w for w in ws if "fit_local_mesh" in str(w.message)]

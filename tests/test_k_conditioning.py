@@ -43,6 +43,7 @@ def test_iter_batches_k2(srn_root):
     assert batch["t1"].shape == (4, 2, 3)
 
 
+@pytest.mark.slow
 def test_trainer_e2e_k2(srn_root, tmp_path):
     from novel_view_synthesis_3d_tpu.train.trainer import Trainer
 

@@ -84,6 +84,7 @@ def test_jit_apply():
     assert out.shape == (2, 16, 16, 3)
 
 
+@pytest.mark.slow
 def test_cond_mask_changes_output_after_training_params():
     """CFG: zeroed pose embedding must give a different output than cond=1
     once params are non-degenerate (perturb them away from zero-init)."""
@@ -100,6 +101,7 @@ def test_cond_mask_changes_output_after_training_params():
     assert not np.allclose(np.asarray(out_c), np.asarray(out_u))
 
 
+@pytest.mark.slow
 def test_k2_conditioning_frames():
     batch = make_batch(jax.random.PRNGKey(0), B=2, S=16, n_cond=2)
     cfg = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
@@ -108,6 +110,7 @@ def test_k2_conditioning_frames():
     assert out.shape == (2, 16, 16, 3)
 
 
+@pytest.mark.slow
 def test_configurable_ch_mult_depth():
     # The reference cannot change ch_mult without editing source; we can.
     batch = make_batch(jax.random.PRNGKey(0), B=1, S=32)
@@ -211,6 +214,7 @@ def test_frameconv_equivalent_to_per_frame_conv():
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_modes_same_params_and_grads():
     """Every remat mode must yield the SAME param tree (checkpoints trained
     with remat on/off are interchangeable — nn.remat's 'CheckpointXUNetBlock'

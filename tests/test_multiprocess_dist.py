@@ -16,6 +16,8 @@ import subprocess
 import sys
 import socket
 
+import pytest
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = r"""
@@ -150,6 +152,7 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.slow
 def test_two_process_train_step(tmp_path):
     worker_py = tmp_path / "worker.py"
     worker_py.write_text(WORKER)

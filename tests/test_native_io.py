@@ -127,6 +127,7 @@ def test_native_loader_deterministic_across_thread_counts(dataset):
             np.testing.assert_array_equal(ba[k], bb[k])
 
 
+@pytest.mark.slow
 def test_trainer_uses_native_loader(srn_root, tmp_path):
     from novel_view_synthesis_3d_tpu.config import (
         Config, DataConfig, DiffusionConfig, ModelConfig, TrainConfig)

@@ -4,6 +4,7 @@ conditioning, autoregressive generation (SURVEY.md §4)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from novel_view_synthesis_3d_tpu.config import DiffusionConfig, ModelConfig
 from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
@@ -62,6 +63,7 @@ def test_sampler_respaced():
     assert np.isfinite(np.asarray(imgs)).all()
 
 
+@pytest.mark.slow
 def test_guidance_weight_zero_vs_nonzero():
     dcfg0 = DiffusionConfig(timesteps=4, guidance_weight=0.0)
     dcfg3 = DiffusionConfig(timesteps=4, guidance_weight=3.0)
@@ -138,6 +140,7 @@ def test_ddim_eta0_ignores_step_noise():
     assert np.abs(np.asarray(c) - np.asarray(d)).max() > 1e-4
 
 
+@pytest.mark.slow
 def test_ddim_eta_changes_output_and_stays_finite():
     model, params, cond = _model_and_params()
     outs = {}
@@ -164,6 +167,7 @@ def test_ddim_respaced_matches_shapes():
     assert np.isfinite(imgs).all()
 
 
+@pytest.mark.slow
 def test_autoregressive_multi_view_pool_seed():
     # first_view with a pool axis (B, P0, ...) seeds stochastic
     # conditioning with P0 REAL views; the single-view form (B, ...) is
@@ -257,6 +261,7 @@ def test_dpmpp_sampler_finite_and_deterministic():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 def test_dpmpp_stochastic_sampler_finite():
     dcfg = DiffusionConfig(timesteps=8, sample_timesteps=8, sampler="dpm++")
     sched = make_schedule(dcfg)
@@ -284,6 +289,7 @@ def test_dpmpp_stochastic_sampler_finite():
     np.testing.assert_array_equal(np.asarray(img), np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_dpmpp_convergence_to_ode_solution():
     # Solver-order check on the REAL network ODE: with a fixed probability
     # flow (deterministic, w=0, perturbed params so the zero-init head is
@@ -337,6 +343,7 @@ def test_unknown_sampler_rejected():
         _make_update(sched, dcfg)
 
 
+@pytest.mark.slow
 def test_objectives_sample_finite():
     # x0- and v-objective samplers produce finite in-envelope images with
     # every update rule (the model is untrained; this pins the output→x̂₀
@@ -487,6 +494,7 @@ def test_precomputed_pose_embs_match_inline():
                                rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_stochastic_precompute_matches_inline_path():
     """The stochastic sampler's hoisted pose path (precompute_pose=True)
     must reproduce the in-loop path exactly — including the unconditional

@@ -17,6 +17,7 @@ from novel_view_synthesis_3d_tpu.parallel.mesh import tp_spec
 from novel_view_synthesis_3d_tpu.train.state import create_train_state
 from novel_view_synthesis_3d_tpu.train.step import make_train_step
 from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+import pytest
 
 
 def _tiny_cfg(tp: bool, data: int, model: int):
@@ -58,6 +59,7 @@ def test_tp_spec_rules():
     assert tp_spec(conv, (3, 3, 32, 64), 1) is None
 
 
+@pytest.mark.slow
 def test_tp_step_matches_replicated():
     schedule = make_schedule(_tiny_cfg(False, 8, 1).diffusion)
     batch = make_example_batch(batch_size=8, sidelength=16, seed=0)

@@ -34,6 +34,7 @@ def _setup(cfg, mesh, batch):
     return state, step, schedule
 
 
+@pytest.mark.slow
 def test_loss_decreases_over_steps():
     batch = make_example_batch(batch_size=8, sidelength=16)
     mesh = mesh_lib.make_mesh(MeshConfig(data=1, model=1, seq=1),
@@ -74,6 +75,7 @@ def test_dp8_equivalent_to_single_device():
                                    rtol=5e-3, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_per_step_rng_differs():
     """Consecutive steps on the SAME batch must produce different losses —
     t, noise, dropout and CFG masks are re-drawn per step (the reference
@@ -99,6 +101,7 @@ def test_frobenius_loss_compat():
         compute_loss(eps, noise, "nope")
 
 
+@pytest.mark.slow
 def test_ema_params_track():
     batch = make_example_batch(batch_size=4, sidelength=16)
     cfg = TINY_CFG.override(**{"train.batch_size": 4, "train.ema_decay": 0.5})
@@ -114,6 +117,7 @@ def test_ema_params_track():
     assert max(jax.tree.leaves(diffs)) > 1e-6
 
 
+@pytest.mark.slow
 def test_train_step_objectives_run_and_learn():
     """One step with each objective is finite; targets differ per objective."""
     import dataclasses
@@ -153,6 +157,7 @@ def test_train_step_objectives_run_and_learn():
     assert len({round(v, 6) for v in losses.values()}) == 3
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_full_batch():
     """accum=2 must reproduce the accum=1 step (loss and params) given the
     same per-step RNG, modulo fp reassociation. dropout=0 so the only
@@ -276,6 +281,7 @@ def test_lr_schedules():
         make_optimizer(TrainConfig(lr_schedule="poly"))
 
 
+@pytest.mark.slow
 def test_cosine_schedule_changes_training():
     """An aggressive cosine decay must produce different params than
     constant lr after a few steps (the schedule is actually wired in)."""
@@ -323,6 +329,7 @@ def test_cosine_schedule_changes_training():
     assert max(diffs) > 1e-5
 
 
+@pytest.mark.slow
 def test_grad_accum_adapts_to_mesh():
     """A preset tuned for one chip (accum=4) must still run on an 8-device
     mesh: the effective accumulation shrinks to the per-shard batch and the
@@ -399,6 +406,7 @@ def test_weighted_loss_reduces_to_uniform_at_weight_one():
         float(jnp.mean(w * per_sample)), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_min_snr_training_runs_and_differs():
     """min_snr weighting trains (finite, decreasing loss) and produces a
     different first-step loss than uniform weighting on the same data/seed."""
@@ -438,6 +446,7 @@ def test_min_snr_requires_mse():
                         make_schedule(cfg.diffusion), mesh)
 
 
+@pytest.mark.slow
 def test_metrics_include_lr():
     from novel_view_synthesis_3d_tpu.train.state import make_lr_schedule
 
@@ -456,6 +465,7 @@ def test_metrics_include_lr():
                                    float(sched(i)), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_pod64_preset_composition_one_step():
     """The pod64 preset's FEATURE COMPOSITION (FSDP + grad accumulation +
     bf16 + remat + EMA) runs one step on the 8-device mesh — with model and
@@ -487,6 +497,7 @@ def test_pod64_preset_composition_one_step():
     assert np.isfinite(float(jax.device_get(m["loss"])))
 
 
+@pytest.mark.slow
 def test_adam_mu_dtype_bf16_halves_mu_and_still_learns():
     """train.adam_mu_dtype='bfloat16' stores Adam's first moment in bf16
     (0.5x param bytes of HBM back at paper256 scale — the 16G-fit lever)

@@ -36,6 +36,7 @@ def _config(srn_root, tmp, num_steps=4, resume=True):
     )
 
 
+@pytest.mark.slow
 def test_train_checkpoint_resume_roundtrip(srn_root, tmp_path):
     tmp = str(tmp_path)
     cfg = _config(srn_root, tmp, num_steps=4)
@@ -61,6 +62,7 @@ def test_train_checkpoint_resume_roundtrip(srn_root, tmp_path):
     t2.ckpt.close()
 
 
+@pytest.mark.slow
 def test_restore_across_mesh_and_fsdp_topologies(srn_root, tmp_path):
     # DESIGN.md §7 claim: "restore reshards to whatever mesh/FSDP layout
     # the run uses" — train+save under FSDP on the full 8-device mesh,

@@ -83,10 +83,13 @@ def main() -> None:
     # (tools/sampler_comparison.py --config) reload exactly this model
     # shape instead of hand-mirroring the override list.
     from novel_view_synthesis_3d_tpu.config import get_preset
+    preset = "tiny64"  # single source of truth: the SAME preset feeds the
+    # persisted config.json AND the train invocation below, so the saved
+    # shape cannot drift from the trained shape if cli defaults change.
     with open(os.path.join(work, "config.json"), "w") as fh:
-        fh.write(get_preset("tiny64").apply_cli(overrides).to_json())
+        fh.write(get_preset(preset).apply_cli(overrides).to_json())
     print(f"training {steps} steps at {size}px on {train_root}", flush=True)
-    rc = cli(["train", train_root] + overrides)
+    rc = cli(["train", train_root, "--preset", preset] + overrides)
     if rc != 0:
         raise SystemExit(f"train failed with rc={rc}")
 

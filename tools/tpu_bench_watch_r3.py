@@ -1,0 +1,181 @@
+"""Round-3 TPU watcher: wait for the tunnel, run the VERDICT-r2 matrix.
+
+Single consolidated watcher (no phase-1/phase-2 split — the split's
+process-detection race was advisor finding 2). Reuses the round-2 probe
+lessons: probe with a REAL computation in a disposable child, abandon stuck
+children (uninterruptible tunnel IO survives SIGKILL), run the matrix
+sequentially with generous timeouts, refuse CPU-fallback output as TPU
+evidence, resume after mid-matrix tunnel deaths.
+
+Round-3 additions:
+  - results land in results/tpu_r03/;
+  - the compilation cache is the REPO-LOCAL .jax_cache that `python
+    bench.py` now defaults to, so every warm-up here primes the judged
+    driver bench (VERDICT r2 "Next round" item 2);
+  - matrix ordered to bank the BASELINE metrics first: the driver's exact
+    tiny64 invocation, metric-2 sampling, then paper256 (first-ever
+    execution = "Next round" item 1), then the base128 lever ladder
+    (item 4), then the 20k-step 64px quality run (item 5).
+
+Usage: python tools/tpu_bench_watch_r3.py [max_wait_hours]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "results", "tpu_r03")
+# Single source of truth for the warm-up↔judged-bench cache handoff: the
+# SAME default bench.py resolves when JAX_COMPILATION_CACHE_DIR is unset.
+sys.path.insert(0, REPO)
+from bench import CACHE_DIR as CACHE  # noqa: E402
+PROBE_INTERVAL_S = 180
+PROBE_TIMEOUT_S = 120
+
+MATRIX = [
+    # (name, argv after `python`, timeout_s), cheap-and-headline first.
+    # 1. The driver's exact end-of-round invocation (tiny64 30 steps):
+    #    banks the headline AND warms .jax_cache for the judged bench.
+    ("tiny64_train", ["bench.py"], 1800),
+    # 2. BASELINE metric 2 (DDPM 256-step sec/view) — never landed in r2.
+    ("sample_tiny64_256", ["bench.py", "sample", "tiny64", "256"], 2400),
+    # 3. The north-star config's first-ever execution + 16G-fit check.
+    ("paper256_train", ["bench.py", "paper256", "10"], 5400),
+    ("sample_base128_256", ["bench.py", "sample", "base128", "256"], 2400),
+    # 4. base128 lever ladder (median-of-5 is internal to bench.py):
+    #    preset default (bf16, remat off), batch-16, f32 A/B, flash-at-128.
+    ("base128_train", ["bench.py", "base128", "20"], 2400),
+    ("base128_bs16", ["bench.py", "base128", "20",
+                      "train.batch_size=16"], 2400),
+    ("base128_f32", ["bench.py", "base128", "20",
+                     "model.dtype=float32"], 2400),
+    ("base128_flash", ["bench.py", "base128", "20",
+                       "model.use_flash_attention=True"], 2400),
+    # Fast-sampler points for the speed/quality story.
+    ("sample_dpmpp32_tiny64", ["bench.py", "sample", "tiny64", "32",
+                               "diffusion.sampler=dpm++"], 1800),
+    ("sample_dpmpp32_base128", ["bench.py", "sample", "base128", "32",
+                                "diffusion.sampler=dpm++"], 1800),
+    ("sample_ar_tiny64", ["bench.py", "sample-ar", "tiny64", "8"], 2400),
+    # 5. The 20k-step 64px ch=64 quality run (VERDICT r2 item 5): held-out
+    #    PSNR must clear the ~10 dB mean-image floor by a wide margin.
+    ("quality_tpu_64px", ["tools/quality_run.py",
+                          os.path.join("results", "quality_tpu_r03"),
+                          "20000", "64"], 14400),
+    # Sampler quality/speed table on that run's retained checkpoint.
+    ("sampler_comparison_quality64",
+     ["tools/sampler_comparison.py", "results/quality_tpu_r03/work/val",
+      "results/quality_tpu_r03/sampler_comparison.json",
+      "--config", "results/quality_tpu_r03/work/config.json",
+      "--num-instances", "6", "--views-per-instance", "2"], 3600),
+    ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
+]
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    os.makedirs(OUT, exist_ok=True)
+    with open(os.path.join(OUT, "log.txt"), "a") as fh:
+        fh.write(line + "\n")
+
+
+def probe_alive() -> bool:
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((256, 256)); "
+            "print(float((x @ x).sum()), jax.devices()[0].platform)")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # probe the real accelerator
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = proc.communicate(timeout=PROBE_TIMEOUT_S)
+        if proc.returncode == 0 and "cpu" not in out:
+            log(f"probe OK: {out.strip()}")
+            return True
+        log(f"probe rc={proc.returncode} out={out.strip()!r} (cpu or fail)")
+        return False
+    except subprocess.TimeoutExpired:
+        proc.kill()  # child may be unreapable; abandon
+        log("probe timed out — tunnel still wedged")
+        return False
+
+
+def run_bench(name: str, argv: list, timeout_s: int) -> bool:
+    log(f"running {name}: {' '.join(argv)}")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # use the real accelerator
+    env["JAX_COMPILATION_CACHE_DIR"] = CACHE
+    # bench.py's own probe already ran here via probe_alive; don't let it
+    # burn its full default budget re-probing a tunnel we just saw alive.
+    env.setdefault("NVS3D_PROBE_BUDGET_S", "120")
+    out_path = os.path.join(OUT, f"{name}.out")
+    script, script_args = argv[0], argv[1:]
+    with open(out_path, "w") as fh:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, script)] + script_args,
+            stdout=fh, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            log(f"{name}: TIMED OUT after {timeout_s}s (output in {out_path})")
+            return False
+    tail = open(out_path).read().strip().splitlines()
+    result = next((ln for ln in reversed(tail) if ln.startswith("{")), None)
+    log(f"{name}: rc={rc} result={result}")
+    platform = None
+    if result:
+        try:
+            platform = json.loads(result).get("platform")
+        except json.JSONDecodeError:
+            pass
+        with open(os.path.join(OUT, f"{name}.json"), "w") as fh:
+            fh.write(result + "\n")
+    if platform == "cpu":
+        log(f"{name}: completed on CPU — not TPU evidence; counting as "
+            "failure")
+        return False
+    return rc == 0
+
+
+def main() -> None:
+    max_wait_h = float(sys.argv[1]) if len(sys.argv) > 1 else 11.0
+    deadline = time.time() + max_wait_h * 3600
+    log(f"r3 watcher: waiting for TPU (max {max_wait_h:.1f}h)")
+    done = set()
+    failed = set()
+    while time.time() < deadline:
+        if probe_alive():
+            log("TPU alive — running matrix")
+            for name, argv, timeout_s in MATRIX:
+                if name in done or name in failed:
+                    continue  # resume after a mid-matrix tunnel death
+                if run_bench(name, argv, timeout_s):
+                    done.add(name)
+                elif probe_alive():
+                    failed.add(name)
+                    log(f"{name}: failed with tunnel alive — not retrying")
+                else:
+                    log("tunnel died mid-matrix; resuming watch")
+                    break
+            if len(done) + len(failed) == len(MATRIX):
+                log(f"matrix finished: ok={json.dumps(sorted(done))} "
+                    f"failed={json.dumps(sorted(failed))}")
+                return
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            break
+        time.sleep(min(PROBE_INTERVAL_S, remaining))
+    log(f"deadline reached: ok={json.dumps(sorted(done))} "
+        f"failed={json.dumps(sorted(failed))}")
+
+
+if __name__ == "__main__":
+    main()

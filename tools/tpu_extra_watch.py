@@ -81,14 +81,29 @@ def another_phase2_running() -> bool:
         cmdline.split("\0", 1)[0])
 
 
+_START = time.time()
+_SEEN_PHASE1 = False
+GRACE_S = 600.0
+
+
 def phase1_finished() -> bool:
     # A dead phase-1 process is finished no matter what its log says (it
     # may have been killed mid-matrix without writing a terminal marker) —
     # the process check also covers "phase-1 never ran at all", since by
     # the time this is polled our own tbw.log() banner has already created
     # the log file.
-    if not phase1_running():
+    global _SEEN_PHASE1
+    if phase1_running():
+        _SEEN_PHASE1 = True
+    elif _SEEN_PHASE1 or time.time() - _START > GRACE_S:
+        # Either we watched it die, or it never appeared within the grace
+        # window. The grace period covers the launch race: phase-2 started
+        # before (or during a restart gap of) phase-1 must not conclude
+        # "finished" and run its matrix concurrently on the
+        # single-process-exclusive TPU.
         return True
+    else:
+        return False
     try:
         text = open(PHASE1_LOG).read()
     except OSError:
