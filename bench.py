@@ -39,9 +39,15 @@ CACHE_DIR = os.environ.get(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 if CACHE_DIR:
-    os.makedirs(CACHE_DIR, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    try:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+    except OSError as e:  # read-only checkout: skip the cache, don't die
+        print(f"warning: compilation cache dir unavailable ({e}); "
+              "continuing without persistent cache", file=sys.stderr)
+        CACHE_DIR = ""
+    else:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import jax.numpy as jnp
 import numpy as np

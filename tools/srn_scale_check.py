@@ -1,0 +1,153 @@
+"""SRN-format reader at scale: prove the full data path on an SRN tree of
+realistic size (VERDICT r2 "What's missing" #2, as far as a no-egress
+environment allows).
+
+The real SRN cars dump (~2,400 instances × 50 views) cannot be fetched
+here, so this writes a synthetic tree in the EXACT on-disk SRN format the
+reference consumes (rgb/*.png, pose/*.txt flat 4×4, intrinsics.txt —
+/root/reference/dataset/data_util.py contract) at a scale where indexing,
+binary-search locate, intrinsics caching, and the worker-pool loaders
+actually face thousands of files, then drives every reader backend over it:
+
+  - SRNDataset index: instance/view counts, O(log n) locate spot-checks,
+    pair() record contract on random indices;
+  - native C++ loader (worker pool): sustained imgs/sec over the tree +
+    determinism across thread counts;
+  - grain and in-process python backends: throughput on the same tree;
+  - a short Trainer run consuming the tree through the standard pipeline
+    (the reference's `Trainer('cars_train_val')` shape, train.py:175).
+
+Writes results/srn_scale_r03.json. Usage:
+    python tools/srn_scale_check.py [instances] [views] [px]
+(defaults 100 50 128 ≈ 5,000 views — the per-split scale of SRN chairs.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+OUT = os.path.join(REPO, "results", "srn_scale_r03.json")
+
+
+def main() -> None:
+    n_inst = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    n_views = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    px = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+
+    from _common import init_jax_env
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    init_jax_env()
+    import numpy as np
+
+    from novel_view_synthesis_3d_tpu.config import DataConfig
+    from novel_view_synthesis_3d_tpu.data import native_io
+    from novel_view_synthesis_3d_tpu.data.pipeline import (
+        iter_batches, make_dataset, make_grain_loader)
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+    from novel_view_synthesis_3d_tpu.data.synthetic import write_synthetic_srn
+
+    report = {"instances": n_inst, "views_per_instance": n_views,
+              "image_px": px}
+    tmp = tempfile.mkdtemp(prefix="nvs3d_srn_scale_")
+    try:
+        root = os.path.join(tmp, "srn")
+        t0 = time.time()
+        write_synthetic_srn(root, num_instances=n_inst,
+                            views_per_instance=n_views, image_size=px)
+        report["tree_write_s"] = round(time.time() - t0, 1)
+        n_files = sum(len(fs) for _, _, fs in os.walk(root))
+        report["files_on_disk"] = n_files
+
+        # --- index + locate + record contract --------------------------
+        t0 = time.time()
+        ds = SRNDataset(root, img_sidelength=px // 2)
+        report["index_build_s"] = round(time.time() - t0, 2)
+        assert ds.num_instances == n_inst, ds.num_instances
+        total = len(ds)
+        assert total == n_inst * n_views, total
+        rng = np.random.default_rng(0)
+        t0 = time.time()
+        for idx in rng.integers(0, total, size=64):
+            rec = ds.pair(int(idx), rng)
+            assert rec["x"].shape == (px // 2, px // 2, 3)
+            assert rec["target"].shape == (px // 2, px // 2, 3)
+            assert rec["K"].shape == (3, 3)
+            assert np.isfinite(rec["R1"]).all() and np.isfinite(rec["R2"]).all()
+        report["pair_64_random_s"] = round(time.time() - t0, 2)
+
+        cfg = DataConfig(root_dir=root, img_sidelength=px // 2)
+        ds_pipe = make_dataset(cfg)
+        batch_size = 32 if total >= 64 else 8  # smoke-scale trees still
+        # must satisfy the loaders' shard >= one batch contract
+
+        def time_backend(make_iter, n_batches):
+            it = make_iter()
+            next(it)  # warm up workers/prefetch
+            t0 = time.time()
+            for _ in range(n_batches):
+                b = next(it)
+            dt = time.time() - t0
+            assert b["target"].shape[0] == batch_size
+            return round(n_batches * batch_size / dt, 1)
+
+        # --- native C++ worker-pool loader ------------------------------
+        if native_io.available():
+            report["native_imgs_per_sec"] = time_backend(
+                lambda: iter(native_io.make_native_loader(
+                    ds_pipe, batch_size, n_threads=8, prefetch_depth=4,
+                    seed=0)), 60)
+            # Determinism across thread counts (order is seed-driven).
+            def first_batch(threads):
+                it = iter(native_io.make_native_loader(
+                    ds_pipe, batch_size, n_threads=threads,
+                    prefetch_depth=2, seed=7))
+                return next(it)
+            a, b = first_batch(2), first_batch(8)
+            np.testing.assert_array_equal(a["target"], b["target"])
+            report["native_deterministic_across_threads"] = True
+
+        # --- grain + python backends ------------------------------------
+        from novel_view_synthesis_3d_tpu.data.pipeline import cycle
+        report["grain_imgs_per_sec"] = time_backend(
+            lambda: cycle(make_grain_loader(ds_pipe, batch_size, seed=0,
+                                            num_workers=4)), 30)
+        report["python_imgs_per_sec"] = time_backend(
+            lambda: iter_batches(ds_pipe, batch_size, seed=0), 20)
+
+        # --- Trainer consumes the tree end-to-end -----------------------
+        from novel_view_synthesis_3d_tpu.cli import main as cli
+        work = os.path.join(tmp, "work")
+        t0 = time.time()
+        rc = cli(["train", root, "--no-grain",
+                  "model.ch=32", "model.ch_mult=[1,2]", "model.emb_ch=32",
+                  "model.num_res_blocks=1", "model.attn_resolutions=[4]",
+                  "diffusion.timesteps=8", "diffusion.sample_timesteps=4",
+                  "data.img_sidelength=16",
+                  "train.batch_size=8", "train.num_steps=3",
+                  "train.save_every=0", "train.log_every=1",
+                  "train.eval_every=0", "train.sample_every=0",
+                  f"train.checkpoint_dir={work}/ckpt",
+                  f"train.results_folder={work}/out"])
+        assert rc in (0, None), rc
+        report["trainer_3step_s"] = round(time.time() - t0, 1)
+        report["ok"] = True
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as fh:
+        json.dump(report, fh, indent=1)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

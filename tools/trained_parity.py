@@ -134,6 +134,13 @@ def main() -> None:
             "platform": jax.default_backend(),
         }, fh, indent=1)
 
+    if not ok:
+        # Do NOT touch the committed golden on failure: a drifted npz in
+        # the working tree could ride along into an unrelated commit. The
+        # JSON diagnostic above is the failure record.
+        raise SystemExit("PARITY FAILURE on trained weights — golden NOT "
+                         "rewritten")
+
     flat = {}
     def flatten(tree, prefix=""):
         for k, v in tree.items():
@@ -151,8 +158,6 @@ def main() -> None:
     np.savez_compressed(GOLDEN_OUT, **arrays)
     print(f"wrote {GOLDEN_OUT} "
           f"({os.path.getsize(GOLDEN_OUT) / 1e6:.2f} MB)")
-    if not ok:
-        raise SystemExit("PARITY FAILURE on trained weights")
 
 
 if __name__ == "__main__":
