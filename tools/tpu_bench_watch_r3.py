@@ -44,7 +44,11 @@ MATRIX = [
     ("tiny64_train", ["bench.py"], 1800),
     # 2. BASELINE metric 2 (DDPM 256-step sec/view) — never landed in r2.
     ("sample_tiny64_256", ["bench.py", "sample", "tiny64", "256"], 2400),
-    # 3. The north-star config's first-ever execution + 16G-fit check.
+    # 3. The north-star config: compile-only analyze FIRST (validates the
+    #    16G fit claim via memory_analysis even if the train bench then
+    #    fails, and its cached executable warms the train compile), then
+    #    the first-ever paper256 execution.
+    ("analyze_paper256", ["bench.py", "analyze", "paper256"], 3600),
     ("paper256_train", ["bench.py", "paper256", "10"], 5400),
     ("sample_base128_256", ["bench.py", "sample", "base128", "256"], 2400),
     # 4. base128 lever ladder (median-of-5 is internal to bench.py):
