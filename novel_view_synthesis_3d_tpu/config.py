@@ -55,6 +55,13 @@ class ModelConfig:
     # the XLA path elsewhere; True forces the kernel (interpret mode off-TPU,
     # slow but exact); False forces the XLA path.
     use_flash_attention: Any = "auto"
+    # Fused single-HBM-pass GroupNorm(+swish) Pallas kernel
+    # (ops/fused_groupnorm.py) for the per-frame GN chains. False (default)
+    # keeps the XLA norm until the kernel has a measured TPU win; "auto"
+    # enables it on TPU backends; True forces it (interpret mode off-TPU).
+    # Shared-stats GN (groupnorm_per_frame=False) and over-VMEM slabs fall
+    # back to XLA automatically.
+    use_fused_groupnorm: Any = False
     # Sequence parallelism: shard the H·W token axis of every attention over
     # the mesh 'seq' axis and run ring attention (parallel/ring_attention.py,
     # ppermute over ICI). Requires mesh.seq > 1 and token counts divisible

@@ -23,6 +23,10 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
+from novel_view_synthesis_3d_tpu.ops.fused_groupnorm import (
+    fits_vmem,
+    fused_group_norm,
+)
 from novel_view_synthesis_3d_tpu.ops.resample import (
     avgpool_downsample,
     nearest_neighbor_upsample,
@@ -63,20 +67,55 @@ class FrameConv(nn.Module):
         return h.reshape((B, F) + h.shape[1:])
 
 
+class _GNParams(nn.Module):
+    """scale/bias params matching flax GroupNorm's tree leaf names, so the
+    fused and XLA paths share one checkpoint layout (instantiated with
+    name='GroupNorm_0', the auto-name the nn.GroupNorm submodule gets)."""
+
+    features: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self):
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), self.param_dtype)
+        return scale, bias
+
+
 class GroupNorm(nn.Module):
-    """32-group GroupNorm over (B, F, H, W, C)."""
+    """32-group GroupNorm over (B, F, H, W, C), optional fused activation.
+
+    `act='swish'` applies the nonlinearity INSIDE the norm op — on the
+    fused Pallas path (ops/fused_groupnorm.py) the whole GN→swish chain is
+    one HBM pass; on the XLA path it is applied after the norm (identical
+    math, same param tree). `fused=True` requires per-frame statistics and
+    falls back to XLA when a row slab exceeds the kernel's VMEM budget.
+    """
 
     per_frame: bool = True
+    act: Optional[str] = None
+    fused: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, h: jnp.ndarray) -> jnp.ndarray:
         B, F, H, W, C = h.shape
+        if self.fused and self.per_frame and fits_vmem(H * W, C, h.dtype):
+            scale, bias = _GNParams(features=C, name="GroupNorm_0")()
+            y = fused_group_norm(h.reshape(B * F, H * W, C), scale, bias,
+                                 32, 1e-6, self.act)
+            # Match the XLA branch's dtype semantics (nn.GroupNorm casts
+            # its output to the module dtype).
+            return y.reshape(B, F, H, W, C).astype(self.dtype)
         norm = nn.GroupNorm(num_groups=32, dtype=self.dtype)
         if self.per_frame:
-            return norm(h.reshape(B * F, H, W, C)).reshape(B, F, H, W, C)
-        # Reference-compat: statistics reduce over (F, H, W) jointly.
-        return norm(h)
+            y = norm(h.reshape(B * F, H, W, C)).reshape(B, F, H, W, C)
+        else:
+            # Reference-compat: statistics reduce over (F, H, W) jointly.
+            y = norm(h)
+        return nonlinearity(y) if self.act == "swish" else y
 
 
 class FiLM(nn.Module):
@@ -106,6 +145,7 @@ class ResnetBlock(nn.Module):
     dropout: float = 0.0
     resample: Optional[str] = None
     per_frame_gn: bool = True
+    fused_gn: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -114,8 +154,10 @@ class ResnetBlock(nn.Module):
         C = h_in.shape[-1]
         features = C if self.features is None else self.features
         kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        gn_kw = dict(per_frame=self.per_frame_gn, fused=self.fused_gn,
+                     dtype=self.dtype)
 
-        h = nonlinearity(GroupNorm(per_frame=self.per_frame_gn, dtype=self.dtype)(h_in))
+        h = GroupNorm(act="swish", **gn_kw)(h_in)
         if self.resample is not None:
             updown = {
                 "up": nearest_neighbor_upsample,
@@ -124,8 +166,7 @@ class ResnetBlock(nn.Module):
             h = updown(h)
             h_in = updown(h_in)
         h = FrameConv(features, **kw)(h)
-        h = FiLM(features=features, **kw)(
-            GroupNorm(per_frame=self.per_frame_gn, dtype=self.dtype)(h), emb)
+        h = FiLM(features=features, **kw)(GroupNorm(**gn_kw)(h), emb)
         h = nonlinearity(h)
         h = nn.Dropout(rate=self.dropout)(h, deterministic=not train)
         h = FrameConv(features, zero_init=True, **kw)(h)
@@ -193,13 +234,15 @@ class AttnBlock(nn.Module):
     use_flash: bool = False
     mesh: Optional[object] = None
     per_frame_gn: bool = True
+    fused_gn: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, h_in: jnp.ndarray) -> jnp.ndarray:
         B, F, H, W, C = h_in.shape
-        h = GroupNorm(per_frame=self.per_frame_gn, dtype=self.dtype)(h_in)
+        h = GroupNorm(per_frame=self.per_frame_gn, fused=self.fused_gn,
+                      dtype=self.dtype)(h_in)
         tokens = h.reshape(B, F, H * W, C)
         layer = AttnLayer(attn_heads=self.attn_heads, out_proj=self.out_proj,
                           use_flash=self.use_flash, mesh=self.mesh,
@@ -238,13 +281,14 @@ class XUNetBlock(nn.Module):
     dropout: float = 0.0
     train: bool = False  # attribute (not call arg) so nn.remat needs no statics
     per_frame_gn: bool = True
+    fused_gn: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, emb: jnp.ndarray) -> jnp.ndarray:
-        kw = dict(per_frame_gn=self.per_frame_gn, dtype=self.dtype,
-                  param_dtype=self.param_dtype)
+        kw = dict(per_frame_gn=self.per_frame_gn, fused_gn=self.fused_gn,
+                  dtype=self.dtype, param_dtype=self.param_dtype)
         attn_kw = dict(attn_heads=self.attn_heads, out_proj=self.attn_out_proj,
                        use_flash=self.attn_use_flash, mesh=self.attn_mesh,
                        **kw)

@@ -38,6 +38,7 @@ from novel_view_synthesis_3d_tpu.models.layers import (
 )
 from novel_view_synthesis_3d_tpu.models.rays import camera_rays
 from novel_view_synthesis_3d_tpu.ops.flash_attention import resolve_flash
+from novel_view_synthesis_3d_tpu.ops.fused_groupnorm import resolve_fused_gn
 from novel_view_synthesis_3d_tpu.ops.posenc import posenc_ddpm, posenc_nerf
 
 
@@ -231,7 +232,9 @@ class XUNet(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         param_dtype = jnp.dtype(cfg.param_dtype)
         kw = dict(dtype=dtype, param_dtype=param_dtype)
-        blk_kw = dict(per_frame_gn=cfg.groupnorm_per_frame, **kw)
+        fused_gn = resolve_fused_gn(cfg.use_fused_groupnorm)
+        blk_kw = dict(per_frame_gn=cfg.groupnorm_per_frame,
+                      fused_gn=fused_gn, **kw)
 
         z = batch["z"]
         B, H, W, C = z.shape
@@ -310,8 +313,8 @@ class XUNet(nn.Module):
                                 **blk_kw)(h, emb, train=train)
 
         assert not hs
-        h = nonlinearity(GroupNorm(per_frame=cfg.groupnorm_per_frame,
-                                   dtype=dtype)(h))
+        h = GroupNorm(per_frame=cfg.groupnorm_per_frame, act="swish",
+                      fused=fused_gn, dtype=dtype)(h)
         # Zero-init output conv in float32 for stable noise predictions.
         out = FrameConv(C, zero_init=True, dtype=jnp.float32,
                         param_dtype=param_dtype)(h.astype(jnp.float32))

@@ -60,6 +60,8 @@ MATRIX = [
                      "model.dtype=float32"], 2400),
     ("base128_flash", ["bench.py", "base128", "20",
                        "model.use_flash_attention=True"], 2400),
+    ("base128_fusedgn", ["bench.py", "base128", "20",
+                         "model.use_fused_groupnorm=True"], 2400),
     # Fast-sampler points for the speed/quality story.
     ("sample_dpmpp32_tiny64", ["bench.py", "sample", "tiny64", "32",
                                "diffusion.sampler=dpm++"], 1800),
