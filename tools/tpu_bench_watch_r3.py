@@ -151,9 +151,14 @@ def run_bench(name: str, argv: list, timeout_s: int) -> bool:
         return False
     if rc != 0:
         return False
-    if result:
-        with open(os.path.join(OUT, f"{name}.json"), "w") as fh:
-            fh.write(result + "\n")
+    if not result:
+        # Every matrix entry prints a platform-tagged JSON line (bench.py
+        # subcommands, quality_run, sampler_comparison); its absence means
+        # the run died oddly — do NOT persist evidence or count it done.
+        log(f"{name}: rc=0 but no JSON line — counting as failure")
+        return False
+    with open(os.path.join(OUT, f"{name}.json"), "w") as fh:
+        fh.write(result + "\n")
     return True
 
 
@@ -164,6 +169,15 @@ def main() -> None:
     done = set()
     failed = set()
     skipped = set()  # never attempted (deadline guard) — NOT failures
+    # Resume across watcher restarts: run_bench writes {name}.json only for
+    # a completed rc=0 run with a non-CPU platform-tagged JSON line, so its
+    # presence is exactly "done" — don't respend tunnel time on it.
+    for name, _, _ in MATRIX:
+        if os.path.exists(os.path.join(OUT, f"{name}.json")):
+            done.add(name)
+    if done:
+        log(f"resuming: {len(done)} entries already have artifacts "
+            f"({json.dumps(sorted(done))})")
     while time.time() < deadline:
         if probe_alive():
             log("TPU alive — running matrix")
