@@ -56,3 +56,24 @@ def test_bench_data_python_backend():
         [l for l in out.stdout.splitlines() if l.startswith("{")][-1])
     assert result["metric"] == "data_imgs_per_sec_python"
     assert result["value"] > 0
+
+
+def test_bench_hard_fails_without_backend_instead_of_cpu_fallback():
+    """BENCH r1/r2 postmortem contract: an unreachable accelerator must
+    produce rc=3 and NO JSON line (a CPU number labeled as the device
+    bench is worse than no number). The probe child is pointed at a
+    platform name that cannot initialize, with a tiny retry budget."""
+    env = dict(os.environ,
+               # A platform name no host provides: backend init fails
+               # everywhere, including real TPU VMs (JAX_PLATFORMS="tpu"
+               # there would run a REAL device bench and fail the test).
+               JAX_PLATFORMS="nonexistent_backend",
+               NVS3D_PROBE_BUDGET_S="8", NVS3D_PROBE_TRY_S="4")
+    env.pop("NVS3D_BENCH_ALLOW_CPU", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "tiny64", "1"] + TINY,
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO_ROOT)
+    assert out.returncode == 3, (out.returncode, out.stderr[-500:])
+    assert not [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert "refusing to emit a CPU number" in out.stderr
