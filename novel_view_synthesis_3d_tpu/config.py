@@ -147,6 +147,10 @@ class TrainConfig:
     # quality signal; full held-out evaluation stays in the `eval` CLI.
     eval_every: int = 0
     eval_sample_steps: int = 64  # respaced steps for the in-loop eval
+    # Held-out SRN tree for the in-loop probe: when set, the eval.csv curve
+    # scores these views (true validation); when empty, the probe scores a
+    # fixed batch of TRAINING views (reconstruction-progress signal only).
+    eval_folder: str = ""
     seed: int = 0
     # Per-sample probability of dropping pose conditioning for CFG
     # (reference: train.py:64 uses 0.1, but bakes the mask at trace time).

@@ -158,9 +158,16 @@ class Trainer:
         # time makes the PSNR/SSIM curve comparable across steps (a fresh
         # random batch per eval would swing several dB on content alone).
         # Only copied when the probe is on — it pins a full batch in host
-        # RAM for the Trainer's lifetime.
-        self._eval_batch = (jax.tree.map(np.array, first_batch)
-                            if tcfg.eval_every else None)
+        # RAM for the Trainer's lifetime. With train.eval_folder set, the
+        # probe batch is drawn from that HELD-OUT tree instead of the first
+        # training batch, turning eval.csv into a true validation curve.
+        self._eval_batch = None
+        if tcfg.eval_every:
+            if tcfg.eval_folder:
+                self._eval_batch = jax.tree.map(
+                    np.array, self._held_out_probe_batch(tcfg.eval_folder))
+            else:
+                self._eval_batch = jax.tree.map(np.array, first_batch)
         self._samplers = {}  # sample_steps -> jitted sampler (_sample_cond)
         self.state = create_train_state(
             tcfg, self.model, _sample_model_batch(first_batch))
@@ -359,17 +366,44 @@ class Trainer:
         return jax.device_put(jax.device_get(replicated),
                               jax.local_devices()[0])
 
+    def _held_out_probe_batch(self, folder: str):
+        """Fixed probe batch from a held-out SRN tree (train.eval_folder).
+
+        Drawn once, deterministically (seed 0), sized to the smaller of the
+        train batch and what the tree holds — small val splits must not
+        trip the loader's records>=batch contract."""
+        import dataclasses
+
+        ds = make_dataset(dataclasses.replace(
+            self.config.data, root_dir=folder))
+        if len(ds) == 0:
+            raise ValueError(f"train.eval_folder={folder!r} has no records")
+        bs = min(dist.local_batch_size(self.config.train.batch_size),
+                 len(ds))
+        spi = self.config.data.samples_per_instance
+        if spi > 1:
+            bs = (bs // spi) * spi  # iter_batches needs bs % spi == 0
+            if bs == 0:
+                raise ValueError(
+                    f"train.eval_folder={folder!r} holds {len(ds)} records "
+                    f"— fewer than data.samples_per_instance={spi}")
+        return next(iter_batches(
+            ds, bs, seed=0,
+            num_cond=self.config.model.num_cond_frames))
+
     # ------------------------------------------------------------------
     _UNSET = object()  # "gather the probe params yourself" sentinel
 
     def eval_step(self, step: int, num: int = 4,
                   params=_UNSET) -> Optional[dict]:
-        """In-loop quality probe on a FIXED batch of training views.
+        """In-loop quality probe on a FIXED batch of views.
 
         Samples the probe batch's target poses and scores PSNR/SSIM against
         the ground-truth targets — same views every call, so the eval.csv
-        curve is comparable across steps. (It is a training-data probe, not
-        a held-out evaluation; the `eval` CLI does that.) Uses EMA params
+        curve is comparable across steps. The batch comes from
+        `train.eval_folder` (held-out views — a true validation curve) when
+        set, else from the first TRAINING batch (reconstruction-progress
+        signal only; the `eval` CLI does held-out). Uses EMA params
         when available, a respaced `eval_sample_steps` ladder, and logs to
         eval.csv — the reference has no quality signal at all during
         training (SURVEY.md §5.5)."""
@@ -380,7 +414,11 @@ class Trainer:
         if params is None:
             return None  # non-reporting host of a multi-process run
         if self._eval_batch is None:  # direct eval_step call, eval_every=0
-            self._eval_batch = jax.tree.map(np.array, self._peek_batch())
+            tcfg = self.config.train
+            self._eval_batch = jax.tree.map(
+                np.array,
+                self._held_out_probe_batch(tcfg.eval_folder)
+                if tcfg.eval_folder else self._peek_batch())
         batch = self._eval_batch
         num = min(num, batch["target"].shape[0])
         imgs = self._sample_cond(

@@ -221,3 +221,35 @@ def test_metrics_csv_schema_rotation(tmp_path):
     with open(old) as fh:
         lines = fh.read().strip().splitlines()
     assert len(lines) == 3 and lines[2].startswith("3,")
+
+
+@pytest.mark.slow
+def test_eval_folder_probe_uses_held_out_views(srn_root, tmp_path,
+                                               tmp_path_factory):
+    """train.eval_folder redirects the in-loop probe's fixed batch to a
+    HELD-OUT tree (eval.csv becomes a true validation curve); empty keeps
+    the training-batch probe."""
+    import dataclasses
+
+    import numpy as np
+
+    from novel_view_synthesis_3d_tpu.data.pipeline import (
+        iter_batches, make_dataset)
+
+    val_root = str(tmp_path_factory.mktemp("srn_val"))
+    write_synthetic_srn(val_root, num_instances=1, views_per_instance=4,
+                        image_size=16, seed=99)
+    cfg = _config(srn_root, str(tmp_path))
+    cfg = cfg.override(**{"train.eval_every": 2,
+                          "train.eval_sample_steps": 4,
+                          "train.eval_folder": val_root})
+    tr = Trainer(config=cfg, use_grain=False)
+    want = next(iter_batches(
+        make_dataset(dataclasses.replace(cfg.data, root_dir=val_root)),
+        4, seed=0, num_cond=cfg.model.num_cond_frames))
+    np.testing.assert_array_equal(tr._eval_batch["target"], want["target"])
+    # And it is NOT the training probe batch (different tree entirely).
+    assert not np.array_equal(tr._eval_batch["target"],
+                              np.asarray(tr._held_batch["target"])[:4])
+    out = tr.eval_step(0)
+    assert out is not None and np.isfinite(out["psnr"])

@@ -19,7 +19,6 @@ Writes <dir>/eval_sweep.json
 
 from __future__ import annotations
 
-import itertools
 import json
 import os
 import sys

@@ -72,6 +72,7 @@ def main() -> None:
         "train.batch_size=8", f"train.num_steps={steps}",
         f"train.save_every={max(steps // 4, 1)}", "train.log_every=50",
         f"train.eval_every={max(steps // 10, 1)}",
+        f"train.eval_folder={val_root}",  # eval.csv = true held-out curve
         "train.eval_sample_steps=32",
         f"train.sample_every={max(steps // 4, 1)}",
         "diffusion.sample_timesteps=64",
