@@ -263,6 +263,25 @@ class Config:
                     f"model.ch×mult = {c} (level {level}, attention "
                     f"resolution {d.img_sidelength // (2 ** level)}) is "
                     f"not divisible by attn_heads={m.attn_heads}")
+        # Cross-frame attention is the ONLY path from the conditioning
+        # image to the target frame (convs are per-frame). A non-empty
+        # attn_resolutions that matches NO UNet level silently trains an
+        # unconditional pose-memorizer: seen-pose metrics look great,
+        # held-out eval sits at the mean-image floor (r2/r3 quality-run
+        # postmortem — the r2 tool used size//4 on a 2-level UNet).
+        level_res = {d.img_sidelength // (2 ** lv)
+                     for lv in range(len(m.ch_mult))}
+        if m.attn_resolutions and not (set(m.attn_resolutions) & level_res):
+            errors.append(
+                f"model.attn_resolutions={tuple(m.attn_resolutions)} "
+                f"matches NO UNet level (levels run at "
+                f"{tuple(sorted(level_res, reverse=True))} for "
+                f"data.img_sidelength={d.img_sidelength}, "
+                f"{len(m.ch_mult)} levels): cross-frame attention would "
+                "never fire and the conditioning image could not influence "
+                "the generated view. Pick resolutions from the level set, "
+                "or set attn_resolutions=() explicitly for an attention-free "
+                "model")
         if not 0.0 <= m.dropout < 1.0:
             errors.append(f"model.dropout={m.dropout} outside [0, 1)")
         if m.num_cond_frames < 1:

@@ -11,7 +11,7 @@ import pytest
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 TINY = ["model.ch=32", "model.ch_mult=[1,2]", "model.emb_ch=32",
-        "model.num_res_blocks=1", "model.attn_resolutions=[4]",
+        "model.num_res_blocks=1", "model.attn_resolutions=[8]",
         "data.img_sidelength=16", "train.batch_size=8",
         "diffusion.timesteps=8", "diffusion.sample_timesteps=8"]
 

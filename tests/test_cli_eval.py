@@ -146,7 +146,7 @@ def cli_workspace(tmp_path_factory):
 
 _TINY = [
     "model.ch=32", "model.ch_mult=[1,2]", "model.emb_ch=32",
-    "model.num_res_blocks=1", "model.attn_resolutions=[4]",
+    "model.num_res_blocks=1", "model.attn_resolutions=[8]",
     "diffusion.timesteps=8", "diffusion.sample_timesteps=2",
     "data.img_sidelength=16", "train.batch_size=8", "train.num_steps=2",
     "train.save_every=2", "train.log_every=1",
@@ -308,6 +308,14 @@ def test_config_validate_catches_bad_configs():
     with pytest.raises(ValueError, match="img_sidelength"):
         good.override(**{"model.ch_mult": (1, 2, 2, 4),
                          "data.img_sidelength": 36}).validate()
+    # attn_resolutions matching NO UNet level: the conditioning image could
+    # never influence the output (r2/r3 quality-run postmortem — the tool
+    # used size//4 on a 2-level UNet and trained a pose-memorizer).
+    with pytest.raises(ValueError, match="matches NO UNet level"):
+        good.override(**{"model.attn_resolutions": (4,),
+                         "data.img_sidelength": 16}).validate()
+    # Explicitly attention-free is allowed.
+    good.override(**{"model.attn_resolutions": ()}).validate()
 
 
 def test_cli_rejects_invalid_config_with_clear_message(capsys):
@@ -332,7 +340,7 @@ def test_evaluate_dataset_mesh_matches_single_device(tmp_path):
                         image_size=16)
     cfg = Config(
         model=ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
-                          attn_resolutions=(4,), dropout=0.0),
+                          attn_resolutions=(8,), dropout=0.0),
         diffusion=DiffusionConfig(timesteps=8, sample_timesteps=2),
         data=DataConfig(root_dir=root, img_sidelength=16),
         mesh=MeshConfig(data=8),

@@ -40,7 +40,7 @@ assert process_shard(8) == (pid, 2)
 import numpy as np
 import jax.numpy as jnp
 from novel_view_synthesis_3d_tpu.config import (
-    Config, DiffusionConfig, MeshConfig, ModelConfig, TrainConfig)
+    Config, DataConfig, DiffusionConfig, MeshConfig, ModelConfig, TrainConfig)
 from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
 from novel_view_synthesis_3d_tpu.diffusion import make_schedule
 from novel_view_synthesis_3d_tpu.models.xunet import XUNet
@@ -77,6 +77,9 @@ cfg = Config(
     model=ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
                       attn_resolutions=(8,), dropout=0.0),
     diffusion=DiffusionConfig(timesteps=50),
+    # 16px batches below: keep the config coherent (attn@8 = the real
+    # bottleneck level) so Trainer's validate() passes in the probe stage.
+    data=DataConfig(img_sidelength=16),
     train=TrainConfig(batch_size=8, lr=1e-3, ema_decay=0.0),
     mesh=MeshConfig(data=8, model=1, seq=1),
 )

@@ -476,7 +476,7 @@ def test_pod64_preset_composition_one_step():
 
     cfg = get_preset("pod64").apply_cli([
         "model.ch=32", "model.ch_mult=[1,2]", "model.emb_ch=32",
-        "model.num_res_blocks=1", "model.attn_resolutions=[4]",
+        "model.num_res_blocks=1", "model.attn_resolutions=[8]",
         "model.remat=dots",
         "data.img_sidelength=16", "train.batch_size=16",
         "train.grad_accum_steps=2",

@@ -25,7 +25,7 @@ def srn_root(tmp_path_factory):
 def _config(srn_root, tmp, num_steps=4, resume=True):
     return Config(
         model=ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
-                          attn_resolutions=(4,), dropout=0.0),
+                          attn_resolutions=(8,), dropout=0.0),
         diffusion=DiffusionConfig(timesteps=8, sample_timesteps=4),
         data=DataConfig(root_dir=srn_root, img_sidelength=16, num_workers=0),
         train=TrainConfig(batch_size=8, lr=1e-3, num_steps=num_steps,

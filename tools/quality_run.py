@@ -65,9 +65,16 @@ def main() -> None:
     # while the 64px TPU run (minutes of chip time at ~150 imgs/s) affords
     # a base-width net whose samples actually show novel-view synthesis.
     ch = 32 if size < 64 else 64
+    # attn at size//2 — the BOTTLENECK of this 2-level UNet (levels run at
+    # {size, size//2}). Round 2/3 postmortem: size//4 matched NO level, so
+    # cross-frame attention never fired and the conditioning image could
+    # not reach the target frame at all — the model trained as a
+    # pose-memorizer and held-out eval sat at the mean-image floor while
+    # the seen-pose probe hit 20 dB. Config.validate() now rejects such
+    # configs outright.
     overrides = [
         f"model.ch={ch}", "model.ch_mult=[1,2]", f"model.emb_ch={2 * ch}",
-        "model.num_res_blocks=2", f"model.attn_resolutions=[{size // 4}]",
+        "model.num_res_blocks=2", f"model.attn_resolutions=[{size // 2}]",
         f"data.img_sidelength={size}",
         "train.batch_size=8", f"train.num_steps={steps}",
         f"train.save_every={max(steps // 4, 1)}", "train.log_every=50",

@@ -129,7 +129,7 @@ def main() -> None:
         t0 = time.time()
         rc = cli(["train", root, "--no-grain",
                   "model.ch=32", "model.ch_mult=[1,2]", "model.emb_ch=32",
-                  "model.num_res_blocks=1", "model.attn_resolutions=[4]",
+                  "model.num_res_blocks=1", "model.attn_resolutions=[8]",
                   "diffusion.timesteps=8", "diffusion.sample_timesteps=4",
                   "data.img_sidelength=16",
                   "train.batch_size=8", "train.num_steps=3",
