@@ -339,7 +339,13 @@ def _cost_numbers(compiled):
     and the unwrap must not fork between analyze and the train bench."""
     ca = compiled.cost_analysis()
     if isinstance(ca, list):
-        ca = ca[0]
+        # Legacy return shape (pre-dict JAX): whether the entry is
+        # per-device or whole-program varies by version, and MFU divides
+        # by peak * n_chips assuming whole-program. An absent roofline
+        # beats one that is silently n_chips off — report nothing. (The
+        # pinned JAX here returns a dict; this branch is a refusal, not a
+        # compat path.)
+        return None, None
     flops = float(ca.get("flops", 0.0)) or None
     byts = float(ca.get("bytes accessed", 0.0)) or None
     return flops, byts
@@ -615,17 +621,21 @@ def main():
     }
     if flops:
         # Space-normalized: v5e reports device_kind "TPU v5 lite". Dense
-        # bf16 peak per chip from public spec sheets: v5e/v5litepod 394 TF;
-        # v4 275 TF; v6e/trillium 918 TF. Unknown kinds report raw
-        # flops/bytes without a utilization claim.
+        # bf16 peak per chip from public spec sheets: v5e/v5litepod 197 TF
+        # (394 is its int8 TOPS figure, not bf16); v4 275 TF; v6e/trillium
+        # 918 TF. Unknown kinds report raw flops/bytes without a
+        # utilization claim. cost_analysis() on an SPMD executable reports
+        # whole-program flops in the JAX versions pinned here, so MFU
+        # normalizes by peak * n_chips; on one chip the two conventions
+        # coincide.
         kind = jax.devices()[0].device_kind.lower().replace(" ", "")
-        peak = next((v for k, v in (("v5lite", 394e12), ("v5e", 394e12),
+        peak = next((v for k, v in (("v5lite", 197e12), ("v5e", 197e12),
                                     ("v6", 918e12), ("v4", 275e12))
                      if k in kind), None)
         result["flops_per_step"] = flops
         result["achieved_tflops_per_sec"] = round(flops / sec_fw / 1e12, 2)
         if peak:
-            result["mfu"] = round(flops / sec_fw / peak, 4)
+            result["mfu"] = round(flops / sec_fw / (peak * n_chips), 4)
     if byts:  # independent of flops: HBM-bound points must not vanish
         result["hbm_bytes_per_step"] = byts
         result["hbm_gbytes_per_sec"] = round(byts / sec_fw / 1e9, 1)

@@ -24,6 +24,11 @@ class ModelConfig:
     ch_mult: Tuple[int, ...] = (1, 2)
     emb_ch: int = 32
     num_res_blocks: int = 2
+    # DDPM-style superset filter: attention runs at every UNet level whose
+    # resolution is in this set; entries with no matching level are inert
+    # by design (one list serves all depths/image sizes). validate()
+    # rejects lists where NO level matches, and entries that could never
+    # match at any depth (not power-of-two-related to the sidelength).
     attn_resolutions: Tuple[int, ...] = (8, 16, 32)
     attn_heads: int = 4
     dropout: float = 0.1
@@ -271,7 +276,8 @@ class Config:
         # postmortem — the r2 tool used size//4 on a 2-level UNet).
         level_res = {d.img_sidelength // (2 ** lv)
                      for lv in range(len(m.ch_mult))}
-        if m.attn_resolutions and not (set(m.attn_resolutions) & level_res):
+        stray = set(m.attn_resolutions) - level_res
+        if m.attn_resolutions and stray == set(m.attn_resolutions):
             errors.append(
                 f"model.attn_resolutions={tuple(m.attn_resolutions)} "
                 f"matches NO UNet level (levels run at "
@@ -282,6 +288,31 @@ class Config:
                 "the generated view. Pick resolutions from the level set, "
                 "or set attn_resolutions=() explicitly for an attention-free "
                 "model")
+        elif stray:
+            # Partial match: attention fires somewhere, but stray entries
+            # are silently inert (advisor r3 — a sub-lethal recurrence of
+            # the r2/r3 postmortem class). Entries related to the
+            # sidelength by a power of two are a deliberate DDPM-style
+            # superset list (the presets keep one attn list across depths
+            # and image sizes; e.g. 8 on a 3-level 64px UNet) — allowed.
+            # Anything else (e.g. 5 at sidelength 16) can never name a
+            # UNet level at any depth or power-of-two rescale of this
+            # config: error.
+            def _pow2_related(e: int) -> bool:
+                if e <= 0:
+                    return False
+                a, b = max(e, d.img_sidelength), min(e, d.img_sidelength)
+                q, r = divmod(a, b)
+                return r == 0 and (q & (q - 1)) == 0
+            bogus = {e for e in stray if not _pow2_related(e)}
+            if bogus:
+                errors.append(
+                    f"model.attn_resolutions entries "
+                    f"{tuple(sorted(bogus))} match no UNet level and never "
+                    f"could (level resolutions are "
+                    f"data.img_sidelength={d.img_sidelength} divided by "
+                    "powers of 2): each would be silently inert. Remove "
+                    "them or pick resolutions from the level set")
         if not 0.0 <= m.dropout < 1.0:
             errors.append(f"model.dropout={m.dropout} outside [0, 1)")
         if m.num_cond_frames < 1:

@@ -104,11 +104,12 @@ class GroupNorm(nn.Module):
         B, F, H, W, C = h.shape
         if self.fused and self.per_frame and fits_vmem(H * W, C, h.dtype):
             scale, bias = _GNParams(features=C, name="GroupNorm_0")()
+            # out_dtype=self.dtype matches the XLA branch's semantics:
+            # nn.GroupNorm casts to the module dtype, THEN swish runs in
+            # that dtype.
             y = fused_group_norm(h.reshape(B * F, H * W, C), scale, bias,
-                                 32, 1e-6, self.act)
-            # Match the XLA branch's dtype semantics (nn.GroupNorm casts
-            # its output to the module dtype).
-            return y.reshape(B, F, H, W, C).astype(self.dtype)
+                                 32, 1e-6, self.act, self.dtype)
+            return y.reshape(B, F, H, W, C)
         norm = nn.GroupNorm(num_groups=32, dtype=self.dtype)
         if self.per_frame:
             y = norm(h.reshape(B * F, H, W, C)).reshape(B, F, H, W, C)

@@ -80,14 +80,19 @@ def _gn_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref,
     rstd = jax.lax.rsqrt(var + eps)
     xhat = ((xg - mean[None, :, None]) * rstd[None, :, None]).reshape(hw, c)
     y = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    # Cast BEFORE the activation to mirror the XLA path's ordering
+    # (nn.GroupNorm casts its output to the module dtype, then swish runs
+    # in that dtype) — keeps the two paths interchangeable at bf16 too.
+    y = y.astype(y_ref.dtype)
     if act == "swish":
         y = y * jax.nn.sigmoid(y)
-    y_ref[0] = y.astype(y_ref.dtype)
+    y_ref[0] = y
     mean_ref[0] = mean
     rstd_ref[0] = rstd
 
 
-def _forward(x, scale, bias, groups: int, eps: float, act: Optional[str]):
+def _forward(x, scale, bias, groups: int, eps: float, act: Optional[str],
+             out_dtype):
     n, hw, c = x.shape
     kernel = functools.partial(_gn_kernel, groups=groups, eps=eps, act=act)
     y, mean, rstd = pl.pallas_call(
@@ -104,7 +109,7 @@ def _forward(x, scale, bias, groups: int, eps: float, act: Optional[str]):
             pl.BlockSpec((1, groups), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, hw, c), x.dtype),
+            jax.ShapeDtypeStruct((n, hw, c), out_dtype or x.dtype),
             jax.ShapeDtypeStruct((n, groups), jnp.float32),
             jax.ShapeDtypeStruct((n, groups), jnp.float32),
         ],
@@ -113,25 +118,28 @@ def _forward(x, scale, bias, groups: int, eps: float, act: Optional[str]):
     return y, mean, rstd
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def fused_group_norm(x, scale, bias, groups: int = 32, eps: float = 1e-6,
-                     act: Optional[str] = None):
+                     act: Optional[str] = None, out_dtype=None):
     """GroupNorm(+optional swish) over (N, H·W, C) rows in one HBM pass.
 
     scale/bias are (C,) — flax GroupNorm's parameter shapes. Returns the
-    normalized (activated) tensor in x.dtype. Differentiable via an
-    explicit XLA backward (see module docstring).
+    normalized (activated) tensor in `out_dtype` (default x.dtype); the
+    cast happens BEFORE the activation, mirroring the XLA path's
+    nn.GroupNorm(dtype=out_dtype)-then-swish ordering so the two paths
+    stay interchangeable even when x.dtype differs from the module dtype.
+    Differentiable via an explicit XLA backward (see module docstring).
     """
-    y, _, _ = _forward(x, scale, bias, groups, eps, act)
+    y, _, _ = _forward(x, scale, bias, groups, eps, act, out_dtype)
     return y
 
 
-def _fwd(x, scale, bias, groups, eps, act):
-    y, mean, rstd = _forward(x, scale, bias, groups, eps, act)
+def _fwd(x, scale, bias, groups, eps, act, out_dtype):
+    y, mean, rstd = _forward(x, scale, bias, groups, eps, act, out_dtype)
     return y, (x, scale, bias, mean, rstd)
 
 
-def _bwd(groups, eps, act, res, g):
+def _bwd(groups, eps, act, out_dtype, res, g):
     x, scale, bias, mean, rstd = res
     n, hw, c = x.shape
     cg = c // groups

@@ -314,6 +314,11 @@ def test_config_validate_catches_bad_configs():
     with pytest.raises(ValueError, match="matches NO UNet level"):
         good.override(**{"model.attn_resolutions": (4,),
                          "data.img_sidelength": 16}).validate()
+    # Partial match: one valid + one bogus entry must ALSO be rejected —
+    # the bogus one would be silently inert (advisor r3).
+    with pytest.raises(ValueError, match="match no UNet level"):
+        good.override(**{"model.attn_resolutions": (16, 5),
+                         "data.img_sidelength": 16}).validate()
     # Explicitly attention-free is allowed.
     good.override(**{"model.attn_resolutions": ()}).validate()
 

@@ -32,9 +32,12 @@ def _xla_reference(x2d, scale, bias, groups=32, act=None):
     var = ((xf - mean) ** 2).mean(axis=(1, 3), keepdims=True)
     xhat = ((xf - mean) / jnp.sqrt(var + 1e-6)).reshape(n, hw, c)
     y = xhat * scale + bias
+    # Cast BEFORE the activation — the kernel mirrors the XLA path's
+    # nn.GroupNorm(dtype=...)-casts-then-swish ordering.
+    y = y.astype(x2d.dtype)
     if act == "swish":
         y = nn.swish(y)
-    return y.astype(x2d.dtype)
+    return y
 
 
 def test_forward_matches_xla_f32():
@@ -55,6 +58,45 @@ def test_forward_matches_xla_bf16():
     assert got.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(got, np.float32),
                                np.asarray(want, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_module_paths_bit_identical_bf16():
+    """GroupNorm(fused=True) vs the nn.GroupNorm path at bf16 must be
+    BIT-identical — the kernel mirrors the XLA path's cast-then-swish
+    ordering, so any reordering (e.g. swish in f32 then cast) regresses
+    this from 0 to ~bf16-ulp drift and fails here."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 8, 8, 64),
+                          jnp.bfloat16)
+    for act in (None, "swish"):
+        fused = GroupNorm(per_frame=True, act=act, fused=True,
+                          dtype=jnp.bfloat16)
+        plain = GroupNorm(per_frame=True, act=act, fused=False,
+                          dtype=jnp.bfloat16)
+        params = fused.init(jax.random.PRNGKey(1), x)
+        params = jax.tree.map(lambda a: a + 0.3, params)  # non-unit affine
+        yf = np.asarray(fused.apply(params, x), np.float32)
+        yx = np.asarray(plain.apply(params, x), np.float32)
+        np.testing.assert_array_equal(yf, yx)
+
+
+def test_out_dtype_mirrors_module_dtype_on_f32_input():
+    """fused=True with module dtype bf16 on an f32 INPUT must follow the
+    XLA path's semantics (cast to module dtype, then activation) — the
+    advisor-r3 dtype-mismatch case."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 8, 8, 64),
+                          jnp.float32)
+    fused = GroupNorm(per_frame=True, act="swish", fused=True,
+                      dtype=jnp.bfloat16)
+    plain = GroupNorm(per_frame=True, act="swish", fused=False,
+                      dtype=jnp.bfloat16)
+    params = fused.init(jax.random.PRNGKey(3), x)
+    params = jax.tree.map(lambda a: a + 0.3, params)
+    yf = fused.apply(params, x)
+    yx = plain.apply(params, x)
+    assert yf.dtype == yx.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(yf, np.float32),
+                               np.asarray(yx, np.float32),
                                rtol=2e-2, atol=2e-2)
 
 

@@ -14,8 +14,12 @@ generalization — the same protocol as eval on seen-instance SRN splits.
 Writes results/quality_r02/: eval_single.json, eval_autoregressive.json,
 samples_*.png grids, eval.csv (the in-training probe curve), summary.json.
 
-Usage: python tools/quality_run.py [out_dir] [steps] [size]
-       (defaults: results/quality_r02 3000 32; honors JAX_PLATFORMS)
+Usage: python tools/quality_run.py [out_dir] [steps] [size] [overrides...]
+       (defaults: results/quality_r02 3000 32; honors JAX_PLATFORMS).
+       Trailing key=value args are config overrides appended AFTER the
+       built-in list (so they win), applied to the persisted config.json
+       and every train/eval/sample invocation alike — e.g.
+       `model.num_cond_frames=2` for the k=2 ablation.
 """
 
 from __future__ import annotations
@@ -34,6 +38,10 @@ def main() -> None:
         REPO, "results", "quality_r02")
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3000
     size = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+    extra_overrides = sys.argv[4:]
+    for ov in extra_overrides:  # fail fast, not 3h into a TPU run
+        if "=" not in ov:
+            raise SystemExit(f"override {ov!r} is not key=value")
 
     from _common import init_jax_env
     init_jax_env()
@@ -85,7 +93,7 @@ def main() -> None:
         "diffusion.sample_timesteps=64",
         f"train.checkpoint_dir={work}/ckpt",
         f"train.results_folder={out_dir}",
-    ]
+    ] + extra_overrides  # caller overrides win (applied last)
     os.makedirs(out_dir, exist_ok=True)
     # Persist the RESOLVED config next to the checkpoint so follow-up tools
     # (tools/sampler_comparison.py --config) reload exactly this model

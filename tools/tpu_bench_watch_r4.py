@@ -1,0 +1,89 @@
+"""Round-4 TPU watcher: wait for the tunnel, run the VERDICT-r3 matrix.
+
+The probe/run/resume machinery lives in tools/_common.run_watcher (shared
+across round watchers); this file is only the round-4 MATRIX, ordered by
+VERDICT r3 "Next round":
+  1. the judged BASELINE metrics first (tiny64 train = the driver's exact
+     invocation, 256-step sampler sec/view);
+  2. paper256: analyze (16G fit check) then first-ever execution (item 5);
+  3. the two Pallas kernels A/B on hardware at tiny64 AND base128
+     (item 4): flash off vs default-auto-on, fused-GN on vs default-off;
+  4. the 20k-step 64px quality run (item 2) + sampler comparison;
+  5. k=2 vs k=1 conditioning quality runs at matched budget (item 8).
+
+Usage: python tools/tpu_bench_watch_r4.py [max_wait_hours]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "results", "tpu_r04")
+# Single source of truth for the warm-up↔judged-bench cache handoff: the
+# SAME default bench.py resolves when JAX_COMPILATION_CACHE_DIR is unset.
+sys.path.insert(0, REPO)
+from bench import CACHE_DIR as CACHE  # noqa: E402
+from _common import run_watcher  # noqa: E402
+
+MATRIX = [
+    # (name, argv after `python`, timeout_s), judged metrics first.
+    # 1. The driver's exact end-of-round invocation (tiny64 30 steps):
+    #    banks the headline AND warms .jax_cache for the judged bench.
+    ("tiny64_train", ["bench.py"], 1800),
+    # 2. BASELINE metric 2 (DDPM 256-step sec/view) — never landed on TPU.
+    ("sample_tiny64_256", ["bench.py", "sample", "tiny64", "256"], 2400),
+    # 3. The north-star config: compile-only analyze FIRST (validates the
+    #    16G fit claim via memory_analysis even if the train bench then
+    #    fails, and its cached executable warms the train compile), then
+    #    the first-ever paper256 execution.
+    ("analyze_paper256", ["bench.py", "analyze", "paper256"], 3600),
+    ("paper256_train", ["bench.py", "paper256", "10"], 5400),
+    ("sample_base128_256", ["bench.py", "sample", "base128", "256"], 2400),
+    # 4. Pallas kernel A/B on hardware (VERDICT r3 item 4). Defaults:
+    #    flash='auto' (ON on TPU), fused-GN=False (OFF) — so the pairs are
+    #    (default vs flash-off) and (fused-on vs default).
+    ("base128_train", ["bench.py", "base128", "20"], 2400),
+    ("tiny64_noflash", ["bench.py", "tiny64", "30",
+                        "model.use_flash_attention=False"], 1800),
+    ("tiny64_fusedgn", ["bench.py", "tiny64", "30",
+                        "model.use_fused_groupnorm=True"], 1800),
+    ("base128_noflash", ["bench.py", "base128", "20",
+                         "model.use_flash_attention=False"], 2400),
+    ("base128_fusedgn", ["bench.py", "base128", "20",
+                         "model.use_fused_groupnorm=True"], 2400),
+    ("base128_bs16", ["bench.py", "base128", "20",
+                      "train.batch_size=16"], 2400),
+    # Fast-sampler points for the speed/quality story.
+    ("sample_dpmpp32_tiny64", ["bench.py", "sample", "tiny64", "32",
+                               "diffusion.sampler=dpm++"], 1800),
+    ("sample_ar_tiny64", ["bench.py", "sample-ar", "tiny64", "8"], 2400),
+    # 5. The 20k-step 64px quality run (VERDICT r3 item 2): held-out PSNR
+    #    must clear the ~9.7 dB mean-image floor decisively (≥18 dB bar).
+    ("quality_tpu_64px", ["tools/quality_run.py",
+                          os.path.join("results", "quality_tpu_r04"),
+                          "20000", "64"], 14400),
+    # Sampler quality/speed table on that run's retained checkpoint.
+    ("sampler_comparison_quality64",
+     ["tools/sampler_comparison.py", "results/quality_tpu_r04/work/val",
+      "results/quality_tpu_r04/sampler_comparison.json",
+      "--config", "results/quality_tpu_r04/work/config.json",
+      "--num-instances", "6", "--views-per-instance", "2"], 3600),
+    # 6. k=2 conditioning vs the k=1 baseline (VERDICT r3 item 8) at
+    #    matched budget/size: does a second conditioning frame lift
+    #    held-out PSNR? (extra argv → quality_run.py config overrides).
+    ("quality_tpu_k2", ["tools/quality_run.py",
+                        os.path.join("results", "quality_tpu_r04_k2"),
+                        "8000", "64", "model.num_cond_frames=2"], 10800),
+    ("quality_tpu_k1_matched", ["tools/quality_run.py",
+                                os.path.join("results",
+                                             "quality_tpu_r04_k1m"),
+                                "8000", "64"], 10800),
+    ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
+]
+
+
+if __name__ == "__main__":
+    max_wait_h = float(sys.argv[1]) if len(sys.argv) > 1 else 11.0
+    run_watcher(OUT, MATRIX, max_wait_h, CACHE)
