@@ -51,6 +51,12 @@ class EvalResult:
     # feature_fn gets the plain "fid" key.
     fid_label: str = "fid_random"
     protocol: str = "single"
+    # Relative output delta when the conditioning image is swapped across
+    # instances (see cond_sensitivity). 0.0 means the model IGNORES its
+    # conditioning image — the r2/r3 failure class (inert cross-frame
+    # attention trains an unconditional pose-memorizer whose seen-pose
+    # PSNR looks healthy). None when too few distinct instances to swap.
+    cond_sens: Optional[float] = None
 
     def to_dict(self) -> dict:
         d = {
@@ -61,7 +67,86 @@ class EvalResult:
         }
         if self.fid is not None:
             d[self.fid_label] = self.fid
+        if self.cond_sens is not None:
+            d["cond_sens"] = self.cond_sens
         return d
+
+
+def make_cond_sensitivity_fn(model, logsnr: float = 0.0):
+    """Jitted conditioning-sensitivity probe: swap the cond image, measure
+    the output delta.
+
+    Returns fn(params, key, batch) -> scalar, where batch holds x/R1/t1/
+    R2/t2/K/target (B ≥ 2, distinct conditioning images). The target is
+    noised to the given logsnr (α = σ(logsnr); default 0.0 = mid-noise,
+    α = ½), the denoiser is applied twice — once with the true
+    conditioning images, once with them rolled by one along the batch
+    axis (poses NOT rolled: only the image path is probed) — and the
+    scalar is mean|Δout| / mean|out|.
+
+    Cross-frame attention is the ONLY path from the conditioning image to
+    the target-frame output (convs are per-frame, models/layers.py), so an
+    inert-attention config — the r2/r3 postmortem class
+    (results/RESULTS_r03.md) — yields EXACTLY 0.0 here while its seen-pose
+    PSNR curve still looks healthy. A healthy conditioned model yields
+    O(0.1–1). One forward pair per call: cheap enough for the in-loop
+    probe at every eval point.
+    """
+
+    @jax.jit
+    def fn(params, key, batch):
+        target = batch["target"]
+        B = target.shape[0]
+        alpha = jax.nn.sigmoid(jnp.asarray(logsnr, jnp.float32))
+        noise = jax.random.normal(key, target.shape)
+        z = jnp.sqrt(alpha) * target + jnp.sqrt(1.0 - alpha) * noise
+        mb = {k: batch[k] for k in ("x", "R1", "t1", "R2", "t2", "K")}
+        mb["z"] = z
+        mb["logsnr"] = jnp.full((B,), logsnr, jnp.float32)
+        mask = jnp.ones((B,))
+        out = model.apply({"params": params}, mb, cond_mask=mask,
+                          train=False)
+        swapped = dict(mb, x=jnp.roll(mb["x"], 1, axis=0))
+        out_swap = model.apply({"params": params}, swapped, cond_mask=mask,
+                               train=False)
+        # (delta, scale) rather than the ratio: the ratio's degenerate
+        # cases (vacuous swap, all-zero output) need host-side None
+        # semantics — see cond_sensitivity.
+        return (jnp.mean(jnp.abs(out - out_swap)),
+                jnp.mean(jnp.abs(out)))
+
+    return fn
+
+
+# Below this output scale the ratio is meaningless, not alarming: a model
+# whose output is ~identically zero (fresh zero-init head, collapsed run)
+# would otherwise score delta/scale = 0/ε = 0.0 — the exact value documented
+# as the inert-attention alarm.
+_COND_SENS_MIN_SCALE = 1e-6
+
+
+def cond_sensitivity(model, params, batch: dict, *, key,
+                     logsnr: float = 0.0, fn=None) -> Optional[float]:
+    """One-shot conditioning-sensitivity probe (see make_cond_sensitivity_fn).
+
+    Returns None when the probe cannot distinguish pathology from
+    degeneracy — fewer than 2 samples, all conditioning images identical
+    (rolled == original ⇒ delta is 0 by construction), or an output that is
+    itself ~0 (fresh zero-init head / collapsed run).
+
+    `fn`: a cached make_cond_sensitivity_fn(model, logsnr) result; pass it
+    from a loop (e.g. the trainer's in-loop probe) to avoid re-jitting —
+    `model`/`logsnr` are ignored when given.
+    """
+    x = np.asarray(batch["x"])
+    if x.shape[0] < 2 or not np.any(x != np.roll(x, 1, axis=0)):
+        return None
+    if fn is None:
+        fn = make_cond_sensitivity_fn(model, logsnr)
+    delta, scale = (float(v) for v in fn(params, key, batch))
+    if scale < _COND_SENS_MIN_SCALE:
+        return None
+    return delta / scale
 
 
 def evaluate_dataset(
@@ -147,6 +232,28 @@ def evaluate_dataset(
         raise ValueError(
             "FID needs ≥2 evaluation pairs for a covariance estimate; "
             "raise num_instances/views_per_instance or drop compute_fid")
+
+    # Standing conditioning-sensitivity probe (one forward pair over one
+    # (cond, target) pair per instance — needs ≥2 distinct instances to
+    # swap across). Runs before sampling so a cond_sens == 0.0 failure is
+    # visible even if the (much slower) sampling loop is interrupted.
+    sens = None
+    if len(instances) >= 2:
+        # Cap the probe batch: one pair per instance but no more than the
+        # sampler's batch_size — a full-split eval (hundreds of instances)
+        # must not stack them all into one jitted forward.
+        probe = instances[:max(2, min(len(instances), batch_size))]
+        sens_batch = jax.tree.map(jnp.asarray, {
+            "x": np.stack([c[0] for c in probe]),
+            "R1": np.stack([c[1][:3, :3] for c in probe]),
+            "t1": np.stack([c[1][:3, 3] for c in probe]),
+            "R2": np.stack([c[3][0][1][:3, :3] for c in probe]),
+            "t2": np.stack([c[3][0][1][:3, 3] for c in probe]),
+            "K": np.stack([c[2] for c in probe]),
+            "target": np.stack([c[3][0][0] for c in probe]),
+        })
+        key, k_sens = jax.random.split(key)
+        sens = cond_sensitivity(model, params, sens_batch, key=k_sens)
 
     all_psnr, all_ssim, all_imgs = [], [], []
 
@@ -250,4 +357,5 @@ def evaluate_dataset(
         fid=fid_value,
         fid_label="fid" if fid_feature_fn is not None else "fid_random",
         protocol=protocol,
+        cond_sens=sens,
     )

@@ -81,11 +81,19 @@ class MetricsLogger:
     def log_eval(self, step: int, metrics: dict) -> None:
         """Append eval-quality metrics (PSNR/SSIM/…) to eval.csv + TB."""
         path = os.path.join(os.path.dirname(self.csv_path), "eval.csv")
+        header = ["step"] + sorted(metrics)
         new = not os.path.exists(path) or os.path.getsize(path) == 0
+        if not new:
+            # Resumed run logging a different metric set (e.g. an older
+            # build without cond_sens): rotate rather than misalign rows.
+            with open(path) as fh:
+                if fh.readline().strip().split(",") != header:
+                    os.replace(path, path + ".old")
+                    new = True
         with open(path, "a", newline="") as fh:
             w = csv.writer(fh)
             if new:
-                w.writerow(["step"] + sorted(metrics))
+                w.writerow(header)
             w.writerow([step] + [f"{float(metrics[k]):.5f}"
                                  for k in sorted(metrics)])
         if self._tb is not None:

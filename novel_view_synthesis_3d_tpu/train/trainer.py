@@ -169,6 +169,7 @@ class Trainer:
             else:
                 self._eval_batch = jax.tree.map(np.array, first_batch)
         self._samplers = {}  # sample_steps -> jitted sampler (_sample_cond)
+        self._cond_sens_fn = None  # lazily-built jitted probe (eval_step)
         self.state = create_train_state(
             tcfg, self.model, _sample_model_batch(first_batch))
         self._state_sharding = mesh_lib.state_shardings(
@@ -431,18 +432,50 @@ class Trainer:
             "psnr": float(np.mean(psnr(imgs, truth))),
             "ssim": float(np.mean(ssim(imgs, truth))),
         }
+        # Standing conditioning-sensitivity probe (VERDICT r3 item 3): the
+        # r2/r3 inert-attention failure class trains an unconditional
+        # pose-memorizer whose seen-pose PSNR looks healthy — this logs
+        # 0.00000 in eval.csv the first time that happens instead of
+        # requiring a manual postmortem. One cheap forward pair; absent
+        # (not 0.0) while the probe is degenerate (e.g. zero-init output).
+        from novel_view_synthesis_3d_tpu.eval.evaluate import (
+            cond_sensitivity,
+            make_cond_sensitivity_fn,
+        )
+
+        if self._cond_sens_fn is None:
+            self._cond_sens_fn = make_cond_sensitivity_fn(self._probe_model())
+        sens = cond_sensitivity(
+            None, params,
+            {k: jnp.asarray(batch[k][:num])
+             for k in ("x", "R1", "t1", "R2", "t2", "K", "target")},
+            key=jax.random.PRNGKey(step), fn=self._cond_sens_fn)
+        # NaN (not a missing key) when the probe declines: the eval.csv
+        # schema must be stable across a run — a step-0 eval (zero-init
+        # output → probe degenerate) would otherwise log a different
+        # column set than later evals and trigger the header rotation
+        # mid-run, truncating the curve.
+        logged["cond_sens"] = float("nan") if sens is None else sens
         self.metrics.log_eval(step, logged)
         return logged
+
+    def _probe_model(self) -> XUNet:
+        """The model the in-loop probes run: dense (non-sequence-parallel)
+        attention — identical math and identical params, but free of the
+        batch/'data'-axis divisibility constraint the ring path imposes (a
+        4-view probe need not divide the mesh)."""
+        if self.config.model.sequence_parallel:
+            import dataclasses
+            return XUNet(dataclasses.replace(
+                self.config.model, sequence_parallel=False))
+        return self.model
 
     def _sample_cond(self, cond: dict, seed: int, *, params,
                      sample_steps: Optional[int] = None) -> np.ndarray:
         """Sample novel views for a conditioning dict with current params.
 
-        Samples with dense (non-sequence-parallel) attention: identical math
-        and identical params, but free of the batch/'data'-axis
-        divisibility constraint the ring path imposes (a 4-view probe need
-        not divide the mesh). Samplers are cached per sample_steps — a
-        fresh make_sampler closure would recompile its scan on every call.
+        Samplers are cached per sample_steps — a fresh make_sampler closure
+        would recompile its scan on every call.
 
         `params` comes from `_probe_host_params` (host-local on pods, so
         the sampler never emits a cross-host collective)."""
@@ -451,12 +484,7 @@ class Trainer:
         sampler = self._samplers.get(key)
         if sampler is None:
             dcfg = self.config.diffusion
-            sample_model = self.model
-            if self.config.model.sequence_parallel:
-                import dataclasses
-                sample_model = XUNet(dataclasses.replace(
-                    self.config.model, sequence_parallel=False))
-            sampler = make_sampler(sample_model,
+            sampler = make_sampler(self._probe_model(),
                                    sampling_schedule(dcfg, sample_steps),
                                    dcfg)
             self._samplers[key] = sampler
