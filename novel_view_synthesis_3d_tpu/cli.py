@@ -99,6 +99,12 @@ def _restore_params(cfg: Config, model, sample_batch: dict, step: Optional[int],
     from novel_view_synthesis_3d_tpu.train.state import create_train_state
 
     template = create_train_state(cfg.train, model, sample_batch)
+    if cfg.train.ema_host and cfg.train.ema_decay > 0:
+        # Host-EMA checkpoints carry the (host f32) EMA tree in ema_params
+        # even though the live TrainState keeps it None — mirror that
+        # structure or StandardRestore rejects the tree.
+        template = template.replace(ema_params=jax.tree.map(
+            lambda p: np.zeros(p.shape, np.float32), template.params))
     ckpt = CheckpointManager(cfg.train.checkpoint_dir)
     if ckpt.latest_step() is None:
         raise FileNotFoundError(

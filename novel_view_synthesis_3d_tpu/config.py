@@ -202,6 +202,14 @@ class TrainConfig:
     # mesh.model > 1. The reference has no TP (SURVEY.md §2.3).
     tp: bool = False
     ema_decay: float = 0.0  # 0 = off; 3DiM paper uses EMA for sampling
+    # Host-side EMA: keep the EMA buffer in host RAM instead of HBM
+    # (frees 4 bytes/param on-chip — 2.6G for the 708M-param paper256
+    # model, the margin between fitting a 16G chip and OOM). The Trainer
+    # pulls params every ema_host_every steps and folds them in with the
+    # decay^k correction (ema ← d^k·ema + (1−d^k)·params — the standard
+    # sparse-EMA update; exact for k=1). Checkpointed with the state.
+    ema_host: bool = False
+    ema_host_every: int = 25
     results_folder: str = "./results"
     checkpoint_dir: str = "./checkpoints"
     resume: bool = True  # auto-resume from latest checkpoint (ref: absent)
@@ -356,6 +364,12 @@ class Config:
             errors.append(
                 f"train.adam_mu_dtype={t.adam_mu_dtype!r} must be "
                 "'float32' or 'bfloat16'")
+        if t.ema_host and t.ema_decay <= 0:
+            errors.append(
+                "train.ema_host=True is inert without train.ema_decay > 0")
+        if t.ema_host_every < 1:
+            errors.append(
+                f"train.ema_host_every={t.ema_host_every} must be >= 1")
         if not 0.0 <= t.cond_drop_prob <= 1.0:
             errors.append(
                 f"train.cond_drop_prob={t.cond_drop_prob} outside [0, 1]")
@@ -478,11 +492,17 @@ def get_preset(name: str) -> Config:
             model=ModelConfig(ch=256, ch_mult=(1, 2, 2, 4, 4), emb_ch=1024,
                               num_res_blocks=3, dtype="bfloat16", remat=True),
             data=DataConfig(img_sidelength=256),
-            # grad_accum: the batch-8 256px step wants ~32G of activations
-            # (22.7G at micro-batch 2); micro-batches of 1 fit a single 16G
-            # chip with remat. On an N-chip mesh the effective accumulation
-            # shrinks automatically (per-chip memory already scales as 1/N).
+            # Measured on v5e (results/tpu_r04/analyze_paper256.out): the
+            # 708M-param state is params f32 2.64G + Adam nu f32 2.64G +
+            # mu bf16 1.32G, and a DEVICE EMA copy (f32 2.64G) pushed total
+            # usage to 17.94G of 15.75G — OOM. ema_host moves that copy to
+            # host RAM (bf16 EMA would be wrong: decay 0.9999 updates round
+            # to nothing in 8 mantissa bits). grad_accum: the batch-8 256px
+            # step wants ~32G of activations; micro-batches of 1 with
+            # remat fit. On an N-chip mesh the effective accumulation
+            # shrinks automatically (per-chip memory scales as 1/N).
             train=TrainConfig(batch_size=8, ema_decay=0.9999,
+                              ema_host=True,
                               grad_accum_steps=8,
                               # 0.5x param bytes of HBM back on the 16G
                               # chip; see TrainConfig.adam_mu_dtype.
@@ -504,5 +524,9 @@ def get_preset(name: str) -> Config:
             # Per-chip batch is already small on 64 chips (256/64 = 4) and
             # FSDP frees the param/optimizer HBM — no micro-batching needed.
             "train.grad_accum_steps": 1,
+            # FSDP shards the EMA copy too (2.64G/64 per chip) — keep it
+            # on device; the host-EMA path would all-gather params on every
+            # update across the pod instead.
+            "train.ema_host": False,
         })
     raise KeyError(f"unknown preset {name!r}")

@@ -133,8 +133,10 @@ def create_train_state(cfg: TrainConfig, model, sample_batch: dict,
             rng=k_train,
             # Distinct buffers from params: the donated train step must not
             # see the same buffer twice (f(donate(a), donate(a)) invalid).
+            # With ema_host the EMA buffer lives in host RAM instead
+            # (Trainer._host_ema) — no device copy at all.
             ema_params=(jax.tree.map(jnp.copy, params)
-                        if cfg.ema_decay > 0 else None),
+                        if cfg.ema_decay > 0 and not cfg.ema_host else None),
         )
 
     if on_cpu:

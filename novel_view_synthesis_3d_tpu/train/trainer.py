@@ -179,13 +179,40 @@ class Trainer:
             config, self.model, self.schedule, self.mesh,
             state_sharding=self._state_sharding)
 
+        # --- host-side EMA (train.ema_host) ---
+        # The EMA buffer lives in host RAM (f32 numpy) instead of HBM —
+        # 4 bytes/param of chip memory back, the paper256-on-16G margin
+        # (config.py preset comment). Folded in every ema_host_every steps
+        # with the decay^k correction; rides in the checkpoint as the
+        # state's ema_params leaves.
+        self._host_ema = None
+        self._host_ema_step = 0
+        ema_host_on = tcfg.ema_host and tcfg.ema_decay > 0
+        if ema_host_on:
+            # Structure-only template (the restore path just needs matching
+            # tree structure/shapes); filled from the live params below
+            # ONLY when no checkpoint restores over it — a fresh pull here
+            # would be a full param transfer discarded on every resume.
+            self._host_ema = jax.tree.map(
+                lambda p: np.zeros(p.shape, np.float32), self.state.params)
+
         # --- checkpointing / metrics ---
         self.ckpt = CheckpointManager(tcfg.checkpoint_dir)
+        resumed = False
         if tcfg.resume:
-            restored = self.ckpt.restore(self.state)
+            restored = self.ckpt.restore(self._ckpt_state())
             if restored is not None:
+                resumed = True
+                if self._host_ema is not None:
+                    self._host_ema = jax.tree.map(
+                        np.asarray, restored.ema_params)
+                    restored = restored.replace(ema_params=None)
                 self.state = jax.device_put(restored, self._state_sharding)
+                self._host_ema_step = int(jax.device_get(restored.step))
                 print(f"resumed from checkpoint at step {int(self.state.step)}")
+        if ema_host_on and not resumed:
+            self._host_ema = jax.tree.map(
+                lambda a: np.asarray(a, np.float32), self._host_params())
         self.metrics = MetricsLogger(tcfg.results_folder)
         self.results_folder = tcfg.results_folder
         os.makedirs(self.results_folder, exist_ok=True)
@@ -243,6 +270,47 @@ class Trainer:
             self._held_batch = next(self.data_iter)
         return self._held_batch
 
+    # ------------------------------------------------------------------
+    def _host_params(self):
+        """Full host numpy copy of the live params. On multi-process runs
+        EVERY host joins a replication collective first (FSDP shards →
+        fully replicated), so all hosts see — and host-EMA over — the same
+        tree; call at the same step on every host."""
+        params = self.state.params
+        if jax.process_count() > 1:
+            params = mesh_lib.replicate(self.mesh, params)
+        return jax.device_get(params)
+
+    def _ckpt_state(self):
+        """State handed to Orbax: with host EMA on, the numpy EMA tree
+        rides in ema_params (StandardSave/Restore handle mixed
+        device/numpy leaves), so the checkpoint format is identical to a
+        device-EMA run's."""
+        if self._host_ema is None:
+            return self.state
+        return self.state.replace(ema_params=self._host_ema)
+
+    def _maybe_update_host_ema(self, step_now: int,
+                               force: bool = False) -> None:
+        """Fold the live params into the host EMA buffer if due.
+
+        Sparse EMA: k elapsed steps fold in with decay^k —
+        ema ← d^k·ema + (1−d^k)·params — exact for k=1 and the standard
+        approximation for k>1 (one params→host transfer per
+        ema_host_every steps instead of per step). `force` (checkpoint
+        saves, probes) flushes regardless of the interval."""
+        if self._host_ema is None:
+            return
+        k = step_now - self._host_ema_step
+        if k <= 0 or (not force and k < self.config.train.ema_host_every):
+            return
+        d = self.config.train.ema_decay ** k
+        params = self._host_params()
+        self._host_ema = jax.tree.map(
+            lambda e, p: d * e + (1.0 - d) * np.asarray(p, np.float32),
+            self._host_ema, params)
+        self._host_ema_step = step_now
+
     def _upload_next_batch(self):
         """Fetch the next host batch and start its async device upload."""
         batch = self._next_batch()
@@ -290,6 +358,8 @@ class Trainer:
                 # the timed region so timings reflect real device time.
                 step_now = self.step
 
+            self._maybe_update_host_ema(step_now)
+
             if step_now % tcfg.log_every == 0 or step_now == 1:
                 logged = self.metrics.log(
                     step_now, jax.device_get(step_metrics), tcfg.batch_size)
@@ -301,12 +371,14 @@ class Trainer:
                 # Pass the (possibly FSDP-sharded) device state directly:
                 # Orbax gathers per-shard across hosts; device_get would
                 # crash on non-fully-addressable arrays in multi-host runs.
-                self.ckpt.save(step_now, self.state)
+                self._maybe_update_host_ema(step_now, force=True)
+                self.ckpt.save(step_now, self._ckpt_state())
 
             sample_due = (tcfg.sample_every
                           and step_now % tcfg.sample_every == 0)
             eval_due = tcfg.eval_every and step_now % tcfg.eval_every == 0
             if sample_due or eval_due:
+                self._maybe_update_host_ema(step_now, force=True)
                 # Called on EVERY host: non-reporting hosts join the param
                 # replication collective and get None back. Gathered ONCE
                 # even when both probes fire (on a pod each gather is a
@@ -330,7 +402,8 @@ class Trainer:
         # Release the dead prefetched batch's HBM before post-training use
         # of this Trainer (sampling/eval on large configs wants the room).
         self._device_batch = None
-        self.ckpt.save(self.step, self.state, force=True)
+        self._maybe_update_host_ema(self.step, force=True)
+        self.ckpt.save(self.step, self._ckpt_state(), force=True)
         self.ckpt.wait()
         print("training completed")
         if last_metrics is not None:
@@ -352,6 +425,14 @@ class Trainer:
         host-addressable copy and samples on its own devices with zero
         collectives inside the sampler; other hosts get None and return
         early — no multi-writer eval.csv, no mismatched collectives."""
+        self._maybe_update_host_ema(self.step, force=True)
+        if self._host_ema is not None:
+            # Host EMA is already fully replicated host-side (every host
+            # folds the same values) — no collective needed; process 0
+            # pins it on a local device for the probe samplers.
+            if jax.process_index() != 0:
+                return None
+            return jax.device_put(self._host_ema, jax.local_devices()[0])
         params = (self.state.ema_params if self.state.ema_params is not None
                   else self.state.params)
         if jax.process_count() == 1:
