@@ -29,7 +29,7 @@ extern "C" {
 /* ABI version of this library. The ctypes binding refuses to drive a
  * mismatched (stale) .so — bump whenever a signature or buffer layout
  * changes. */
-#define NVS3D_ABI_VERSION 2
+#define NVS3D_ABI_VERSION 3
 int nvs3d_abi_version(void);
 
 /* Most recent error message for the calling thread ("" if none). */
@@ -72,10 +72,17 @@ int nvs3d_parse_intrinsics(const char *path, int sidelength,
  * instance (reference dataset/data_loader.py:85-90 at num_cond=1; 3DiM k>1
  * conditioning otherwise, matching data/srn.py SRNDataset.pair). Worker
  * threads decode and fill whole batches into a bounded prefetch queue.
+ * samples_per_instance (>= 1) applies the reference's instance-grouped
+ * batching (data_loader.py:183-195): each shuffled index draw fills that
+ * many CONSECUTIVE batch slots from one instance — the indexed
+ * observation first, the rest at uniformly random view indices; the
+ * batch then holds batch_size/samples_per_instance index draws
+ * (batch_size must divide evenly).
  * Returns NULL on failure. */
 void *nvs3d_loader_create(const char **rgb_paths, const char **pose_paths,
                           const int32_t *instance_ids, int n_records,
                           int sidelength, int batch_size, int num_cond,
+                          int samples_per_instance,
                           int n_threads, int prefetch_depth, uint64_t seed,
                           int shard_index, int shard_count);
 

@@ -476,6 +476,10 @@ struct Loader {
   std::vector<int32_t> instance_of;            // record -> instance
   std::vector<std::vector<int32_t>> members;   // instance -> records
   int sidelength, batch_size, num_cond, prefetch_depth;
+  // Reference data_loader.py:183-195 grouping: each shuffled index draw
+  // fills `spi` consecutive batch slots from ONE instance. A batch is
+  // batch_size/spi index draws.
+  int spi = 1;
   int shard_index, shard_count;
   uint64_t seed;
 
@@ -500,11 +504,14 @@ struct Loader {
   uint64_t epoch = 0;
   uint64_t serial_counter = 0;
 
+  // Index draws per batch (== batch_size when spi == 1).
+  size_t draws() const { return size_t(batch_size) / size_t(spi); }
+
   void reshuffle_locked() {
     std::mt19937_64 rng(seed ^ (0x9e3779b97f4a7c15ULL * (epoch + 1)));
     order = shard_records;
     std::shuffle(order.begin(), order.end(), rng);
-    size_t usable = (order.size() / batch_size) * batch_size;
+    size_t usable = (order.size() / draws()) * draws();
     order.resize(usable);  // drop remainder (reference DataLoader drop_last)
     cursor = 0;
     ++epoch;
@@ -513,14 +520,14 @@ struct Loader {
   bool claim(std::vector<int32_t> &batch_records, uint64_t &batch_tag,
              uint64_t &serial) {
     std::lock_guard<std::mutex> lk(epoch_mu);
-    if (cursor + batch_size > order.size()) {
+    if (cursor + draws() > order.size()) {
       reshuffle_locked();
-      if (cursor + batch_size > order.size()) return false;  // tiny dataset
+      if (cursor + draws() > order.size()) return false;  // tiny dataset
     }
     size_t start = cursor;
-    cursor += size_t(batch_size);
+    cursor += draws();
     batch_records.assign(order.begin() + start,
-                         order.begin() + start + batch_size);
+                         order.begin() + start + draws());
     // Tag depends only on (epoch, position): the target-view choice is
     // deterministic in (seed, shard) no matter which thread runs the batch.
     batch_tag = epoch * (uint64_t(1) << 32) + start;
@@ -563,12 +570,23 @@ struct Loader {
       b->target.resize(img * batch_size);
       b->pose1.resize(16 * size_t(batch_size) * k);
       b->pose2.resize(16 * size_t(batch_size));
-      b->record_idx.assign(records.begin(), records.end());
       std::mt19937_64 rng(seed ^ (tag * 0xda942042e4dd58b5ULL));
+      // Expand index draws to batch slots: the indexed observation fills
+      // the group's first slot, the remaining spi-1 slots are uniformly
+      // random views of the SAME instance (data_loader.py:183-195).
+      std::vector<int32_t> slots;
+      slots.reserve(size_t(batch_size));
+      for (int32_t rec : records) {
+        slots.push_back(rec);
+        const auto &sibs = members[size_t(instance_of[size_t(rec)])];
+        std::uniform_int_distribution<size_t> pick(0, sibs.size() - 1);
+        for (int s = 1; s < spi; ++s) slots.push_back(sibs[pick(rng)]);
+      }
+      b->record_idx.assign(slots.begin(), slots.end());
       std::string err;
       bool failed = false;
       for (int i = 0; i < batch_size && !failed; ++i) {
-        int32_t rec = records[i];
+        int32_t rec = slots[size_t(i)];
         const auto &sibs = members[size_t(instance_of[size_t(rec)])];
         std::uniform_int_distribution<size_t> pick(0, sibs.size() - 1);
         // Target first, then extra conditioning views — the draw order of
@@ -614,17 +632,23 @@ struct Loader {
 void *nvs3d_loader_create(const char **rgb_paths, const char **pose_paths,
                           const int32_t *instance_ids, int n_records,
                           int sidelength, int batch_size, int num_cond,
+                          int samples_per_instance,
                           int n_threads, int prefetch_depth, uint64_t seed,
                           int shard_index, int shard_count) {
   if (n_records <= 0 || batch_size <= 0 || sidelength <= 0 ||
-      num_cond <= 0) {
+      num_cond <= 0 || samples_per_instance <= 0) {
     g_error = "invalid loader arguments";
+    return nullptr;
+  }
+  if (batch_size % samples_per_instance != 0) {
+    g_error = "batch_size not divisible by samples_per_instance";
     return nullptr;
   }
   auto L = std::make_unique<Loader>();
   L->sidelength = sidelength;
   L->batch_size = batch_size;
   L->num_cond = num_cond;
+  L->spi = samples_per_instance;
   L->prefetch_depth = std::max(1, prefetch_depth);
   L->seed = seed;
   L->shard_index = std::max(0, shard_index);
@@ -648,7 +672,7 @@ void *nvs3d_loader_create(const char **rgb_paths, const char **pose_paths,
     }
   for (int i = L->shard_index; i < n_records; i += L->shard_count)
     L->shard_records.push_back(i);
-  if (int(L->shard_records.size()) < batch_size) {
+  if (L->shard_records.size() < L->draws()) {
     g_error = "shard smaller than one batch";
     return nullptr;
   }

@@ -31,7 +31,7 @@ _load_failed = False
 
 # Must match NVS3D_ABI_VERSION in native/include/nvs3d_io.h: the binding
 # refuses to drive a stale .so whose signatures may have changed.
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 def _build() -> bool:
@@ -98,7 +98,7 @@ def _load():
         lib.nvs3d_loader_create.argtypes = [
             c_char_pp, c_char_pp, i32_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
         lib.nvs3d_loader_next.argtypes = [
             ctypes.c_void_p, f32_p, f32_p, f32_p, f32_p, i32_p]
         lib.nvs3d_loader_destroy.argtypes = [ctypes.c_void_p]
@@ -186,7 +186,7 @@ class NativePairLoader:
     def __init__(self, rgb_paths: Sequence[str], pose_paths: Sequence[str],
                  instance_ids: Sequence[int], Ks: np.ndarray, *,
                  sidelength: int, batch_size: int, num_cond: int = 1,
-                 n_threads: int = 8,
+                 samples_per_instance: int = 1, n_threads: int = 8,
                  prefetch_depth: int = 4, seed: int = 0,
                  shard_index: int = 0, shard_count: int = 1):
         lib = _load()
@@ -208,7 +208,8 @@ class NativePairLoader:
         self._handle = lib.nvs3d_loader_create(
             self._rgb_arr, self._pose_arr,
             inst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            len(rgb_paths), sidelength, batch_size, num_cond, n_threads,
+            len(rgb_paths), sidelength, batch_size, num_cond,
+            samples_per_instance, n_threads,
             prefetch_depth, seed, shard_index, shard_count)
         if not self._handle:
             raise RuntimeError(f"nvs3d_loader_create: {_err(lib)}")
@@ -260,13 +261,14 @@ def make_native_loader(dataset, batch_size: int, *, num_cond: int = 1,
                        prefetch_depth: int = 4, seed: int = 0,
                        shard_index: int = 0,
                        shard_count: int = 1) -> NativePairLoader:
-    """Build a NativePairLoader from a data/srn.SRNDataset."""
-    if getattr(dataset, "samples_per_instance", 1) > 1:
-        # Only the in-process iterator implements instance grouping;
-        # silently batching per-record would drop the configured semantics.
-        raise ValueError(
-            "samples_per_instance > 1 is not supported by the native "
-            "loader; use the in-process backend (data.loader='python')")
+    """Build a NativePairLoader from a data/srn.SRNDataset.
+
+    dataset.samples_per_instance > 1 applies the reference's
+    instance-grouped batching (data_loader.py:183-195) inside the C++
+    loader: each shuffled index draw fills that many consecutive batch
+    slots from one instance — same record semantics as
+    pipeline.iter_batches' grouped path.
+    """
     rgb: List[str] = []
     pose: List[str] = []
     inst: List[int] = []
@@ -279,6 +281,8 @@ def make_native_loader(dataset, batch_size: int, *, num_cond: int = 1,
             Ks.append(instance.K)
     return NativePairLoader(
         rgb, pose, inst, np.stack(Ks), sidelength=dataset.img_sidelength,
-        batch_size=batch_size, num_cond=num_cond, n_threads=n_threads,
+        batch_size=batch_size, num_cond=num_cond,
+        samples_per_instance=getattr(dataset, "samples_per_instance", 1),
+        n_threads=n_threads,
         prefetch_depth=prefetch_depth, seed=seed,
         shard_index=shard_index, shard_count=shard_count)

@@ -63,15 +63,24 @@ def make_grain_loader(dataset: SRNDataset, batch_size: int,
                       shard_count: Optional[int] = None,
                       drop_remainder: bool = True,
                       num_cond: int = 1):
-    """Grain DataLoader yielding batched numpy dicts (per-host shard)."""
-    if getattr(dataset, "samples_per_instance", 1) > 1:
-        # Only the in-process iterator implements instance grouping;
-        # silently batching per-record would drop the configured semantics.
-        raise ValueError(
-            "samples_per_instance > 1 is not supported by the Grain "
-            "loader; use the in-process backend (data.loader='python')")
+    """Grain DataLoader yielding batched numpy dicts (per-host shard).
+
+    With dataset.samples_per_instance > 1 the reference's instance-grouped
+    batching (data_loader.py:183-195) applies: each sampled index yields
+    that many records of ONE instance (SRNDataset.samples — the indexed
+    observation first), stacked on a leading group axis inside the worker;
+    the batch of batch_size/spi groups is then flattened back so groups
+    occupy consecutive batch slots, exactly like iter_batches' grouped
+    path. batch_size still counts MODEL samples.
+    """
     import grain.python as pygrain
     import jax
+
+    spi = getattr(dataset, "samples_per_instance", 1)
+    if batch_size % spi != 0:
+        raise ValueError(
+            f"batch_size {batch_size} not divisible by "
+            f"samples_per_instance {spi}")
 
     shard_index = jax.process_index() if shard_index is None else shard_index
     shard_count = jax.process_count() if shard_count is None else shard_count
@@ -81,6 +90,27 @@ def make_grain_loader(dataset: SRNDataset, batch_size: int,
     class PairTransform(pygrain.RandomMapTransform):
         def random_map(self, idx, rng: np.random.Generator):
             return ds_ref.pair(int(idx), rng, num_cond=num_cond)
+
+    class GroupTransform(pygrain.RandomMapTransform):
+        def random_map(self, idx, rng: np.random.Generator):
+            records = ds_ref.samples(int(idx), rng, num_cond=num_cond)
+            return {k: np.stack([r[k] for r in records])
+                    for k in records[0]}
+
+    class FlattenGroups(pygrain.MapTransform):
+        def map(self, batch: dict) -> dict:
+            # (draws, spi, ...) -> (draws*spi, ...): groups stay
+            # consecutive in the flattened batch.
+            return {k: v.reshape((-1,) + v.shape[2:])
+                    for k, v in batch.items()}
+
+    operations = [
+        PairTransform() if spi == 1 else GroupTransform(),
+        pygrain.Batch(batch_size=batch_size // spi,
+                      drop_remainder=drop_remainder),
+    ]
+    if spi > 1:
+        operations.append(FlattenGroups())
 
     sampler = pygrain.IndexSampler(
         num_records=len(dataset),
@@ -94,10 +124,7 @@ def make_grain_loader(dataset: SRNDataset, batch_size: int,
     return pygrain.DataLoader(
         data_source=_PairSource(dataset),
         sampler=sampler,
-        operations=[
-            PairTransform(),
-            pygrain.Batch(batch_size=batch_size, drop_remainder=drop_remainder),
-        ],
+        operations=operations,
         worker_count=num_workers,
     )
 

@@ -111,14 +111,11 @@ class Trainer:
                     f"{tcfg.batch_size} over {jax.process_count()} "
                     f"processes) is not divisible by "
                     f"data.samples_per_instance={spi}")
+            # Instance-grouped sampling (samples_per_instance > 1) is
+            # implemented by all three backends: in-process iterator,
+            # Grain (grouped transform + flatten), and the native loader
+            # (grouped claims in C++) — no fallback needed.
             backend = config.data.loader if use_grain else "python"
-            if spi > 1 and backend != "python":
-                # Instance-grouped sampling (reference data_loader.py:183-195)
-                # is implemented by the in-process iterator only; the Grain
-                # and native loaders batch per-record.
-                print(f"note: data.samples_per_instance={spi} uses the "
-                      f"in-process loader (requested {backend!r})")
-                backend = "python"
             if backend == "native":
                 from novel_view_synthesis_3d_tpu.data import native_io
                 if native_io.available():

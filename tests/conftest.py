@@ -70,3 +70,18 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+def instance_of_image(ds, img, atol=1e-4):
+    """Identify which instance an image belongs to by view matching.
+
+    Shared by the loader instance-grouping tests (test_data.py,
+    test_native_io.py)."""
+    import numpy as np
+
+    for i, inst in enumerate(ds.instances):
+        views = np.stack([inst.view(v)[0] for v in range(len(inst))])
+        if (np.abs(views - img[None]).reshape(len(views), -1).max(axis=1)
+                < atol).any():
+            return i
+    raise AssertionError("image matches no instance view")
