@@ -295,6 +295,12 @@ def cmd_eval(args, overrides: List[str]) -> int:
                 print(f"note: rounding eval batch {args.batch_size} -> "
                       f"{batch_size} (multiple of data axis {shards})")
 
+    fid_feature_fn = None
+    if args.inception_npz:
+        from novel_view_synthesis_3d_tpu.eval.inception import (
+            load_inception_features)
+        fid_feature_fn = load_inception_features(args.inception_npz)
+
     result = evaluate_dataset(
         cfg, model, params, ds,
         key=jax.random.PRNGKey(args.seed),
@@ -303,7 +309,8 @@ def cmd_eval(args, overrides: List[str]) -> int:
         cond_view=args.cond_view,
         sample_steps=args.sample_steps,
         batch_size=batch_size,
-        compute_fid=args.fid,
+        compute_fid=args.fid or fid_feature_fn is not None,
+        fid_feature_fn=fid_feature_fn,
         protocol=args.protocol,
         mesh=mesh,
     )
@@ -471,6 +478,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "'fid_random' (deterministic random-conv features, "
                         "NOT comparable to published Inception-FID; see "
                         "eval/metrics.py)")
+    p.add_argument("--inception-npz", default=None,
+                   help="InceptionV3 weights (.npz from "
+                        "tools/convert_inception.py): compute the Fréchet "
+                        "distance over pool3 features and report it as the "
+                        "paper-comparable 'fid' (implies --fid)")
 
     p = sub.add_parser("prep", help="offline dataset preparation")
     prep_sub = p.add_subparsers(dest="prep_command", required=True)

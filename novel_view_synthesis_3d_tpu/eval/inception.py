@@ -1,0 +1,338 @@
+"""InceptionV3 feature extractor for paper-comparable FID (pure JAX).
+
+The 3DiM SRN-cars protocol (SURVEY.md §6) reports Fréchet distances over
+InceptionV3 pool3 features (2048-d). This module implements the graph used
+by the standard `pytorch-fid` package — torchvision's InceptionV3 with the
+three FID-specific quirks of the original TF-slim export:
+
+  * every in-block 3×3 stride-1 average pool uses count_include_pad=False;
+  * Mixed_7c's pooling branch uses a MAX pool (FIDInceptionE_2);
+  * inputs are bilinearly resized to 299×299 (half-pixel centers,
+    align_corners=False) and normalized to [-1, 1].
+
+Weights are NOT bundled (this environment has no network egress and no
+cached checkpoint): `load_inception_features(npz)` builds the feature_fn
+from an .npz produced by `tools/convert_inception.py` (which reads the
+public `pt_inception-2015-12-05` state_dict with torch and re-keys
+nothing — the npz uses the state_dict key names verbatim). Until a user
+supplies weights, eval falls back to the honestly-labeled random-conv
+Fréchet metric (eval/metrics.py "fid_random").
+
+The reference has no quality evaluation at all (its sampling.py only
+displays images; SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BN_EPS = 0.001
+FEATURE_DIM = 2048
+
+# ---------------------------------------------------------------------------
+# Declarative conv table: name -> (cin, cout, (kh, kw), (sh, sw), (ph, pw)).
+# Names are the pytorch-fid/torchvision module paths; the npz holds
+# "<name>.conv.weight" (O,I,H,W) and "<name>.bn.{weight,bias,running_mean,
+# running_var}" per entry.
+# ---------------------------------------------------------------------------
+
+
+def _block_a(prefix: str, cin: int, pool: int) -> Dict[str, tuple]:
+    return {
+        f"{prefix}.branch1x1": (cin, 64, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch5x5_1": (cin, 48, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch5x5_2": (48, 64, (5, 5), (1, 1), (2, 2)),
+        f"{prefix}.branch3x3dbl_1": (cin, 64, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch3x3dbl_2": (64, 96, (3, 3), (1, 1), (1, 1)),
+        f"{prefix}.branch3x3dbl_3": (96, 96, (3, 3), (1, 1), (1, 1)),
+        f"{prefix}.branch_pool": (cin, pool, (1, 1), (1, 1), (0, 0)),
+    }
+
+
+def _block_b(prefix: str, cin: int) -> Dict[str, tuple]:
+    return {
+        f"{prefix}.branch3x3": (cin, 384, (3, 3), (2, 2), (0, 0)),
+        f"{prefix}.branch3x3dbl_1": (cin, 64, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch3x3dbl_2": (64, 96, (3, 3), (1, 1), (1, 1)),
+        f"{prefix}.branch3x3dbl_3": (96, 96, (3, 3), (2, 2), (0, 0)),
+    }
+
+
+def _block_c(prefix: str, cin: int, c7: int) -> Dict[str, tuple]:
+    return {
+        f"{prefix}.branch1x1": (cin, 192, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch7x7_1": (cin, c7, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch7x7_2": (c7, c7, (1, 7), (1, 1), (0, 3)),
+        f"{prefix}.branch7x7_3": (c7, 192, (7, 1), (1, 1), (3, 0)),
+        f"{prefix}.branch7x7dbl_1": (cin, c7, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch7x7dbl_2": (c7, c7, (7, 1), (1, 1), (3, 0)),
+        f"{prefix}.branch7x7dbl_3": (c7, c7, (1, 7), (1, 1), (0, 3)),
+        f"{prefix}.branch7x7dbl_4": (c7, c7, (7, 1), (1, 1), (3, 0)),
+        f"{prefix}.branch7x7dbl_5": (c7, 192, (1, 7), (1, 1), (0, 3)),
+        f"{prefix}.branch_pool": (cin, 192, (1, 1), (1, 1), (0, 0)),
+    }
+
+
+def _block_d(prefix: str, cin: int) -> Dict[str, tuple]:
+    return {
+        f"{prefix}.branch3x3_1": (cin, 192, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch3x3_2": (192, 320, (3, 3), (2, 2), (0, 0)),
+        f"{prefix}.branch7x7x3_1": (cin, 192, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch7x7x3_2": (192, 192, (1, 7), (1, 1), (0, 3)),
+        f"{prefix}.branch7x7x3_3": (192, 192, (7, 1), (1, 1), (3, 0)),
+        f"{prefix}.branch7x7x3_4": (192, 192, (3, 3), (2, 2), (0, 0)),
+    }
+
+
+def _block_e(prefix: str, cin: int) -> Dict[str, tuple]:
+    return {
+        f"{prefix}.branch1x1": (cin, 320, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch3x3_1": (cin, 384, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch3x3_2a": (384, 384, (1, 3), (1, 1), (0, 1)),
+        f"{prefix}.branch3x3_2b": (384, 384, (3, 1), (1, 1), (1, 0)),
+        f"{prefix}.branch3x3dbl_1": (cin, 448, (1, 1), (1, 1), (0, 0)),
+        f"{prefix}.branch3x3dbl_2": (448, 384, (3, 3), (1, 1), (1, 1)),
+        f"{prefix}.branch3x3dbl_3a": (384, 384, (1, 3), (1, 1), (0, 1)),
+        f"{prefix}.branch3x3dbl_3b": (384, 384, (3, 1), (1, 1), (1, 0)),
+        f"{prefix}.branch_pool": (cin, 192, (1, 1), (1, 1), (0, 0)),
+    }
+
+
+def conv_table() -> Dict[str, tuple]:
+    t: Dict[str, tuple] = {
+        "Conv2d_1a_3x3": (3, 32, (3, 3), (2, 2), (0, 0)),
+        "Conv2d_2a_3x3": (32, 32, (3, 3), (1, 1), (0, 0)),
+        "Conv2d_2b_3x3": (32, 64, (3, 3), (1, 1), (1, 1)),
+        "Conv2d_3b_1x1": (64, 80, (1, 1), (1, 1), (0, 0)),
+        "Conv2d_4a_3x3": (80, 192, (3, 3), (1, 1), (0, 0)),
+    }
+    t.update(_block_a("Mixed_5b", 192, 32))
+    t.update(_block_a("Mixed_5c", 256, 64))
+    t.update(_block_a("Mixed_5d", 288, 64))
+    t.update(_block_b("Mixed_6a", 288))
+    t.update(_block_c("Mixed_6b", 768, 128))
+    t.update(_block_c("Mixed_6c", 768, 160))
+    t.update(_block_c("Mixed_6d", 768, 160))
+    t.update(_block_c("Mixed_6e", 768, 192))
+    t.update(_block_d("Mixed_7a", 768))
+    t.update(_block_e("Mixed_7b", 1280))
+    t.update(_block_e("Mixed_7c", 2048))
+    return t
+
+
+def expected_param_shapes() -> Dict[str, Tuple[int, ...]]:
+    """state_dict key -> shape for every tensor the npz must carry.
+
+    Conv weights use the torch (O, I, H, W) layout — the loader does the
+    HWIO transpose — so a converter can dump the state_dict unmodified.
+    """
+    shapes: Dict[str, Tuple[int, ...]] = {}
+    for name, (cin, cout, (kh, kw), _, _) in conv_table().items():
+        shapes[f"{name}.conv.weight"] = (cout, cin, kh, kw)
+        for p in ("weight", "bias", "running_mean", "running_var"):
+            shapes[f"{name}.bn.{p}"] = (cout,)
+    return shapes
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _avg_pool_3x3_nopad(x: jnp.ndarray) -> jnp.ndarray:
+    """3×3 stride-1 SAME average pool with count_include_pad=False —
+    the FID quirk: border windows divide by the number of VALID taps."""
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 3, 3, 1), (1, 1, 1, 1), "SAME")
+    counts = jax.lax.reduce_window(
+        jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None], 0.0, jax.lax.add,
+        (1, 3, 3, 1), (1, 1, 1, 1), "SAME")
+    return summed / counts
+
+
+def _max_pool(x: jnp.ndarray, window: int, stride: int,
+              padding: str = "VALID") -> jnp.ndarray:
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, window, window, 1),
+        (1, stride, stride, 1), padding)
+
+
+def _make_cbr(params: dict, table: Dict[str, tuple]):
+    """conv+bn+relu by table name; BN folded into scale/shift at load."""
+
+    def cbr(name: str, x: jnp.ndarray) -> jnp.ndarray:
+        _, _, _, stride, (ph, pw) = table[name]
+        w, scale, shift = params[name]
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jax.nn.relu(y * scale + shift)
+
+    return cbr
+
+
+def _forward(params: dict, images: jnp.ndarray) -> jnp.ndarray:
+    """images (B, H, W, 3) in [-1, 1] -> pool3 features (B, 2048)."""
+    table = conv_table()
+    cbr = _make_cbr(params, table)
+    x = jax.image.resize(
+        jnp.asarray(images, jnp.float32),
+        (images.shape[0], 299, 299, images.shape[-1]), "bilinear")
+
+    x = cbr("Conv2d_1a_3x3", x)
+    x = cbr("Conv2d_2a_3x3", x)
+    x = cbr("Conv2d_2b_3x3", x)
+    x = _max_pool(x, 3, 2)
+    x = cbr("Conv2d_3b_1x1", x)
+    x = cbr("Conv2d_4a_3x3", x)
+    x = _max_pool(x, 3, 2)
+
+    def block_a(p, x):
+        b1 = cbr(f"{p}.branch1x1", x)
+        b5 = cbr(f"{p}.branch5x5_2", cbr(f"{p}.branch5x5_1", x))
+        b3 = cbr(f"{p}.branch3x3dbl_3",
+                 cbr(f"{p}.branch3x3dbl_2", cbr(f"{p}.branch3x3dbl_1", x)))
+        bp = cbr(f"{p}.branch_pool", _avg_pool_3x3_nopad(x))
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+    def block_b(p, x):
+        b3 = cbr(f"{p}.branch3x3", x)
+        bd = cbr(f"{p}.branch3x3dbl_3",
+                 cbr(f"{p}.branch3x3dbl_2", cbr(f"{p}.branch3x3dbl_1", x)))
+        return jnp.concatenate([b3, bd, _max_pool(x, 3, 2)], axis=-1)
+
+    def block_c(p, x):
+        b1 = cbr(f"{p}.branch1x1", x)
+        b7 = cbr(f"{p}.branch7x7_3",
+                 cbr(f"{p}.branch7x7_2", cbr(f"{p}.branch7x7_1", x)))
+        bd = x
+        for i in range(1, 6):
+            bd = cbr(f"{p}.branch7x7dbl_{i}", bd)
+        bp = cbr(f"{p}.branch_pool", _avg_pool_3x3_nopad(x))
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+    def block_d(p, x):
+        b3 = cbr(f"{p}.branch3x3_2", cbr(f"{p}.branch3x3_1", x))
+        b7 = x
+        for i in range(1, 5):
+            b7 = cbr(f"{p}.branch7x7x3_{i}", b7)
+        return jnp.concatenate([b3, b7, _max_pool(x, 3, 2)], axis=-1)
+
+    def block_e(p, x, pool_max: bool):
+        b1 = cbr(f"{p}.branch1x1", x)
+        b3 = cbr(f"{p}.branch3x3_1", x)
+        b3 = jnp.concatenate([cbr(f"{p}.branch3x3_2a", b3),
+                              cbr(f"{p}.branch3x3_2b", b3)], axis=-1)
+        bd = cbr(f"{p}.branch3x3dbl_2", cbr(f"{p}.branch3x3dbl_1", x))
+        bd = jnp.concatenate([cbr(f"{p}.branch3x3dbl_3a", bd),
+                              cbr(f"{p}.branch3x3dbl_3b", bd)], axis=-1)
+        pooled = (_max_pool(x, 3, 1, "SAME") if pool_max
+                  else _avg_pool_3x3_nopad(x))
+        bp = cbr(f"{p}.branch_pool", pooled)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+    x = block_a("Mixed_5b", x)
+    x = block_a("Mixed_5c", x)
+    x = block_a("Mixed_5d", x)
+    x = block_b("Mixed_6a", x)
+    x = block_c("Mixed_6b", x)
+    x = block_c("Mixed_6c", x)
+    x = block_c("Mixed_6d", x)
+    x = block_e("Mixed_7b", block_d("Mixed_7a", block_c("Mixed_6e", x)),
+                pool_max=False)
+    # FIDInceptionE_2: the TF-slim export's LAST block pools with MAX.
+    x = block_e("Mixed_7c", x, pool_max=True)
+    return jnp.mean(x, axis=(1, 2))  # global pool3 -> (B, 2048)
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def _fold_params(raw: Dict[str, np.ndarray]) -> dict:
+    """Validate against expected_param_shapes and fold BN into per-channel
+    scale/shift: y = conv(x)·scale + shift with
+    scale = γ/√(σ²+ε), shift = β − μ·scale."""
+    expected = expected_param_shapes()
+    missing = sorted(set(expected) - set(raw))
+    if missing:
+        raise ValueError(
+            f"inception weights missing {len(missing)} tensors "
+            f"(first: {missing[:3]}); expected the pytorch-fid "
+            "state_dict key set — regenerate with tools/convert_inception.py")
+    params = {}
+    for name, (cin, cout, (kh, kw), _, _) in conv_table().items():
+        w = np.asarray(raw[f"{name}.conv.weight"], np.float32)
+        if w.shape != (cout, cin, kh, kw):
+            raise ValueError(
+                f"{name}.conv.weight has shape {w.shape}, expected "
+                f"{(cout, cin, kh, kw)}")
+        gamma = np.asarray(raw[f"{name}.bn.weight"], np.float32)
+        beta = np.asarray(raw[f"{name}.bn.bias"], np.float32)
+        mean = np.asarray(raw[f"{name}.bn.running_mean"], np.float32)
+        var = np.asarray(raw[f"{name}.bn.running_var"], np.float32)
+        for arr, p in ((gamma, "bn.weight"), (beta, "bn.bias"),
+                       (mean, "bn.running_mean"), (var, "bn.running_var")):
+            if arr.shape != (cout,):
+                raise ValueError(
+                    f"{name}.{p} has shape {arr.shape}, expected {(cout,)}")
+        scale = gamma / np.sqrt(var + BN_EPS)
+        shift = beta - mean * scale
+        params[name] = (jnp.asarray(w.transpose(2, 3, 1, 0)),  # OIHW->HWIO
+                        jnp.asarray(scale), jnp.asarray(shift))
+    return params
+
+
+def make_feature_fn(raw: Dict[str, np.ndarray], batch_size: int = 32):
+    """feature_fn for eval/metrics.fid from a raw state_dict-keyed dict.
+
+    Chunks of `batch_size` are PADDED to a fixed shape so the 94-conv
+    299×299 graph compiles exactly once, no matter what slice sizes the
+    caller (e.g. fid()'s embed loop) hands in — per-tail-shape recompiles
+    of this graph cost far more than the padded rows."""
+    params = _fold_params(raw)
+
+    @jax.jit
+    def features(images: jnp.ndarray) -> jnp.ndarray:
+        return _forward(params, images)
+
+    def feature_fn(images):
+        imgs = np.asarray(images)
+        out = []
+        for start in range(0, imgs.shape[0], batch_size):
+            chunk = imgs[start:start + batch_size]
+            n = chunk.shape[0]
+            if n < batch_size:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((batch_size - n,) + chunk.shape[1:],
+                                     chunk.dtype)])
+            out.append(np.asarray(jax.device_get(
+                features(jnp.asarray(chunk))))[:n])
+        return jnp.asarray(np.concatenate(out))
+
+    return feature_fn
+
+
+def load_inception_features(npz_path: str, batch_size: int = 32):
+    """feature_fn from an .npz written by tools/convert_inception.py.
+
+    Pass the result as `fid_feature_fn` to eval/evaluate.evaluate_dataset
+    (or --inception-npz on the eval CLI): the Fréchet metric is then
+    reported under the paper-comparable "fid" label instead of
+    "fid_random".
+    """
+    if not os.path.exists(npz_path):
+        raise FileNotFoundError(
+            f"inception weights not found: {npz_path!r} (generate with "
+            "tools/convert_inception.py from the public "
+            "pt_inception-2015-12-05 checkpoint)")
+    with np.load(npz_path) as z:
+        raw = {k: z[k] for k in z.files}
+    return make_feature_fn(raw, batch_size=batch_size)

@@ -89,3 +89,150 @@ def test_fid_orders_corruption_levels():
     d_heavy = fid(base, heavy, feature_fn=feature_fn)
     assert np.isfinite(d_mild) and np.isfinite(d_heavy)
     assert d_heavy > d_mild
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 feature extractor (eval/inception.py)
+# ---------------------------------------------------------------------------
+class TestInception:
+    def test_expected_param_shapes_complete(self):
+        from novel_view_synthesis_3d_tpu.eval import inception
+
+        table = inception.conv_table()
+        assert len(table) == 94  # 5 stem + 21 A + 4 B + 40 C + 6 D + 18 E
+        shapes = inception.expected_param_shapes()
+        assert len(shapes) == 94 * 5
+        # Spot-check torchvision channel arithmetic at the block seams.
+        assert table["Mixed_5b.branch1x1"][0] == 192
+        assert table["Mixed_5c.branch1x1"][0] == 256
+        assert table["Mixed_6a.branch3x3"][0] == 288
+        assert table["Mixed_6b.branch1x1"][0] == 768
+        assert table["Mixed_7a.branch3x3_1"][0] == 768
+        assert table["Mixed_7b.branch1x1"][0] == 1280
+        assert table["Mixed_7c.branch1x1"][0] == 2048
+
+    @staticmethod
+    def _random_raw(seed=0, scale=0.05):
+        from novel_view_synthesis_3d_tpu.eval import inception
+
+        rng = np.random.default_rng(seed)
+        raw = {}
+        for key, shape in inception.expected_param_shapes().items():
+            if key.endswith("running_var"):
+                raw[key] = rng.uniform(0.5, 1.5, shape).astype(np.float32)
+            elif key.endswith("bn.weight"):
+                raw[key] = rng.uniform(0.5, 1.5, shape).astype(np.float32)
+            else:
+                raw[key] = (scale * rng.standard_normal(shape)
+                            ).astype(np.float32)
+        return raw
+
+    @pytest.mark.slow
+    def test_forward_shapes_finite(self):
+        from novel_view_synthesis_3d_tpu.eval import inception
+
+        fn = inception.make_feature_fn(self._random_raw(), batch_size=2)
+        imgs = np.random.default_rng(1).uniform(
+            -1, 1, (3, 32, 32, 3)).astype(np.float32)
+        feats = np.asarray(fn(imgs))
+        assert feats.shape == (3, inception.FEATURE_DIM)
+        assert np.isfinite(feats).all()
+        # Features distinguish inputs (no collapsed graph).
+        assert not np.allclose(feats[0], feats[1])
+
+    def test_loader_rejects_missing_and_misshaped(self, tmp_path):
+        from novel_view_synthesis_3d_tpu.eval import inception
+
+        raw = self._random_raw()
+        bad = dict(raw)
+        del bad["Mixed_7c.branch_pool.conv.weight"]
+        with pytest.raises(ValueError, match="missing"):
+            inception.make_feature_fn(bad)
+        bad = dict(raw)
+        bad["Conv2d_1a_3x3.conv.weight"] = np.zeros((32, 3, 5, 5),
+                                                    np.float32)
+        with pytest.raises(ValueError, match="shape"):
+            inception.make_feature_fn(bad)
+        with pytest.raises(FileNotFoundError):
+            inception.load_inception_features(str(tmp_path / "nope.npz"))
+
+    def test_npz_roundtrip(self, tmp_path):
+        from novel_view_synthesis_3d_tpu.eval import inception
+
+        raw = self._random_raw()
+        path = str(tmp_path / "w.npz")
+        np.savez_compressed(path, **raw)
+        fn = inception.load_inception_features(path, batch_size=2)
+        assert callable(fn)
+
+    def test_conv_bn_relu_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        from novel_view_synthesis_3d_tpu.eval import inception
+
+        rng = np.random.default_rng(3)
+        cin, cout, kh, kw, ph, pw = 5, 7, 1, 7, 0, 3
+        raw = {
+            "m.conv.weight": rng.standard_normal(
+                (cout, cin, kh, kw)).astype(np.float32),
+            "m.bn.weight": rng.uniform(0.5, 1.5, cout).astype(np.float32),
+            "m.bn.bias": rng.standard_normal(cout).astype(np.float32),
+            "m.bn.running_mean": rng.standard_normal(cout).astype(
+                np.float32),
+            "m.bn.running_var": rng.uniform(0.5, 1.5, cout).astype(
+                np.float32),
+        }
+        x = rng.standard_normal((2, 9, 9, cin)).astype(np.float32)
+
+        # torch reference: conv (no bias) + eval-mode BN + relu, NCHW.
+        tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+        ty = torch.nn.functional.conv2d(
+            tx, torch.from_numpy(raw["m.conv.weight"]), stride=1,
+            padding=(ph, pw))
+        ty = torch.nn.functional.batch_norm(
+            ty, torch.from_numpy(raw["m.bn.running_mean"]),
+            torch.from_numpy(raw["m.bn.running_var"]),
+            torch.from_numpy(raw["m.bn.weight"]),
+            torch.from_numpy(raw["m.bn.bias"]), training=False,
+            eps=inception.BN_EPS)
+        expected = torch.relu(ty).numpy().transpose(0, 2, 3, 1)
+
+        # this module's folded path
+        w = raw["m.conv.weight"]
+        scale = raw["m.bn.weight"] / np.sqrt(
+            raw["m.bn.running_var"] + inception.BN_EPS)
+        shift = raw["m.bn.bias"] - raw["m.bn.running_mean"] * scale
+        import jax
+        import jax.numpy as jnp
+        y = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w.transpose(2, 3, 1, 0)),
+            window_strides=(1, 1), padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        got = np.asarray(jax.nn.relu(y * scale + shift))
+        np.testing.assert_allclose(got, expected, atol=2e-5)
+
+    def test_avg_pool_nopad_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        from novel_view_synthesis_3d_tpu.eval.inception import (
+            _avg_pool_3x3_nopad)
+
+        x = np.random.default_rng(4).standard_normal(
+            (2, 7, 7, 3)).astype(np.float32)
+        expected = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)), 3, stride=1,
+            padding=1, count_include_pad=False
+        ).numpy().transpose(0, 2, 3, 1)
+        got = np.asarray(_avg_pool_3x3_nopad(x))
+        np.testing.assert_allclose(got, expected, atol=1e-6)
+
+    def test_resize_matches_torch_bilinear(self):
+        torch = pytest.importorskip("torch")
+        import jax
+
+        x = np.random.default_rng(5).standard_normal(
+            (1, 16, 16, 3)).astype(np.float32)
+        expected = torch.nn.functional.interpolate(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)), size=(299, 299),
+            mode="bilinear", align_corners=False
+        ).numpy().transpose(0, 2, 3, 1)
+        got = np.asarray(jax.image.resize(x, (1, 299, 299, 3), "bilinear"))
+        np.testing.assert_allclose(got, expected, atol=1e-4)

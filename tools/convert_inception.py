@@ -1,0 +1,66 @@
+"""Convert the public pytorch-fid InceptionV3 checkpoint to .npz.
+
+Usage: python tools/convert_inception.py pt_inception-2015-12-05.pth out.npz
+
+One-time, offline-friendly conversion: reads the torch state_dict (torch is
+only needed HERE, never by the JAX feature extractor), drops the
+classifier/aux tensors, validates every remaining tensor against
+eval/inception.expected_param_shapes(), and writes a plain .npz with the
+state_dict key names verbatim. The eval CLI then takes it via
+--inception-npz and reports paper-comparable "fid" instead of
+"fid_random".
+
+The checkpoint is the standard FID one (TF-slim inception export,
+distributed by the pytorch-fid project as pt_inception-2015-12-05). This
+container has no network egress, so fetching it is up to the user.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import init_jax_env  # noqa: E402
+
+
+def convert(pth_path: str, npz_path: str) -> int:
+    from novel_view_synthesis_3d_tpu.eval.inception import (
+        expected_param_shapes)
+
+    import torch
+
+    state = torch.load(pth_path, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    expected = expected_param_shapes()
+    out = {}
+    for key, shape in expected.items():
+        if key not in state:
+            print(f"error: checkpoint missing {key!r}", file=sys.stderr)
+            return 1
+        arr = state[key].detach().cpu().numpy()
+        if tuple(arr.shape) != shape:
+            print(f"error: {key} has shape {tuple(arr.shape)}, "
+                  f"expected {shape}", file=sys.stderr)
+            return 1
+        out[key] = arr.astype(np.float32)
+    dropped = sorted(k for k in state
+                     if k not in expected and "num_batches_tracked" not in k)
+    if dropped:
+        print(f"dropped {len(dropped)} non-feature tensors "
+              f"(fc/aux): first {dropped[:3]}")
+    np.savez_compressed(npz_path, **out)
+    print(f"wrote {len(out)} tensors to {npz_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    init_jax_env()
+    if len(sys.argv) != 3:
+        print(__doc__)
+        sys.exit(2)
+    sys.exit(convert(sys.argv[1], sys.argv[2]))
