@@ -181,9 +181,13 @@ def _forward(params: dict, images: jnp.ndarray) -> jnp.ndarray:
     """images (B, H, W, 3) in [-1, 1] -> pool3 features (B, 2048)."""
     table = conv_table()
     cbr = _make_cbr(params, table)
+    # antialias=False: pytorch-fid's F.interpolate applies no antialias
+    # filter, and jax.image.resize defaults to antialias=True — which
+    # silently diverges on DOWNsampling (inputs larger than 299px).
     x = jax.image.resize(
         jnp.asarray(images, jnp.float32),
-        (images.shape[0], 299, 299, images.shape[-1]), "bilinear")
+        (images.shape[0], 299, 299, images.shape[-1]), "bilinear",
+        antialias=False)
 
     x = cbr("Conv2d_1a_3x3", x)
     x = cbr("Conv2d_2a_3x3", x)

@@ -1,0 +1,69 @@
+"""Round-4 TPU watcher, phase B: reordered by VERDICT-r3 value.
+
+Same OUT dir as tools/tpu_bench_watch_r4.py, so completed entries (their
+{name}.json exists) are skipped and failed ones retry. Reordering
+rationale, given a live-but-mortal tunnel:
+  1. paper256 analyze+train retry FIRST — the r4a attempt measured the
+     OOM (17.94G/15.75G) that motivated train.ema_host; this validates
+     the fix on hardware (VERDICT item 5);
+  2. the 20k-step 64px quality run next (VERDICT item 2 — the
+     framework's entire purpose; nothing else in the matrix is worth
+     more if the tunnel dies early);
+  3. then the Pallas A/B grid (item 4), the base128 sampler retry, the
+     k=2/k=1 quality pair (item 8), and the long-tail extras.
+
+Usage: python tools/tpu_bench_watch_r4b.py [max_wait_hours]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "results", "tpu_r04")
+sys.path.insert(0, REPO)
+from bench import CACHE_DIR as CACHE  # noqa: E402
+from _common import run_watcher  # noqa: E402
+
+Q = os.path.join("results", "quality_tpu_r04")
+
+MATRIX = [
+    # Done in phase A (skipped via .json): tiny64_train, sample_tiny64_256.
+    ("analyze_paper256", ["bench.py", "analyze", "paper256"], 3600),
+    ("paper256_train", ["bench.py", "paper256", "10"], 5400),
+    ("quality_tpu_64px", ["tools/quality_run.py", Q, "20000", "64"], 14400),
+    ("base128_train", ["bench.py", "base128", "20"], 2400),
+    ("tiny64_noflash", ["bench.py", "tiny64", "30",
+                        "model.use_flash_attention=False"], 1800),
+    ("tiny64_fusedgn", ["bench.py", "tiny64", "30",
+                        "model.use_fused_groupnorm=True"], 1800),
+    ("base128_noflash", ["bench.py", "base128", "20",
+                         "model.use_flash_attention=False"], 2400),
+    ("base128_fusedgn", ["bench.py", "base128", "20",
+                         "model.use_fused_groupnorm=True"], 2400),
+    ("sample_base128_256", ["bench.py", "sample", "base128", "256"], 2400),
+    ("base128_bs16", ["bench.py", "base128", "20",
+                      "train.batch_size=16"], 2400),
+    ("sample_dpmpp32_tiny64", ["bench.py", "sample", "tiny64", "32",
+                               "diffusion.sampler=dpm++"], 1800),
+    ("sample_ar_tiny64", ["bench.py", "sample-ar", "tiny64", "8"], 2400),
+    ("sampler_comparison_quality64",
+     ["tools/sampler_comparison.py", os.path.join(Q, "work", "val"),
+      os.path.join(Q, "sampler_comparison.json"),
+      "--config", os.path.join(Q, "work", "config.json"),
+      "--num-instances", "6", "--views-per-instance", "2"], 3600),
+    ("quality_tpu_k2", ["tools/quality_run.py",
+                        os.path.join("results", "quality_tpu_r04_k2"),
+                        "8000", "64", "model.num_cond_frames=2"], 10800),
+    ("quality_tpu_k1_matched", ["tools/quality_run.py",
+                                os.path.join("results",
+                                             "quality_tpu_r04_k1m"),
+                                "8000", "64"], 10800),
+    ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
+]
+
+
+if __name__ == "__main__":
+    max_wait_h = float(sys.argv[1]) if len(sys.argv) > 1 else 9.0
+    run_watcher(OUT, MATRIX, max_wait_h, CACHE)
