@@ -168,9 +168,14 @@ def evaluate_dataset(
 ) -> EvalResult:
     """Sample novel views for held-out (cond, target) pairs and score them.
 
-    For each of the first `num_instances` instances: condition on view
-    `cond_view`, synthesize `views_per_instance` other views at their
-    ground-truth poses, and score PSNR/SSIM against the real images.
+    For each of the first `num_instances` instances: condition on
+    k = config.model.num_cond_frames CONSECUTIVE views starting at
+    `cond_view` (k=1 is the reference's single-view protocol), synthesize
+    `views_per_instance` of the remaining views at their ground-truth
+    poses, and score PSNR/SSIM against the real images. The k cond views
+    are excluded from the target pool, so an instance with V views yields
+    at most V−k targets. Under protocol="autoregressive" all k views seed
+    the stochastic-conditioning pool.
 
     `protocol`: "single" scores every target independently conditioned on
     the fixed view; "autoregressive" runs the 3DiM stochastic-conditioning
@@ -216,16 +221,29 @@ def evaluate_dataset(
     n_inst = (dataset.num_instances if num_instances is None
               else min(num_instances, dataset.num_instances))
 
-    # Assemble (cond view, target views) per instance host-side.
-    instances = []  # (cond_img, cond_pose, K, [(target_img, target_pose)])
+    # Assemble (cond views, target views) per instance host-side. A k>1
+    # model (model.num_cond_frames) is conditioned on k CONSECUTIVE views
+    # starting at cond_view — the 3DiM multi-view conditioning the model
+    # was trained with; k=1 keeps the reference's single-view protocol
+    # (and the frame-axis-free record layout).
+    k = config.model.num_cond_frames
+    instances = []  # (x, R1, t1, K, [(target_img, target_pose)])
     for i in range(n_inst):
         inst = dataset.instances[i]
-        x, pose1 = inst.view(cond_view % len(inst))
-        others = [v for v in range(len(inst)) if v != cond_view % len(inst)]
+        cond_idx = [(cond_view + j) % len(inst) for j in range(k)]
+        views = [inst.view(v) for v in cond_idx]
+        if k == 1:
+            x, pose1 = views[0]
+            R1, t1 = pose1[:3, :3], pose1[:3, 3]
+        else:
+            x = np.stack([v[0] for v in views])
+            R1 = np.stack([v[1][:3, :3] for v in views])
+            t1 = np.stack([v[1][:3, 3] for v in views])
+        others = [v for v in range(len(inst)) if v not in cond_idx]
         targets = [inst.view(v) for v in others[:views_per_instance]]
         if targets:
-            instances.append((x, pose1, inst.K, targets))
-    truths = [t for (_, _, _, targets) in instances for (t, _) in targets]
+            instances.append((x, R1, t1, inst.K, targets))
+    truths = [t for (_, _, _, _, targets) in instances for (t, _) in targets]
     if not truths:
         raise ValueError("no evaluation pairs (need ≥2 views per instance)")
     if compute_fid and len(truths) < 2:
@@ -245,12 +263,12 @@ def evaluate_dataset(
         probe = instances[:max(2, min(len(instances), batch_size))]
         sens_batch = jax.tree.map(jnp.asarray, {
             "x": np.stack([c[0] for c in probe]),
-            "R1": np.stack([c[1][:3, :3] for c in probe]),
-            "t1": np.stack([c[1][:3, 3] for c in probe]),
-            "R2": np.stack([c[3][0][1][:3, :3] for c in probe]),
-            "t2": np.stack([c[3][0][1][:3, 3] for c in probe]),
-            "K": np.stack([c[2] for c in probe]),
-            "target": np.stack([c[3][0][0] for c in probe]),
+            "R1": np.stack([c[1] for c in probe]),
+            "t1": np.stack([c[2] for c in probe]),
+            "R2": np.stack([c[4][0][1][:3, :3] for c in probe]),
+            "t2": np.stack([c[4][0][1][:3, 3] for c in probe]),
+            "K": np.stack([c[3] for c in probe]),
+            "target": np.stack([c[4][0][0] for c in probe]),
         })
         key, k_sens = jax.random.split(key)
         sens = cond_sensitivity(model, params, sens_batch, key=k_sens)
@@ -273,31 +291,34 @@ def evaluate_dataset(
         # short-tailed instance set falls back to the min target count. The
         # stochastic sampler is built ONCE and the tail chunk padded to
         # batch_size, so one compiled program serves every chunk.
-        n_targets = min(len(t) for (_, _, _, t) in instances)
+        n_targets = min(len(t) for (_, _, _, _, t) in instances)
         if n_targets < views_per_instance:
             print(f"note: autoregressive eval truncated to {n_targets} "
                   f"target views per instance (requested "
                   f"{views_per_instance}; shortest instance bounds all)")
-            truths = [t for (_, _, _, targets) in instances
+            truths = [t for (_, _, _, _, targets) in instances
                       for (t, _) in targets[:n_targets]]
+        # A k>1 model's k conditioning views all seed the stochastic pool
+        # (autoregressive_generate accepts (B, P0, …) pools natively);
+        # k=1 keeps the paper's pool-of-one protocol.
         ar_sampler = make_stochastic_sampler(model, schedule, dcfg,
-                                             max_pool=n_targets + 1)
+                                             max_pool=n_targets + k)
         for start in range(0, len(instances), batch_size):
             chunk = instances[start:start + batch_size]
             n = len(chunk)
             chunk = chunk + [chunk[-1]] * (batch_size - n)
             first_view = {
                 "x": jnp.asarray(np.stack([c[0] for c in chunk])),
-                "R1": jnp.asarray(np.stack([c[1][:3, :3] for c in chunk])),
-                "t1": jnp.asarray(np.stack([c[1][:3, 3] for c in chunk])),
-                "K": jnp.asarray(np.stack([c[2] for c in chunk])),
+                "R1": jnp.asarray(np.stack([c[1] for c in chunk])),
+                "t1": jnp.asarray(np.stack([c[2] for c in chunk])),
+                "K": jnp.asarray(np.stack([c[3] for c in chunk])),
             }
             target_poses = {
                 "R2": jnp.asarray(np.stack(
-                    [[p[:3, :3] for (_, p) in c[3][:n_targets]]
+                    [[p[:3, :3] for (_, p) in c[4][:n_targets]]
                      for c in chunk])),
                 "t2": jnp.asarray(np.stack(
-                    [[p[:3, 3] for (_, p) in c[3][:n_targets]]
+                    [[p[:3, 3] for (_, p) in c[4][:n_targets]]
                      for c in chunk])),
             }
             if mesh is not None:
@@ -307,12 +328,12 @@ def evaluate_dataset(
                 # runs data-parallel across chips.
                 first_view = mesh_lib.shard_batch(mesh, first_view)
                 target_poses = mesh_lib.shard_batch(mesh, target_poses)
-            truth = np.stack([[t for (t, _) in c[3][:n_targets]]
+            truth = np.stack([[t for (t, _) in c[4][:n_targets]]
                               for c in chunk[:n]])  # (n, N, H, W, 3)
             key, k_s = jax.random.split(key)
             imgs = autoregressive_generate(
                 model, schedule, dcfg, params, k_s, first_view, target_poses,
-                max_pool=n_targets + 1, sampler=ar_sampler)
+                max_pool=n_targets + k, sampler=ar_sampler)
             imgs = imgs[:n].reshape((-1,) + imgs.shape[2:])
             score(imgs, truth.reshape((-1,) + truth.shape[2:]))
     else:
@@ -320,10 +341,10 @@ def evaluate_dataset(
         # the tail so one compilation serves all).
         sampler = make_sampler(model, schedule, dcfg)
         conds = []
-        for (x, pose1, K, targets) in instances:
+        for (x, R1, t1, K, targets) in instances:
             for (_, pose2) in targets:
                 conds.append({
-                    "x": x, "R1": pose1[:3, :3], "t1": pose1[:3, 3],
+                    "x": x, "R1": R1, "t1": t1,
                     "R2": pose2[:3, :3], "t2": pose2[:3, 3], "K": K,
                 })
         for start in range(0, len(conds), batch_size):
