@@ -56,9 +56,12 @@ class ModelConfig:
     remat: Any = False
     # Fused Pallas attention kernel (ops/flash_attention.py) instead of the
     # XLA dot_product_attention path. "auto" (default) enables it on TPU
-    # backends only (measured +26-35% train step on v5e at tiny64) and keeps
-    # the XLA path elsewhere; True forces the kernel (interpret mode off-TPU,
-    # slow but exact); False forces the XLA path.
+    # backends only and keeps the XLA path elsewhere; True forces the
+    # kernel (interpret mode off-TPU, slow but exact); False forces the
+    # XLA path. Measured +26-35% train step on v5e at tiny64 in ROUND 2,
+    # BEFORE the r3 backward-path split (_PALLAS_BWD_MIN_HEAD_DIM) — the
+    # r4 bench matrix re-validates with tiny64/base128 flash-off A/Bs
+    # (results/tpu_r04/).
     use_flash_attention: Any = "auto"
     # Fused single-HBM-pass GroupNorm(+swish) Pallas kernel
     # (ops/fused_groupnorm.py) for the per-frame GN chains. False (default)
