@@ -184,32 +184,34 @@ class Trainer:
         # state's ema_params leaves.
         self._host_ema = None
         self._host_ema_step = 0
+        self._host_ema_pending = False  # seed from params at first fold
         ema_host_on = tcfg.ema_host and tcfg.ema_decay > 0
         if ema_host_on:
             # Structure-only template (the restore path just needs matching
-            # tree structure/shapes); filled from the live params below
-            # ONLY when no checkpoint restores over it — a fresh pull here
-            # would be a full param transfer discarded on every resume.
+            # tree structure/shapes). Seeding from the live params is
+            # DEFERRED to the first fold: a pull here would be (a) a full
+            # param transfer discarded on every resume and (b) on pods an
+            # un-barriered replication collective inside __init__, where
+            # per-host init-compile stagger can blow the communicator
+            # rendezvous window — the first fold instead runs at a point
+            # where every host is in lock-step.
             self._host_ema = jax.tree.map(
                 lambda p: np.zeros(p.shape, np.float32), self.state.params)
+            self._host_ema_pending = True
 
         # --- checkpointing / metrics ---
         self.ckpt = CheckpointManager(tcfg.checkpoint_dir)
-        resumed = False
         if tcfg.resume:
             restored = self.ckpt.restore(self._ckpt_state())
             if restored is not None:
-                resumed = True
                 if self._host_ema is not None:
                     self._host_ema = jax.tree.map(
                         np.asarray, restored.ema_params)
+                    self._host_ema_pending = False
                     restored = restored.replace(ema_params=None)
                 self.state = jax.device_put(restored, self._state_sharding)
                 self._host_ema_step = int(jax.device_get(restored.step))
                 print(f"resumed from checkpoint at step {int(self.state.step)}")
-        if ema_host_on and not resumed:
-            self._host_ema = jax.tree.map(
-                lambda a: np.asarray(a, np.float32), self._host_params())
         self.metrics = MetricsLogger(tcfg.results_folder)
         self.results_folder = tcfg.results_folder
         os.makedirs(self.results_folder, exist_ok=True)
@@ -297,6 +299,16 @@ class Trainer:
         ema_host_every steps instead of per step). `force` (checkpoint
         saves, probes) flushes regardless of the interval."""
         if self._host_ema is None:
+            return
+        if self._host_ema_pending:
+            # First touch of a fresh (non-resumed) run: seed EMA = params.
+            # On pods every host reaches here at the same step (the fold
+            # sites are symmetric), so the replicate inside _host_params
+            # rendezvouses in lock-step.
+            self._host_ema = jax.tree.map(
+                lambda a: np.asarray(a, np.float32), self._host_params())
+            self._host_ema_pending = False
+            self._host_ema_step = step_now
             return
         k = step_now - self._host_ema_step
         if k <= 0 or (not force and k < self.config.train.ema_host_every):

@@ -61,8 +61,11 @@ def test_state_has_no_device_ema(srn_root, tmp_path):
     cfg = tiny_config(tmp_path, srn_root, ema_decay=0.5, ema_host=True)
     tr = Trainer(config=cfg)
     assert tr.state.ema_params is None  # no HBM copy
-    assert tr._host_ema is not None
-    # Initialized from the init params.
+    # Seeding is DEFERRED (structure-only template until the first fold):
+    # on pods an __init__-time pull would be an un-barriered collective.
+    assert tr._host_ema is not None and tr._host_ema_pending
+    tr._maybe_update_host_ema(0, force=True)  # first touch seeds = params
+    assert not tr._host_ema_pending
     np.testing.assert_allclose(
         jax.tree.leaves(tr._host_ema)[0],
         np.asarray(jax.tree.leaves(jax.device_get(tr.state.params))[0],
@@ -78,6 +81,7 @@ def test_decay_power_correction(srn_root, tmp_path):
     ones = jax.tree.map(lambda a: np.ones(a.shape, np.float32),
                         tr._host_ema)
     tr._host_ema = jax.tree.map(np.zeros_like, ones)
+    tr._host_ema_pending = False  # inject a known buffer, skip seeding
     tr._host_params = lambda: ones
     # Not due yet (k=2 < every=3): no fold.
     tr._maybe_update_host_ema(2)

@@ -109,10 +109,12 @@ for _ in range(3):
 assert np.isfinite(losses).all(), losses
 # Params must remain identical across processes: compare a checksum via a
 # replicated-mean reduction (any divergence would differ per process).
-host_params = jax.device_get(state.params)
-checksum = float(jax.tree.reduce(
-    lambda a, b: a + b,
-    jax.tree.map(lambda x: float(np.sum(np.abs(x))), host_params)))
+def tree_checksum(tree):
+    return float(jax.tree.reduce(
+        lambda a, b: a + b,
+        jax.tree.map(lambda x: float(np.sum(np.abs(x))), tree)))
+
+checksum = tree_checksum(jax.device_get(state.params))
 print(f"RESULT {pid} losses={losses} checksum={checksum:.6f}", flush=True)
 
 # --- pod-safe in-loop probe (trainer._probe_host_params path) ---
@@ -144,6 +146,24 @@ if pid == 0:
 else:
     assert out_eval is None and path is None
 print(f"PROBE {pid} ok={out_eval}", flush=True)
+
+# --- host-EMA on a pod (trainer._host_params replicate path) ---
+# Every host joins the replication collective inside the EMA fold; the
+# folded host buffer must be IDENTICAL across processes (it ships in the
+# checkpoint, so divergence would corrupt saves).
+ema_cfg = probe_cfg.override(**{
+    "train.ema_decay": 0.5, "train.ema_host": True,
+    "train.ema_host_every": 1,
+    "train.results_folder": tdir + "/ema",
+    "train.checkpoint_dir": tdir + "/ckema",
+})
+barrier()
+tr2 = Trainer(config=ema_cfg, data_iter=itertools.repeat(local))
+assert tr2._host_ema_pending  # __init__ made NO collective (seed deferred)
+barrier()  # init compile stagger ends; the seed pull rendezvouses fresh
+tr2._maybe_update_host_ema(1, force=True)
+assert tr2._host_ema_step == 1 and not tr2._host_ema_pending
+print(f"EMA {pid} checksum={tree_checksum(tr2._host_ema):.8f}", flush=True)
 """
 
 
@@ -184,10 +204,16 @@ def test_two_process_train_step(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
     results = {}
+    emas = {}
     for out in outs:
         line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][0]
         pid = int(line.split()[1])
         results[pid] = line.split(" ", 2)[2]
+        ema = [ln for ln in out.splitlines() if ln.startswith("EMA")][0]
+        emas[int(ema.split()[1])] = ema.split(" ", 2)[2]
     # Both processes computed the same global step: identical losses and
     # identical post-step parameter checksums.
     assert results[0] == results[1], results
+    # Host-EMA fold is process-consistent (FSDP shards -> replicate ->
+    # identical fold on every host).
+    assert emas[0] == emas[1], emas
