@@ -64,6 +64,14 @@ MATRIX = [
                                              "quality_tpu_r04_k1m"),
                                 "8000", "64"], 10800),
     ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
+    # Perf probes, config-only: bf16 sampling compute on the f32-trained
+    # tiny64 shape (params stay f32; casts per use), and the 'dots' remat
+    # point re-measured post-r3/r4 changes (r2 ladder:
+    # results/tpu_r02/base128_remat_*.json).
+    ("sample_tiny64_256_bf16", ["bench.py", "sample", "tiny64", "256",
+                                "model.dtype=bfloat16"], 1800),
+    ("base128_dots", ["bench.py", "base128", "20",
+                      "model.remat=dots"], 2400),
 ]
 
 
