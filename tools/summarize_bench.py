@@ -1,0 +1,79 @@
+"""Summarize a results/tpu_r* directory into one markdown table.
+
+Usage: python tools/summarize_bench.py [results/tpu_r04] [--write out.md]
+
+Reads every {name}.json the watcher persisted (platform-tagged judged-format
+lines), plus quality summaries if present, and prints a compact table —
+the round-results narrative's data section, generated instead of
+hand-copied.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def load_rows(out_dir: str):
+    rows = []
+    for fn in sorted(os.listdir(out_dir)):
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(out_dir, fn)) as fh:
+                d = json.loads(fh.read().strip() or "{}")
+        except (OSError, json.JSONDecodeError):
+            continue
+        if "metric" not in d:
+            continue
+        rows.append((fn[:-5], d))
+    return rows
+
+
+def fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:,.3f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    out_dir = args[0] if args else os.path.join("results", "tpu_r04")
+    lines = [
+        f"# Bench summary — {out_dir}", "",
+        "| entry | metric | value | unit | vs_baseline | platform | mfu |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    rows = load_rows(out_dir)
+    for name, d in rows:
+        lines.append(
+            "| {} | {} | {} | {} | {} | {} | {} |".format(
+                name, d.get("metric", "?"), fmt(d.get("value", "?")),
+                d.get("unit", ""), fmt(d.get("vs_baseline", "")),
+                d.get("platform", "?"),
+                fmt(d.get("mfu", "")) if d.get("mfu") else ""))
+    if not rows:
+        lines.append("| (no artifacts yet) | | | | | | |")
+    # Quality summaries live in sibling dirs; pull their headline if there.
+    for qdir in sorted(d for d in os.listdir("results")
+                       if d.startswith("quality_tpu")):
+        summary = os.path.join("results", qdir, "summary.json")
+        if os.path.exists(summary):
+            with open(summary) as fh:
+                s = json.load(fh)
+            lines.append(
+                "| {} | {} | {} | {} | | {} | |".format(
+                    qdir, s.get("metric"), fmt(s.get("value")),
+                    s.get("unit"), s.get("platform")))
+    text = "\n".join(lines) + "\n"
+    print(text)
+    if "--write" in sys.argv:
+        out = sys.argv[sys.argv.index("--write") + 1]
+        with open(out, "w") as fh:
+            fh.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
