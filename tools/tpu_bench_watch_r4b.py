@@ -32,7 +32,11 @@ MATRIX = [
     # Done in phase A (skipped via .json): tiny64_train, sample_tiny64_256.
     ("analyze_paper256", ["bench.py", "analyze", "paper256"], 3600),
     ("paper256_train", ["bench.py", "paper256", "10"], 5400),
-    ("quality_tpu_64px", ["tools/quality_run.py", Q, "20000", "64"], 14400),
+    # 7200s, not 14400: the run needs ~1-2h on the chip, and the watcher
+    # skips any entry whose TIMEOUT crosses its deadline — an oversized
+    # budget would sacrifice the highest-value entry on a late tunnel
+    # revival.
+    ("quality_tpu_64px", ["tools/quality_run.py", Q, "20000", "64"], 7200),
     ("base128_train", ["bench.py", "base128", "20"], 2400),
     ("tiny64_noflash", ["bench.py", "tiny64", "30",
                         "model.use_flash_attention=False"], 1800),
@@ -58,11 +62,11 @@ MATRIX = [
       "--num-instances", "6", "--views-per-instance", "2"], 3600),
     ("quality_tpu_k2", ["tools/quality_run.py",
                         os.path.join("results", "quality_tpu_r04_k2"),
-                        "8000", "64", "model.num_cond_frames=2"], 10800),
+                        "8000", "64", "model.num_cond_frames=2"], 5400),
     ("quality_tpu_k1_matched", ["tools/quality_run.py",
                                 os.path.join("results",
                                              "quality_tpu_r04_k1m"),
-                                "8000", "64"], 10800),
+                                "8000", "64"], 5400),
     ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
     # Perf probes, config-only: bf16 sampling compute on the f32-trained
     # tiny64 shape (params stay f32; casts per use), and the 'dots' remat
