@@ -313,6 +313,7 @@ def cmd_eval(args, overrides: List[str]) -> int:
         fid_feature_fn=fid_feature_fn,
         protocol=args.protocol,
         mesh=mesh,
+        dump_comparisons=args.dump_comparisons,
     )
     print(json.dumps(dict(result.to_dict(), checkpoint_step=step)))
     if args.out:
@@ -483,6 +484,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "tools/convert_inception.py): compute the Fréchet "
                         "distance over pool3 features and report it as the "
                         "paper-comparable 'fid' (implies --fid)")
+    p.add_argument("--dump-comparisons", default=None, metavar="PNG",
+                   help="write a [conditioning | ground truth | synthesis] "
+                        "row per scored pair (first 8) — the human-legible "
+                        "form of the PSNR table")
 
     p = sub.add_parser("prep", help="offline dataset preparation")
     prep_sub = p.add_subparsers(dest="prep_command", required=True)

@@ -165,6 +165,8 @@ def evaluate_dataset(
     fid_feature_fn=None,
     protocol: str = "single",
     mesh=None,
+    dump_comparisons: Optional[str] = None,
+    max_comparisons: int = 8,
 ) -> EvalResult:
     """Sample novel views for held-out (cond, target) pairs and score them.
 
@@ -191,6 +193,10 @@ def evaluate_dataset(
     axis and params replicated, so the reverse process runs data-parallel
     across chips (batch_size must be a multiple of the data-axis size).
     None = default-device sampling.
+
+    `dump_comparisons`: optional PNG path — writes a
+    [conditioning | ground truth | synthesis] row per scored pair (first
+    `max_comparisons` pairs), the human-legible form of the PSNR table.
     """
     if protocol not in ("single", "autoregressive"):
         raise ValueError(f"unknown eval protocol {protocol!r}")
@@ -274,6 +280,15 @@ def evaluate_dataset(
         sens = cond_sensitivity(model, params, sens_batch, key=k_sens)
 
     all_psnr, all_ssim, all_imgs = [], [], []
+    comparisons = []  # (cond, truth, pred) rows for dump_comparisons
+
+    def add_comparison(cond_img, truth_img, pred_img):
+        if dump_comparisons and len(comparisons) < max_comparisons:
+            cond_img = np.asarray(cond_img)
+            if cond_img.ndim == 4:  # k>1: show the first conditioning view
+                cond_img = cond_img[0]
+            comparisons.append((cond_img, np.asarray(truth_img),
+                                np.asarray(pred_img)))
 
     def score(imgs, truth):
         all_psnr.append(np.asarray(jax.device_get(
@@ -334,6 +349,12 @@ def evaluate_dataset(
             imgs = autoregressive_generate(
                 model, schedule, dcfg, params, k_s, first_view, target_poses,
                 max_pool=n_targets + k, sampler=ar_sampler)
+            if dump_comparisons and len(comparisons) < max_comparisons:
+                per_inst = np.asarray(jax.device_get(imgs[:n]))
+                for j in range(n):
+                    for ti in range(n_targets):
+                        add_comparison(chunk[j][0], truth[j][ti],
+                                       per_inst[j][ti])
             imgs = imgs[:n].reshape((-1,) + imgs.shape[2:])
             score(imgs, truth.reshape((-1,) + truth.shape[2:]))
     else:
@@ -361,7 +382,17 @@ def evaluate_dataset(
                 device_batch = mesh_lib.shard_batch(mesh, device_batch)
             imgs = sampler(params, k_s, device_batch)
             imgs = imgs[:n]
+            if dump_comparisons and len(comparisons) < max_comparisons:
+                preds = np.asarray(jax.device_get(imgs))
+                for j in range(n):
+                    add_comparison(chunk[j]["x"], truth[j], preds[j])
             score(imgs, truth)
+
+    if dump_comparisons and comparisons:
+        from novel_view_synthesis_3d_tpu.utils.images import save_image_grid
+
+        rows = np.stack([im for trip in comparisons for im in trip])
+        save_image_grid(rows, dump_comparisons, cols=3)
 
     per_psnr = np.concatenate(all_psnr)
     per_ssim = np.concatenate(all_ssim)

@@ -441,3 +441,47 @@ def test_export_uses_ema_params(tmp_path):
     assert len(ema_leaves) == len(re_leaves)
     for a, b in zip(ema_leaves, re_leaves):
         np.testing.assert_array_equal(a, b)
+
+
+def test_evaluate_dataset_dump_comparisons(tmp_path):
+    """dump_comparisons writes a [cond | truth | pred] triptych grid —
+    the human-legible form of the PSNR table."""
+    from PIL import Image
+
+    from novel_view_synthesis_3d_tpu.config import (
+        Config, DataConfig, DiffusionConfig, ModelConfig)
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+    from novel_view_synthesis_3d_tpu.eval.evaluate import evaluate_dataset
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    root = str(tmp_path / "srn")
+    write_synthetic_srn(root, num_instances=2, views_per_instance=4,
+                        image_size=16)
+    cfg = Config(
+        model=ModelConfig(ch=32, ch_mult=(1,), emb_ch=32, num_res_blocks=1,
+                          attn_resolutions=(16,), dropout=0.0),
+        diffusion=DiffusionConfig(timesteps=4, sample_timesteps=2),
+        data=DataConfig(root_dir=root, img_sidelength=16))
+    ds = SRNDataset(root, img_sidelength=16)
+    model = XUNet(cfg.model)
+    rec = ds.pair(0, np.random.default_rng(0))
+    mb = {"x": jnp.asarray(rec["x"][None]),
+          "z": jnp.asarray(rec["target"][None]),
+          "logsnr": jnp.zeros((1,)), "R1": jnp.asarray(rec["R1"][None]),
+          "t1": jnp.asarray(rec["t1"][None]),
+          "R2": jnp.asarray(rec["R2"][None]),
+          "t2": jnp.asarray(rec["t2"][None]),
+          "K": jnp.asarray(rec["K"][None])}
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((1,)), train=False)
+
+    png = str(tmp_path / "cmp.png")
+    res = evaluate_dataset(
+        cfg, model, variables["params"], ds, key=jax.random.PRNGKey(2),
+        num_instances=2, views_per_instance=2, sample_steps=2, batch_size=2,
+        dump_comparisons=png, max_comparisons=3)
+    assert res.num_views == 4
+    img = Image.open(png)
+    # cols=3 triptych layout: width = 3 tiles, height = max_comparisons rows
+    assert img.size == (3 * 16, 3 * 16)

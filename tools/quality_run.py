@@ -114,7 +114,9 @@ def main() -> None:
         out_json = os.path.join(out_dir, f"eval_{protocol}.json")
         rc = cli(["eval", val_root, "--out", out_json,
                   "--protocol", protocol, "--views-per-instance", "4",
-                  "--sample-steps", "64", "--batch-size", "6", "--fid"]
+                  "--sample-steps", "64", "--batch-size", "6", "--fid",
+                  "--dump-comparisons",
+                  os.path.join(out_dir, f"comparisons_{protocol}.png")]
                  + overrides)
         if rc != 0:
             raise SystemExit(f"eval ({protocol}) failed with rc={rc}")
