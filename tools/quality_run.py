@@ -56,8 +56,14 @@ def main() -> None:
     work = os.path.join(out_dir, "work")
     shutil.rmtree(work, ignore_errors=True)
     os.makedirs(work, exist_ok=True)
+    # 50 views/instance — SRN-cars trainset density (the real benchmark
+    # renders 50 views per car). The r4 CPU hedge at 24 views showed the
+    # held-out curve pinned near the mean-image floor: with a 1-in-3 split
+    # the pose-interpolation gaps were ~2x the real protocol's. Density is
+    # a property of the DATASET generator, not a metric knob — held-out
+    # views remain fully unseen.
     full = write_raytraced_srn(os.path.join(work, "full"), num_instances=6,
-                               views_per_instance=24, image_size=size,
+                               views_per_instance=50, image_size=size,
                                seed=7)
     # 1-in-3 held-out view split per instance (reference semantics,
     # data_util.py:75-98): train on 2/3 of each scene's views, evaluate on
@@ -137,7 +143,7 @@ def main() -> None:
         "unit": "dB",
         "platform": jax.devices()[0].platform,
         "dataset": "raytraced spheres+plane (data/raytrace.py), "
-                   "6 instances x 24 views, 1-in-3 held-out view split",
+                   "6 instances x 50 views, 1-in-3 held-out view split",
         "img_size": size, "train_steps": steps,
         "eval": results,
     }
