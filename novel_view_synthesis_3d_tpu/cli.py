@@ -335,7 +335,7 @@ def cmd_prep(args, overrides: List[str]) -> int:
     if args.prep_command == "split-object":
         n_train, n_val = prep.train_val_split(
             args.object_dir, args.train_dir, args.val_dir,
-            symlink=args.symlink)
+            symlink=args.symlink, invert=args.invert)
         print(f"{n_train} train / {n_val} val views")
     elif args.prep_command == "shapenet":
         placed = prep.shapenet_train_test_split(
@@ -497,6 +497,10 @@ def make_parser() -> argparse.ArgumentParser:
     q.add_argument("train_dir")
     q.add_argument("val_dir")
     q.add_argument("--symlink", action="store_true")
+    q.add_argument("--invert", action="store_true",
+                   help="train on the 2-in-3 slice, hold out 1-in-3 "
+                        "(default mirrors the reference: train on the "
+                        "sparse third)")
     q = prep_sub.add_parser("shapenet", help="CSV-driven ShapeNet split")
     q.add_argument("shapenet_path")
     q.add_argument("synset_id")

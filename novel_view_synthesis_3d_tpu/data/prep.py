@@ -27,14 +27,20 @@ def _place(src: str, dst: str, symlink: bool) -> None:
 
 
 def train_val_split(object_dir: str, train_dir: str, val_dir: str,
-                    *, symlink: bool = False) -> Tuple[int, int]:
+                    *, symlink: bool = False,
+                    invert: bool = False) -> Tuple[int, int]:
     """Split one SRN object dir into train/val by 1-in-3 round-robin.
 
     Reference semantics (data_util.py:75-98): every item with index % 3 == 0
-    goes to train, the rest to val (1:2 split); outputs are renumbered
-    %06d within each split; intrinsics.txt is copied to both. Handles the
-    pose/rgb/depth subdirs, tolerating a missing depth/ (many SRN dumps omit
-    it). Returns (num_train_views, num_val_views).
+    goes to train, the rest to val (a 1:2 split — the reference TRAINS on
+    the sparse third); outputs are renumbered %06d within each split;
+    intrinsics.txt is copied to both. Handles the pose/rgb/depth subdirs,
+    tolerating a missing depth/ (many SRN dumps omit it). Returns
+    (num_train_views, num_val_views).
+
+    `invert=True` flips the assignment (train on the 2-in-3 slice, hold
+    out 1-in-3) — the conventional dense-train/sparse-holdout protocol the
+    quality runs use; the default stays reference-faithful.
     """
     subdirs = [("pose", "*.txt", ".txt"), ("rgb", "*.png", ".png"),
                ("depth", "*.png", ".png")]
@@ -50,7 +56,7 @@ def train_val_split(object_dir: str, train_dir: str, val_dir: str,
             continue
         train_counter = val_counter = 0
         for i, item in enumerate(items):
-            if i % 3 == 0:
+            if (i % 3 == 0) != invert:
                 dst = os.path.join(train_dir, name,
                                    f"{train_counter:06d}{ending}")
                 train_counter += 1

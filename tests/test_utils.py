@@ -155,6 +155,17 @@ def test_train_val_split(tmp_path):
             assert names[0].startswith("000000")
 
 
+def test_train_val_split_invert(tmp_path):
+    obj = tmp_path / "obj"
+    _make_srn_object(str(obj), n_views=7)
+    n_train, n_val = train_val_split(str(obj), str(tmp_path / "train"),
+                                     str(tmp_path / "val"), invert=True)
+    # Dense-train protocol: the 1-in-3 slice (0,3,6) is HELD OUT instead.
+    assert (n_train, n_val) == (4, 3)
+    # The two assignments partition the views: train(invert) == val(ref).
+    assert len(os.listdir(tmp_path / "train" / "rgb")) == 4
+
+
 def test_shapenet_split(tmp_path):
     shapenet = tmp_path / "shapenet"
     synset = "2958343"
