@@ -7,6 +7,8 @@ import os
 
 import pytest
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 TOOLS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tools")
 
@@ -88,3 +90,40 @@ def test_sampler_comparison_sweep_dedupes_after_clamp(monkeypatch):
         ("ddpm", 8), ("ddim", 8), ("dpm++", 8)]
     # No clamping: the full ladder survives untouched.
     assert sc.clamped_sweep(sc.SWEEP, 1000) == sc.SWEEP
+
+
+def test_pose_generalization_analysis(tmp_path):
+    """PSNR-vs-pose-distance analysis reconstructs eval pair order and
+    writes correlations (discriminative memorizer-vs-synthesis signal)."""
+    import json
+    import subprocess
+    import sys
+
+    from novel_view_synthesis_3d_tpu.config import get_preset
+    from novel_view_synthesis_3d_tpu.data.prep import train_val_split
+    from novel_view_synthesis_3d_tpu.data.raytrace import write_raytraced_srn
+
+    out = tmp_path / "q"
+    work = out / "work"
+    full = write_raytraced_srn(str(work / "full"), num_instances=2,
+                               views_per_instance=6, image_size=16, seed=1)
+    for inst in sorted(os.listdir(full)):
+        train_val_split(os.path.join(full, inst),
+                        str(work / "train" / inst),
+                        str(work / "val" / inst), invert=True)
+    cfg = get_preset("tiny64").apply_cli(["data.img_sidelength=16"])
+    (work / "config.json").write_text(cfg.to_json())
+    # A fake eval result: 2 val views per instance exist (6/3), eval'd 1:1.
+    (out / "eval_single.json").write_text(json.dumps({
+        "per_view_psnr": [11.0, 9.0], "num_views": 2}))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "tools", "pose_generalization.py"),
+         str(out)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.load(open(out / "pose_generalization.json"))
+    assert result["num_views"] == 2
+    assert len(result["rows"]) == 2
+    assert all(r["nearest_train_deg"] >= 0 for r in result["rows"])
