@@ -319,7 +319,14 @@ def cmd_eval(args, overrides: List[str]) -> int:
     if args.out:
         os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
         with open(args.out, "w") as fh:
+            # The eval protocol parameters ride along so downstream
+            # analysis (tools/pose_generalization.py) can reconstruct the
+            # exact (instance, view) pairing of per_view_psnr instead of
+            # guessing it from counts.
             json.dump(dict(result.to_dict(), checkpoint_step=step,
+                           cond_view=args.cond_view,
+                           num_instances=args.num_instances,
+                           views_per_instance=args.views_per_instance,
                            per_view_psnr=result.per_view_psnr.tolist(),
                            per_view_ssim=result.per_view_ssim.tolist()), fh)
     return 0

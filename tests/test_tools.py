@@ -9,8 +9,7 @@ import pytest
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-TOOLS = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "tools")
+TOOLS = os.path.join(REPO_ROOT, "tools")
 
 
 @pytest.fixture()

@@ -74,33 +74,56 @@ def main() -> int:
     train_root = os.path.join(out_dir, "work", "train")
 
     # Reproduce evaluate_dataset's deterministic pair ordering: per
-    # instance, k consecutive cond views from cond_view (eval CLI default
-    # 0), targets = remaining views in index order. views_per_instance is
-    # recovered from the eval's num_views / instance count.
+    # instance, k consecutive cond views from cond_view, targets =
+    # remaining views in index order. Newer eval JSONs carry the protocol
+    # parameters (cli.py eval --out); older ones fall back to counts —
+    # rejected when ambiguous (a partial-instance eval would otherwise
+    # silently misalign every pair).
     k = cfg.model.num_cond_frames
-    n_inst = len(val.instances)
-    vpi = max(1, len(per_psnr) // n_inst)
+    cond_view = ev.get("cond_view", 0)
+    n_inst = ev.get("num_instances") or len(val.instances)
+    n_inst = min(n_inst, len(val.instances))
+    if "views_per_instance" in ev:
+        vpi = ev["views_per_instance"]
+    else:
+        if len(per_psnr) % len(val.instances) != 0:
+            raise SystemExit(
+                "eval JSON predates the protocol-parameter fields and "
+                f"{len(per_psnr)} views do not divide evenly over "
+                f"{len(val.instances)} instances — re-run eval --out with "
+                "the current build")
+        vpi = len(per_psnr) // len(val.instances)
     pairs = []  # (instance, target_view_index)
-    for i, inst in enumerate(val.instances):
-        cond_idx = [j % len(inst) for j in range(k)]
+    for i in range(n_inst):
+        inst = val.instances[i]
+        cond_idx = [(cond_view + j) % len(inst) for j in range(k)]
         others = [v for v in range(len(inst)) if v not in cond_idx]
         for v in others[:vpi]:
             pairs.append((i, v))
     if len(pairs) != len(per_psnr):
         raise SystemExit(
             f"cannot align eval pairs: reconstructed {len(pairs)} vs "
-            f"{len(per_psnr)} per_view_psnr entries — was the eval run "
-            "with non-default --cond-view or truncated instances?")
+            f"{len(per_psnr)} per_view_psnr entries")
+
+    # Train-pose directions once per instance (target-independent).
+    train_dirs_cache = {}
+
+    def train_dirs(inst) -> list:
+        name = os.path.basename(os.path.normpath(inst.instance_dir))
+        if name not in train_dirs_cache:
+            tdir = os.path.join(train_root, name, "pose")
+            train_dirs_cache[name] = [
+                cam_dir(load_pose(os.path.join(tdir, p)))
+                for p in sorted(os.listdir(tdir))]
+        return train_dirs_cache[name]
 
     rows = []
     for (i, v), psnr in zip(pairs, per_psnr):
         inst = val.instances[i]
         target_dir = cam_dir(load_pose(inst.pose_paths[v]))
-        tdir = os.path.join(train_root, os.path.basename(os.path.normpath(inst.instance_dir)),
-                            "pose")
-        dists = [angular_deg(target_dir, cam_dir(load_pose(
-            os.path.join(tdir, p)))) for p in sorted(os.listdir(tdir))]
-        rows.append({"instance": os.path.basename(os.path.normpath(inst.instance_dir)),
+        dists = [angular_deg(target_dir, td) for td in train_dirs(inst)]
+        rows.append({"instance": os.path.basename(
+                         os.path.normpath(inst.instance_dir)),
                      "view": v, "psnr": float(psnr),
                      "nearest_train_deg": float(min(dists))})
 
