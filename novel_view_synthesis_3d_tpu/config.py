@@ -166,7 +166,10 @@ class TrainConfig:
     # 'mse' (per-element mean squared error, the sane default) or 'frobenius'
     # (reference train.py:67: L2 norm of the whole flattened residual).
     loss: str = "mse"
-    # Optimizer
+    # Optimizer: 'adam' (reference train.py:46) or 'adafactor' (factored
+    # second moments + no first moment — optimizer state drops from 2x
+    # param bytes to ~sqrt-sized row/col stats; the fallback that gives
+    # paper256 real HBM margin on a 16G chip, see train/state.make_optimizer)
     optimizer: str = "adam"
     grad_clip: float = 0.0  # 0 = off
     # Adam first-moment (m) storage dtype. 'bfloat16' halves m's HBM
@@ -363,6 +366,11 @@ class Config:
                 f"train.batch_size={t.batch_size} must be a multiple of "
                 f"data.samples_per_instance="
                 f"{self.data.samples_per_instance}")
+        if t.optimizer not in ("adam", "adafactor"):
+            errors.append(
+                f"train.optimizer={t.optimizer!r} must be 'adam' "
+                "(reference, train.py:46) or 'adafactor' (memory-lean: "
+                "factored second moments, no first moment)")
         if t.adam_mu_dtype not in ("float32", "bfloat16"):
             errors.append(
                 f"train.adam_mu_dtype={t.adam_mu_dtype!r} must be "
