@@ -37,6 +37,15 @@ MATRIX = [
     # budget would sacrifice the highest-value entry on a late tunnel
     # revival.
     ("quality_tpu_64px", ["tools/quality_run.py", Q, "20000", "64"], 7200),
+    # paper256 optimizer A/B: adafactor drops optimizer state from 2x to
+    # ~0x param bytes (state.make_optimizer) — memory-margin evidence via
+    # analyze, throughput delta vs Adam via train. Also the fallback that
+    # lands paper256 numbers if the ema_host margin (predicted 15.30G of
+    # 15.75G) loses to allocator fragmentation variance.
+    ("analyze_paper256_adafactor",
+     ["bench.py", "analyze", "paper256", "train.optimizer=adafactor"], 3600),
+    ("paper256_adafactor",
+     ["bench.py", "paper256", "10", "train.optimizer=adafactor"], 5400),
     ("base128_train", ["bench.py", "base128", "20"], 2400),
     ("tiny64_noflash", ["bench.py", "tiny64", "30",
                         "model.use_flash_attention=False"], 1800),
