@@ -199,6 +199,14 @@ class TrainConfig:
     # per-data-shard batch ≤ this value (train/step.effective_accum_steps),
     # so a single-chip tuning stays valid on any mesh. 1 = off.
     grad_accum_steps: int = 1
+    # Fused multi-step dispatch: lax.scan over K staged batches in ONE XLA
+    # program. Each scanned step is the full train step (fresh data, fresh
+    # fold_in(rng, step) keys, optimizer update) — semantics identical to K
+    # single dispatches; what changes is K-1 fewer host dispatch round
+    # trips, which dominate wall clock for small models and remote-device
+    # (tunneled) runtimes. Cadences (log/save/eval/sample_every, num_steps,
+    # profile window) must be multiples of K — validate() enforces.
+    steps_per_dispatch: int = 1
     # ZeRO/FSDP: shard params + optimizer state over the mesh 'data' axis
     # (parallel/mesh.fsdp_spec). The reference replicates everything per
     # device (train.py:46).
@@ -366,6 +374,31 @@ class Config:
                 f"train.batch_size={t.batch_size} must be a multiple of "
                 f"data.samples_per_instance="
                 f"{self.data.samples_per_instance}")
+        spd = t.steps_per_dispatch
+        if spd < 1:
+            errors.append(
+                f"train.steps_per_dispatch={spd} must be >= 1")
+        elif spd > 1:
+            if t.num_steps % spd:
+                errors.append(
+                    f"train.num_steps={t.num_steps} must be a multiple of "
+                    f"train.steps_per_dispatch={spd} (the loop advances "
+                    "K steps per dispatch)")
+            for nm in ("log_every", "save_every", "eval_every",
+                       "sample_every"):
+                v = getattr(t, nm)
+                if v and v % spd:
+                    errors.append(
+                        f"train.{nm}={v} must be a multiple of "
+                        f"train.steps_per_dispatch={spd} — the trainer only "
+                        "observes step counts at dispatch boundaries, so a "
+                        "misaligned cadence would silently never fire")
+            if t.profile_steps and (t.profile_from % spd
+                                    or t.profile_steps % spd):
+                errors.append(
+                    f"train.profile_from={t.profile_from}/profile_steps="
+                    f"{t.profile_steps} must be multiples of "
+                    f"train.steps_per_dispatch={spd}")
         if t.optimizer not in ("adam", "adafactor"):
             errors.append(
                 f"train.optimizer={t.optimizer!r} must be 'adam' "

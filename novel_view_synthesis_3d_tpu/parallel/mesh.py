@@ -120,15 +120,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, batch):
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """(K, B, ...) multi-step batches (train.steps_per_dispatch): the K
+    step axis is replicated (lax.scan consumes it sequentially), B shards
+    over 'data' exactly like a single batch."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def shard_batch(mesh: Mesh, batch, stacked: bool = False):
     """Move a host-side batch pytree onto the mesh, sharded over 'data'.
 
     Single-process: a plain device_put with a NamedSharding. Multi-process:
     each process contributes its LOCAL shard of the global batch via
     `jax.make_array_from_process_local_data` (per-host Grain shards feed
-    this — SURVEY.md §2.3 "TPU-native equivalents").
+    this — SURVEY.md §2.3 "TPU-native equivalents"). `stacked` marks a
+    (K, B, ...) multi-step batch (leading step axis replicated).
     """
-    sharding = batch_sharding(mesh)
+    sharding = stacked_batch_sharding(mesh) if stacked else batch_sharding(mesh)
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
     return jax.tree.map(

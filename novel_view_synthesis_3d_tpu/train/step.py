@@ -233,12 +233,31 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         return new_state, metrics
 
     repl = mesh_lib.replicated(mesh)
-    data = mesh_lib.batch_sharding(mesh)
     if state_sharding is None:
         state_sharding = repl
+    if tcfg.steps_per_dispatch <= 1:
+        return jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(state_sharding, mesh_lib.batch_sharding(mesh)),
+            out_shardings=(state_sharding, repl),
+        )
+
+    # Fused multi-step dispatch (train.steps_per_dispatch = K > 1): scan
+    # the SAME step body over a (K, B, ...) stack of fresh batches — one
+    # XLA program per K steps. Semantics are identical to K single
+    # dispatches (state.step advances inside the scan, so fold_in-derived
+    # noise/dropout/CFG keys match the sequential run exactly); what
+    # disappears is K-1 host dispatch round trips, the dominant cost for
+    # small models and remote-device runtimes. Metrics come back as the
+    # window mean (loss/grad_norm/lr over the K steps).
+    def multi_step(state: TrainState, batches: dict):
+        state, ms = jax.lax.scan(train_step, state, batches)
+        return state, jax.tree.map(lambda a: jnp.mean(a, axis=0), ms)
+
     return jax.jit(
-        train_step,
+        multi_step,
         donate_argnums=(0,),
-        in_shardings=(state_sharding, data),
+        in_shardings=(state_sharding, mesh_lib.stacked_batch_sharding(mesh)),
         out_shardings=(state_sharding, repl),
     )

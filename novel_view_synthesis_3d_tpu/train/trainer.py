@@ -321,10 +321,22 @@ class Trainer:
         self._host_ema_step = step_now
 
     def _upload_next_batch(self):
-        """Fetch the next host batch and start its async device upload."""
-        batch = self._next_batch()
-        batch = {k: v for k, v in batch.items() if k != "noise"}
-        return mesh_lib.shard_batch(self.mesh, batch)
+        """Fetch the next host batch(es) and start the async device upload.
+
+        With train.steps_per_dispatch = K > 1, K consecutive batches are
+        stacked on a leading step axis and consumed by one fused-scan
+        dispatch (train/step.py multi_step) — fresh data every step, K-1
+        fewer dispatch round trips."""
+        spd = self.config.train.steps_per_dispatch
+
+        def clean(b):
+            return {k: v for k, v in b.items() if k != "noise"}
+
+        if spd <= 1:
+            return mesh_lib.shard_batch(self.mesh, clean(self._next_batch()))
+        host = [clean(self._next_batch()) for _ in range(spd)]
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *host)
+        return mesh_lib.shard_batch(self.mesh, stacked, stacked=True)
 
     def train(self) -> None:
         tcfg = self.config.train
@@ -369,7 +381,10 @@ class Trainer:
 
             self._maybe_update_host_ema(step_now)
 
-            if step_now % tcfg.log_every == 0 or step_now == 1:
+            # First-iteration log: step_now is 1 normally, K under fused
+            # multi-step dispatch (both only at a fresh, non-resumed start).
+            if (step_now % tcfg.log_every == 0
+                    or step_now == tcfg.steps_per_dispatch):
                 logged = self.metrics.log(
                     step_now, jax.device_get(step_metrics), tcfg.batch_size)
                 print(f"{step_now}: loss={logged['loss']:.5f} "

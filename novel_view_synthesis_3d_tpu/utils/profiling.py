@@ -39,18 +39,25 @@ def trace_window(log_dir: str, enabled: bool = True) -> Iterator[None]:
 
 
 class StepTimer:
-    """Wall-clock per-step timing with summary statistics."""
+    """Wall-clock per-step timing with summary statistics.
 
-    def __init__(self):
+    `units_per_measure` > 1 marks each measured region as covering that
+    many steps (fused multi-step dispatch): recorded times are normalized
+    to per-step so summaries stay comparable across dispatch widths
+    (within-window per-step variation is unobservable, so each window
+    contributes its mean)."""
+
+    def __init__(self, units_per_measure: int = 1):
         self._times: list = []
         self._t0: Optional[float] = None
+        self._units = max(1, units_per_measure)
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
 
     def stop(self) -> float:
         assert self._t0 is not None, "start() not called"
-        dt = time.perf_counter() - self._t0
+        dt = (time.perf_counter() - self._t0) / self._units
         self._times.append(dt)
         self._t0 = None
         return dt
@@ -68,7 +75,7 @@ class StepTimer:
             return {}
         arr = np.asarray(self._times)
         return {
-            "steps": int(arr.size),
+            "steps": int(arr.size) * self._units,
             "mean_s": float(arr.mean()),
             "p50_s": float(np.percentile(arr, 50)),
             "p90_s": float(np.percentile(arr, 90)),

@@ -47,6 +47,15 @@ MATRIX = [
     ("paper256_adafactor",
      ["bench.py", "paper256", "10", "train.optimizer=adafactor"], 5400),
     ("base128_train", ["bench.py", "base128", "20"], 2400),
+    # Fused multi-step dispatch A/B (train.steps_per_dispatch): the r4a
+    # tiny64_train.json (188.5 imgs/s/chip) was spd=1; bench.py now
+    # defaults tiny64 to spd=10, so measure both explicitly. base128 at
+    # spd=5 probes whether dispatch overhead still matters at 200ms steps.
+    ("tiny64_spd10", ["bench.py", "tiny64", "30"], 1800),
+    ("tiny64_spd1", ["bench.py", "tiny64", "30",
+                     "train.steps_per_dispatch=1"], 1800),
+    ("base128_spd5", ["bench.py", "base128", "20",
+                      "train.steps_per_dispatch=5"], 2400),
     ("tiny64_noflash", ["bench.py", "tiny64", "30",
                         "model.use_flash_attention=False"], 1800),
     ("tiny64_fusedgn", ["bench.py", "tiny64", "30",
