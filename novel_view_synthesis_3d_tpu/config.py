@@ -224,6 +224,15 @@ class TrainConfig:
     # sparse-EMA update; exact for k=1). Checkpointed with the state.
     ema_host: bool = False
     ema_host_every: int = 25
+    # Dtype for the in-loop probe's pinned param copy (sample/eval probes).
+    # '' = keep the param/EMA dtype (f32 — exact). 'bfloat16' halves the
+    # probe pin: at paper256 scale the f32 probe copy is ~2.6G on a chip
+    # already at ~15.3G of 15.75G (results/tpu_r04/analyze_paper256.out) —
+    # the probe would OOM mid-training. The probe is a trend signal
+    # (eval.csv curve), and the paper256 model computes in bf16 anyway, so
+    # bf16 probe weights cost ~nothing in signal. The probe copy is
+    # explicitly freed after each probe either way.
+    probe_dtype: str = ""
     results_folder: str = "./results"
     checkpoint_dir: str = "./checkpoints"
     resume: bool = True  # auto-resume from latest checkpoint (ref: absent)
@@ -408,6 +417,10 @@ class Config:
             errors.append(
                 f"train.adam_mu_dtype={t.adam_mu_dtype!r} must be "
                 "'float32' or 'bfloat16'")
+        if t.probe_dtype not in ("", "float32", "bfloat16"):
+            errors.append(
+                f"train.probe_dtype={t.probe_dtype!r} must be '' (param "
+                "dtype), 'float32', or 'bfloat16'")
         if t.ema_host and t.ema_decay <= 0:
             errors.append(
                 "train.ema_host=True is inert without train.ema_decay > 0")
@@ -550,7 +563,11 @@ def get_preset(name: str) -> Config:
                               grad_accum_steps=8,
                               # 0.5x param bytes of HBM back on the 16G
                               # chip; see TrainConfig.adam_mu_dtype.
-                              adam_mu_dtype="bfloat16"),
+                              adam_mu_dtype="bfloat16",
+                              # In-loop probes pin the EMA copy on-chip;
+                              # f32 would be 2.6G the margin doesn't have
+                              # (see TrainConfig.probe_dtype).
+                              probe_dtype="bfloat16"),
             diffusion=DiffusionConfig(sample_timesteps=256),
         )
     if name == "pod64":

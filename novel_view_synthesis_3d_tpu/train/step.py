@@ -249,11 +249,15 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
     # dispatches (state.step advances inside the scan, so fold_in-derived
     # noise/dropout/CFG keys match the sequential run exactly); what
     # disappears is K-1 host dispatch round trips, the dominant cost for
-    # small models and remote-device runtimes. Metrics come back as the
-    # window mean (loss/grad_norm/lr over the K steps).
+    # small models and remote-device runtimes. loss/grad_norm come back as
+    # the window mean (per-step values inside the window are unobservable
+    # to the logger anyway); lr is the LAST step's value — a schedule
+    # position, where a window mean would misreport the logged step.
     def multi_step(state: TrainState, batches: dict):
         state, ms = jax.lax.scan(train_step, state, batches)
-        return state, jax.tree.map(lambda a: jnp.mean(a, axis=0), ms)
+        out = jax.tree.map(lambda a: jnp.mean(a, axis=0), ms)
+        out["lr"] = ms["lr"][-1]
+        return state, out
 
     return jax.jit(
         multi_step,

@@ -215,7 +215,10 @@ class Trainer:
         self.metrics = MetricsLogger(tcfg.results_folder)
         self.results_folder = tcfg.results_folder
         os.makedirs(self.results_folder, exist_ok=True)
-        self.timer = StepTimer()
+        # units_per_measure: each measured region covers one dispatch, i.e.
+        # steps_per_dispatch training steps — normalize so the end-of-run
+        # summary reports true per-step times at any dispatch width.
+        self.timer = StepTimer(units_per_measure=tcfg.steps_per_dispatch)
         if tcfg.debug_nans:
             enable_nan_checks()
 
@@ -359,7 +362,17 @@ class Trainer:
             # while the previous step ran on device (shard_batch issues an
             # async device_put). The first iteration pays one cold upload.
             if self._device_batch is None:
-                self._device_batch = self._upload_next_batch()
+                try:
+                    self._device_batch = self._upload_next_batch()
+                except StopIteration:
+                    raise RuntimeError(
+                        "data_iter exhausted before train.num_steps="
+                        f"{tcfg.num_steps} (at step {self.step}). Injected "
+                        "finite iterators must supply ceil(remaining_steps /"
+                        f" steps_per_dispatch={tcfg.steps_per_dispatch}) * "
+                        "steps_per_dispatch batches; with "
+                        "steps_per_dispatch>1 a partial trailing group "
+                        "cannot be dispatched.") from None
             with self.timer.measure():
                 self.state, step_metrics = self.train_step(
                     self.state, self._device_batch)
@@ -408,13 +421,20 @@ class Trainer:
                 # even when both probes fire (on a pod each gather is a
                 # full cross-host all-gather of the param tree).
                 probe_params = self._probe_host_params()
-                if sample_due:
-                    self.dump_samples(step_now, params=probe_params)
-                if eval_due:
-                    logged = self.eval_step(step_now, params=probe_params)
-                    if logged is not None:
-                        print(f"{step_now}: eval psnr={logged['psnr']:.2f} "
-                              f"ssim={logged['ssim']:.4f}")
+                try:
+                    if sample_due:
+                        self.dump_samples(step_now, params=probe_params)
+                    if eval_due:
+                        logged = self.eval_step(step_now, params=probe_params)
+                        if logged is not None:
+                            print(f"{step_now}: "
+                                  f"eval psnr={logged['psnr']:.2f} "
+                                  f"ssim={logged['ssim']:.4f}")
+                finally:
+                    # Free the pinned probe copy promptly — at paper256 it
+                    # is the difference between the next step fitting HBM
+                    # and an OOM (VERDICT r4 item 8).
+                    self._release_probe_params(probe_params)
 
             if self._preempt_agreed():
                 print(f"preemption signal received at step {step_now}: "
@@ -450,16 +470,24 @@ class Trainer:
         collectives inside the sampler; other hosts get None and return
         early — no multi-writer eval.csv, no mismatched collectives."""
         self._maybe_update_host_ema(self.step, force=True)
+        pd = self.config.train.probe_dtype or None
         if self._host_ema is not None:
             # Host EMA is already fully replicated host-side (every host
             # folds the same values) — no collective needed; process 0
-            # pins it on a local device for the probe samplers.
+            # pins it on a local device for the probe samplers. probe_dtype
+            # (paper256: bf16) halves the pin — the f32 copy is ~2.6G the
+            # 16G chip doesn't have mid-training (VERDICT r4 item 8).
             if jax.process_index() != 0:
                 return None
-            return jax.device_put(self._host_ema, jax.local_devices()[0])
+            tree = self._host_ema
+            if pd:
+                tree = jax.tree.map(lambda a: np.asarray(a, pd), tree)
+            return jax.device_put(tree, jax.local_devices()[0])
         params = (self.state.ema_params if self.state.ema_params is not None
                   else self.state.params)
         if jax.process_count() == 1:
+            if pd and pd != self.config.model.param_dtype:
+                return jax.tree.map(lambda a: jnp.asarray(a, pd), params)
             return params
         replicated = mesh_lib.replicate(self.mesh, params)
         jax.block_until_ready(replicated)
@@ -469,8 +497,25 @@ class Trainer:
         # single-device programs, and handing them host numpy would re-pay
         # the host→device transfer per sampler call (2× when sample and
         # eval probes coincide).
-        return jax.device_put(jax.device_get(replicated),
-                              jax.local_devices()[0])
+        host = jax.device_get(replicated)
+        if pd:
+            host = jax.tree.map(lambda a: np.asarray(a, pd), host)
+        return jax.device_put(host, jax.local_devices()[0])
+
+    def _release_probe_params(self, probe_params) -> None:
+        """Free the probe's pinned device copy (paper256 HBM margin).
+
+        No-op when the probe handed out the live state trees themselves
+        (single-process, probe_dtype unset) — only a distinct pinned copy
+        is deleted."""
+        if probe_params is None:
+            return
+        if (probe_params is self.state.params
+                or probe_params is self.state.ema_params):
+            return
+        for leaf in jax.tree.leaves(probe_params):
+            if hasattr(leaf, "delete"):
+                leaf.delete()
 
     def _held_out_probe_batch(self, folder: str):
         """Fixed probe batch from a held-out SRN tree (train.eval_folder).

@@ -151,3 +151,26 @@ def test_trainer_runs_fused(tmp_path):
     rows = list(csv.DictReader(open(tmp_path / "res" / "metrics.csv")))
     assert [int(r["step"]) for r in rows] == [2, 4]
     assert all(np.isfinite(float(r["loss"])) for r in rows)
+
+
+@pytest.mark.slow
+def test_fused_lr_is_last_step_value():
+    """Under fused dispatch, logged lr is the LAST step's schedule value —
+    a schedule position, not a window mean (ADVICE r4). With a 10-step
+    linear warmup and K=3 from step 0, lr(2) = 2e-4 vs mean 1e-4."""
+    mesh = mesh_lib.make_mesh(MeshConfig(data=1, model=1, seq=1),
+                              devices=jax.devices()[:1])
+    cfg = dataclasses.replace(
+        CFG, train=dataclasses.replace(CFG.train, steps_per_dispatch=K,
+                                       warmup_steps=10, num_steps=99))
+    schedule = make_schedule(cfg.diffusion)
+    batches = [make_example_batch(batch_size=4, sidelength=16, seed=s)
+               for s in range(K)]
+    model, state = _state(cfg, batches[0])
+    state = mesh_lib.replicate(mesh, state)
+    stepk = make_train_step(cfg, model, schedule, mesh)
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+    state, m = stepk(state, mesh_lib.shard_batch(mesh, stacked,
+                                                 stacked=True))
+    lr = cfg.train.lr
+    np.testing.assert_allclose(float(m["lr"]), lr * 2 / 10, rtol=1e-6)

@@ -256,3 +256,66 @@ def test_eval_folder_probe_uses_held_out_views(srn_root, tmp_path,
                               np.asarray(tr._held_batch["target"])[:4])
     out = tr.eval_step(0)
     assert out is not None and np.isfinite(out["psnr"])
+
+
+def test_undersized_data_iter_clear_error(srn_root, tmp_path):
+    """An injected iterator that runs dry BEFORE num_steps must fail with
+    an error naming steps_per_dispatch (ADVICE r4), not a raw
+    StopIteration at the loop top."""
+    from novel_view_synthesis_3d_tpu.data.pipeline import iter_batches
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+
+    cfg = _config(srn_root, str(tmp_path), num_steps=4, resume=False)
+    ds = SRNDataset(srn_root, img_sidelength=16)
+    src = iter_batches(ds, 8, seed=0)
+    finite = iter([next(src) for _ in range(2)])  # 2 batches < 4 steps
+    t = Trainer(config=cfg, data_iter=finite, use_grain=False)
+    with pytest.raises(RuntimeError, match="steps_per_dispatch"):
+        t.train()
+    t.ckpt.close()
+
+
+@pytest.mark.slow
+def test_probe_dtype_casts_and_release_frees(srn_root, tmp_path):
+    """train.probe_dtype='bfloat16' (paper256 HBM-margin path, VERDICT r4
+    item 8): the probe pin is a bf16 COPY of the host EMA, and
+    _release_probe_params deletes it without touching live state; a
+    subsequent probe still works."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    cfg = _config(srn_root, str(tmp_path), num_steps=2, resume=False)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(
+            cfg.train, ema_decay=0.999, ema_host=True, ema_host_every=1,
+            probe_dtype="bfloat16"))
+    t = Trainer(config=cfg, use_grain=False)
+    t.train()
+    p = t._probe_host_params()
+    leaves = jax.tree.leaves(p)
+    assert leaves and all(leaf.dtype == jnp.bfloat16 for leaf in leaves)
+    t._release_probe_params(p)
+    assert all(leaf.is_deleted() for leaf in leaves)
+    # Live params untouched; the next probe re-pins cleanly.
+    assert all(np.isfinite(np.asarray(leaf)).all()
+               for leaf in jax.tree.leaves(jax.device_get(t.state.params)))
+    p2 = t._probe_host_params()
+    assert all(not leaf.is_deleted() for leaf in jax.tree.leaves(p2))
+    t._release_probe_params(p2)
+    t.ckpt.close()
+
+
+def test_probe_release_never_deletes_live_params(srn_root, tmp_path):
+    """Default path (no EMA, probe_dtype unset): the probe hands out the
+    LIVE param tree and release must be a no-op on it."""
+    cfg = _config(srn_root, str(tmp_path), num_steps=2, resume=False)
+    t = Trainer(config=cfg, use_grain=False)
+    t.train()
+    p = t._probe_host_params()
+    assert p is t.state.params
+    t._release_probe_params(p)
+    leaf = jax.tree.leaves(t.state.params)[0]
+    assert not leaf.is_deleted()
+    float(np.asarray(leaf).sum())  # still usable
+    t.ckpt.close()

@@ -1,0 +1,110 @@
+"""Watcher retry persistence (VERDICT r4 item 7): a failure with the
+tunnel alive is charged to a persistent per-entry attempt ledger
+({name}.attempts.json) and retried exactly once on a later matrix pass; a
+watcher RESTART neither forgets an exhausted entry nor re-queues it from
+scratch; a tunnel death mid-run charges nothing (the re-run is cheap via
+the persistent compile cache). run_watcher is exercised for real —
+subprocess entries, artifact files — with only the tunnel probe injected."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import _common  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fast_probe_interval(monkeypatch):
+    monkeypatch.setattr(_common, "PROBE_INTERVAL_S", 0.01)
+
+
+def _script(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(body)
+    return str(path)  # absolute: run_watcher joins relative paths onto repo
+
+
+def test_failure_with_tunnel_alive_retries_then_exhausts(tmp_path):
+    out = str(tmp_path / "out")
+    boom = _script(tmp_path, "boom.py", "import sys; sys.exit(1)\n")
+    _common.run_watcher(out, [("boom", [boom], 5)], max_wait_h=0.005,
+                        cache_dir=str(tmp_path / "cache"),
+                        probe_fn=lambda: True)
+    rec = json.load(open(os.path.join(out, "boom.attempts.json")))
+    assert rec["attempts"] == 2  # first try + exactly one retry
+    assert "rc=1" in rec["last_failure"]
+    assert not os.path.exists(os.path.join(out, "boom.json"))
+
+
+def test_attempt_ledger_survives_watcher_restart(tmp_path):
+    out = str(tmp_path / "out")
+    os.makedirs(out)
+    # A prior watcher process exhausted this entry; the restarted watcher
+    # must not run it again (its script would now SUCCEED if re-run —
+    # detectable via the artifact it would write).
+    with open(os.path.join(out, "boom.attempts.json"), "w") as fh:
+        json.dump({"attempts": 2, "last_failure": "rc=1"}, fh)
+    ok = _script(tmp_path, "ok.py",
+                 "print('{\"platform\": \"fake\", \"value\": 1}')\n")
+    _common.run_watcher(out, [("boom", [ok], 5)], max_wait_h=0.003,
+                        cache_dir=str(tmp_path / "cache"),
+                        probe_fn=lambda: True)
+    assert not os.path.exists(os.path.join(out, "boom.json"))
+
+
+def test_success_persists_artifact_and_resume_skips(tmp_path):
+    out = str(tmp_path / "out")
+    os.makedirs(out)
+    # A stale failure from an earlier transient problem: success must clear
+    # it so a future re-measure gets a fresh retry budget.
+    with open(os.path.join(out, "ok.attempts.json"), "w") as fh:
+        json.dump({"attempts": 1, "last_failure": "rc=1"}, fh)
+    ok = _script(tmp_path, "ok.py",
+                 "print('{\"platform\": \"fake\", \"value\": 1}')\n")
+    _common.run_watcher(out, [("ok", [ok], 5)], max_wait_h=0.005,
+                        cache_dir=str(tmp_path / "cache"),
+                        probe_fn=lambda: True)
+    art = os.path.join(out, "ok.json")
+    assert json.load(open(art))["platform"] == "fake"
+    assert not os.path.exists(os.path.join(out, "ok.attempts.json"))
+    # Restart with a now-FAILING script: the artifact must short-circuit
+    # the entry (no re-run, no failure recorded).
+    boom = _script(tmp_path, "ok.py", "import sys; sys.exit(1)\n")
+    _common.run_watcher(out, [("ok", [boom], 5)], max_wait_h=0.003,
+                        cache_dir=str(tmp_path / "cache"),
+                        probe_fn=lambda: True)
+    assert json.load(open(art))["platform"] == "fake"
+    assert not os.path.exists(os.path.join(out, "ok.attempts.json"))
+
+
+def test_cpu_fallback_rejected_and_charged(tmp_path):
+    out = str(tmp_path / "out")
+    cpu = _script(tmp_path, "cpu.py",
+                  "print('{\"platform\": \"cpu\", \"value\": 1}')\n")
+    _common.run_watcher(out, [("cpu", [cpu], 5)], max_wait_h=0.005,
+                        cache_dir=str(tmp_path / "cache"),
+                        probe_fn=lambda: True)
+    assert not os.path.exists(os.path.join(out, "cpu.json"))
+    rec = json.load(open(os.path.join(out, "cpu.attempts.json")))
+    assert rec["attempts"] == 2
+    assert "cpu" in rec["last_failure"]
+
+
+def test_tunnel_death_mid_run_charges_no_attempt(tmp_path):
+    out = str(tmp_path / "out")
+    boom = _script(tmp_path, "boom.py", "import sys; sys.exit(1)\n")
+    calls = {"n": 0}
+
+    def probe():
+        calls["n"] += 1
+        return calls["n"] == 1  # alive to enter the matrix, dead after
+
+    _common.run_watcher(out, [("boom", [boom], 5)], max_wait_h=0.002,
+                        cache_dir=str(tmp_path / "cache"), probe_fn=probe)
+    # Failure was attributed to the dead tunnel, not the entry.
+    assert not os.path.exists(os.path.join(out, "boom.attempts.json"))

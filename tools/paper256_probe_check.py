@@ -1,0 +1,87 @@
+"""paper256 probe-coexistence check (VERDICT r4 item 8).
+
+Runs the REAL Trainer at the paper256 preset for a handful of steps with
+the in-loop eval/sample probes enabled (eval_every > 0) — the exact
+configuration the r4 analysis flagged: training state ~15.3G of 15.75G
+HBM, plus the probe's pinned param copy (f32 would be +2.6G → OOM). The
+round-5 mitigations under test:
+  - train.probe_dtype='bfloat16' (paper256 preset default): halves the pin;
+  - Trainer._release_probe_params: frees the pin before the next step.
+
+Passes iff two eval probes and the surrounding train steps all execute
+without RESOURCE_EXHAUSTED. Prints one platform-tagged JSON line (the
+watcher contract) with peak HBM if the backend reports memory_stats.
+
+Usage: python tools/paper256_probe_check.py [out_dir] [steps]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "results", "tpu_r05", "p256probe")
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+    from _common import init_jax_env
+    init_jax_env()
+    import jax
+
+    from novel_view_synthesis_3d_tpu.config import get_preset
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+    os.makedirs(out_dir, exist_ok=True)
+    cfg = get_preset("paper256").override(**{
+        "train.num_steps": steps,
+        "train.eval_every": max(steps // 2, 1),
+        "train.sample_every": steps,  # one grid dump at the end
+        "train.save_every": 0,        # skip mid-run Orbax (not under test)
+        "train.log_every": max(steps // 4, 1),
+        "train.results_folder": out_dir,
+        "train.checkpoint_dir": os.path.join(out_dir, "ckpt"),
+        "train.resume": False,
+        # Probe speed: the probe samples eval_sample_steps DDPM steps at
+        # 256px — keep it small; memory, not quality, is under test.
+        "train.eval_sample_steps": 8,
+        "diffusion.sample_timesteps": 8,
+    })
+
+    def batches():
+        while True:
+            # Fresh-enough data; identical shapes each step (one program).
+            yield make_example_batch(batch_size=cfg.train.batch_size,
+                                     sidelength=cfg.data.img_sidelength,
+                                     seed=0)
+
+    t = Trainer(config=cfg, data_iter=batches(), use_grain=False)
+    t.train()
+
+    result = {
+        "metric": "paper256_probe_coexistence",
+        "value": 1,
+        "unit": "ok",
+        "vs_baseline": None,
+        "steps": steps,
+        "eval_rows": sum(1 for _ in open(os.path.join(out_dir, "eval.csv"))
+                         ) - 1 if os.path.exists(
+                             os.path.join(out_dir, "eval.csv")) else 0,
+        "platform": jax.devices()[0].platform,
+    }
+    stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
+    if stats:
+        for k in ("peak_bytes_in_use", "bytes_limit"):
+            if k in stats:
+                result[k] = stats[k]
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Round-5 TPU watcher — the round's judged-evidence queue.
+
+Fresh OUT dir (results/tpu_r05): round-4's banked artifacts stay frozen in
+results/tpu_r04; everything here is round-5 evidence. Ordering by VERDICT
+r4 "Next round" value:
+  0. tiny64_train FIRST (~10 min): banks one guaranteed TPU artifact AND
+     warms the persistent compile cache for the EXACT program the driver's
+     end-of-round `python bench.py` runs — the judged BENCH line is
+     0-for-4 rounds; de-risking it is worth the 10-minute delay to
+     paper256.
+  1. paper256 analyze + 10-step train (host-EMA + probe_dtype fixes) — the
+     BASELINE.json north star, never yet measured (r4 attempt OOM'd by
+     2.19G pre-fix); adafactor variant as the fallback; then the
+     probe-coexistence check (VERDICT item 8).
+  2. the 20k-step 64px quality run (the framework's purpose).
+  3. honest sampler headline (bench_sample's new jit-per-step baseline).
+  4. Pallas A/B grid (flash post-backward-split, fused GN, spd, remat).
+  5. k=2 vs k=1 quality pair, long-tail extras.
+
+Retries: run_watcher persists per-entry attempt counts (max 2) — an OOM
+or timeout with the tunnel alive is retried once on the next matrix pass,
+and a watcher restart neither forgets nor re-queues exhausted entries
+(VERDICT r4 item 7).
+
+Usage: python tools/tpu_bench_watch_r5.py [max_wait_hours]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "results", "tpu_r05")
+sys.path.insert(0, REPO)
+from bench import CACHE_DIR as CACHE  # noqa: E402
+from _common import run_watcher  # noqa: E402
+
+Q = os.path.join("results", "quality_tpu_r05")
+
+MATRIX = [
+    # -- 0: bank one artifact + warm the driver's exact bench program --
+    ("tiny64_train", ["bench.py", "tiny64", "30"], 1800),
+    # -- 1: paper256, the never-measured north star --
+    ("analyze_paper256", ["bench.py", "analyze", "paper256"], 3600),
+    ("paper256_train", ["bench.py", "paper256", "10"], 5400),
+    ("analyze_paper256_adafactor",
+     ["bench.py", "analyze", "paper256", "train.optimizer=adafactor"], 1800),
+    ("paper256_adafactor",
+     ["bench.py", "paper256", "10", "train.optimizer=adafactor"], 5400),
+    ("paper256_probe_check",
+     ["tools/paper256_probe_check.py",
+      os.path.join("results", "tpu_r05", "p256probe"), "20"], 4800),
+    # -- 2: novel-view synthesis above the floor --
+    ("quality_tpu_64px", ["tools/quality_run.py", Q, "20000", "64"], 7200),
+    # -- 3: honest sampler headline (jit-per-step baseline, r5 bench) --
+    ("sample_base128_256", ["bench.py", "sample", "base128", "256"], 3600),
+    ("sample_tiny64_256", ["bench.py", "sample", "tiny64", "256"], 1800),
+    # -- 4: Pallas / dispatch A/B grid --
+    ("base128_train", ["bench.py", "base128", "20"], 2400),
+    ("tiny64_spd1", ["bench.py", "tiny64", "30",
+                     "train.steps_per_dispatch=1"], 1800),
+    ("tiny64_noflash", ["bench.py", "tiny64", "30",
+                        "model.use_flash_attention=False"], 1800),
+    ("tiny64_fusedgn", ["bench.py", "tiny64", "30",
+                        "model.use_fused_groupnorm=True"], 1800),
+    ("base128_noflash", ["bench.py", "base128", "20",
+                         "model.use_flash_attention=False"], 2400),
+    ("base128_fusedgn", ["bench.py", "base128", "20",
+                         "model.use_fused_groupnorm=True"], 2400),
+    ("base128_spd5", ["bench.py", "base128", "20",
+                      "train.steps_per_dispatch=5"], 2400),
+    ("base128_dots", ["bench.py", "base128", "20",
+                      "model.remat=dots"], 2400),
+    # -- 5: k>1 quality pair + extras --
+    ("quality_tpu_k2", ["tools/quality_run.py",
+                        os.path.join("results", "quality_tpu_r05_k2"),
+                        "8000", "64", "model.num_cond_frames=2"], 5400),
+    ("quality_tpu_k1_matched", ["tools/quality_run.py",
+                                os.path.join("results",
+                                             "quality_tpu_r05_k1m"),
+                                "8000", "64"], 5400),
+    ("sampler_comparison_quality64",
+     ["tools/sampler_comparison.py", os.path.join(Q, "work", "val"),
+      os.path.join(Q, "sampler_comparison.json"),
+      "--config", os.path.join(Q, "work", "config.json"),
+      "--num-instances", "6", "--views-per-instance", "2"], 3600),
+    ("base128_bs16", ["bench.py", "base128", "20",
+                      "train.batch_size=16"], 2400),
+    ("sample_dpmpp32_tiny64", ["bench.py", "sample", "tiny64", "32",
+                               "diffusion.sampler=dpm++"], 1800),
+    ("sample_ar_tiny64", ["bench.py", "sample-ar", "tiny64", "8"], 2400),
+    ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
+    ("sample_tiny64_256_bf16", ["bench.py", "sample", "tiny64", "256",
+                                "model.dtype=bfloat16"], 1800),
+]
+
+
+if __name__ == "__main__":
+    max_wait_h = float(sys.argv[1]) if len(sys.argv) > 1 else 10.5
+    run_watcher(OUT, MATRIX, max_wait_h, CACHE)
