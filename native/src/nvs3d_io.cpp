@@ -585,6 +585,22 @@ struct Loader {
       b->record_idx.assign(slots.begin(), slots.end());
       std::string err;
       bool failed = false;
+      // Every failure is tagged with the offending file path so the
+      // Python binding can quarantine that record and rebuild (the data
+      // fault-tolerance contract shared with data/srn.py safe_pair).
+      auto load_view = [&](int32_t r, float *img_out,
+                           float *pose_out) -> bool {
+        if (load_rgb_impl(rgb_paths[size_t(r)].c_str(), sidelength, img_out,
+                          err)) {
+          err = rgb_paths[size_t(r)] + ": " + err;
+          return true;
+        }
+        if (parse_pose_impl(pose_paths[size_t(r)].c_str(), pose_out, err)) {
+          err = pose_paths[size_t(r)] + ": " + err;
+          return true;
+        }
+        return false;
+      };
       for (int i = 0; i < batch_size && !failed; ++i) {
         int32_t rec = slots[size_t(i)];
         const auto &sibs = members[size_t(instance_of[size_t(rec)])];
@@ -594,18 +610,12 @@ struct Loader {
         int32_t rec2 = sibs[pick(rng)];
         std::vector<int32_t> cond(1, rec);
         for (size_t c = 1; c < k; ++c) cond.push_back(sibs[pick(rng)]);
-        failed =
-            load_rgb_impl(rgb_paths[size_t(rec2)].c_str(), sidelength,
-                          b->target.data() + img * i, err) ||
-            parse_pose_impl(pose_paths[size_t(rec2)].c_str(),
-                            b->pose2.data() + 16 * i, err);
+        failed = load_view(rec2, b->target.data() + img * i,
+                           b->pose2.data() + 16 * i);
         for (size_t c = 0; c < k && !failed; ++c) {
-          failed =
-              load_rgb_impl(rgb_paths[size_t(cond[c])].c_str(), sidelength,
-                            b->x.data() + img * (size_t(i) * k + c), err) ||
-              parse_pose_impl(pose_paths[size_t(cond[c])].c_str(),
-                              b->pose1.data() + 16 * (size_t(i) * k + c),
-                              err);
+          failed = load_view(cond[c],
+                             b->x.data() + img * (size_t(i) * k + c),
+                             b->pose1.data() + 16 * (size_t(i) * k + c));
         }
       }
       if (failed) {

@@ -59,7 +59,17 @@ def _split_overrides(rest: List[str]) -> List[str]:
 # ---------------------------------------------------------------------------
 def cmd_train(args, overrides: List[str]) -> int:
     from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+    from novel_view_synthesis_3d_tpu.utils import faultinject
 
+    armed = faultinject.armed()
+    if armed:
+        # Loud, not fatal: chaos drills on real hardware are legitimate,
+        # but a production run must never discover injected faults only by
+        # dying — and injected anomalies in metrics.csv must be
+        # distinguishable from real ones.
+        print(f"warning: FAULT INJECTION ARMED ({', '.join(armed)}) — this "
+              "run will experience deliberate failures; unset NVS3D_FI_* "
+              "for production training")
     cfg = build_config(args, overrides)
     if args.folder:
         cfg = cfg.override(**{"data.root_dir": args.folder})

@@ -138,6 +138,12 @@ class DataConfig:
     num_workers: int = 8
     prefetch: int = 4
     shuffle_seed: int = 0
+    # Data fault tolerance: a record whose image/pose fails to load is
+    # QUARANTINED (skipped for the rest of the run, reported on stderr) and
+    # a substitute record is drawn, up to this many consecutive redraws
+    # before the batch is declared unbuildable. Uniform across the python,
+    # Grain, and native backends. 0 = faults are fatal (old behavior).
+    max_record_retries: int = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -246,6 +252,24 @@ class TrainConfig:
     # Checkpoint + clean exit on SIGTERM (TPU preemption); with resume=True
     # the rescheduled run continues from the last step.
     handle_preemption: bool = True
+    # --- fault tolerance: the guard → rollback → fallback ladder ---
+    # (docs/DESIGN.md "Fault tolerance"; SURVEY.md §5.3-§5.4 — the
+    # reference dies on the first NaN and bricks on a torn checkpoint.)
+    # Step anomaly guard (train/guard.py): skip the optimizer/EMA update on
+    # steps with non-finite loss or grad norm. On by default: for clean
+    # runs the guarded step is numerically identical to the unguarded one.
+    anomaly_guard: bool = True
+    # > 0: additionally flag steps whose loss exceeds factor × a running
+    # EMA of accepted losses (e.g. 10.0). Off by default — unlike the
+    # non-finite check it can fire on legitimate loss spikes.
+    loss_spike_factor: float = 0.0
+    # Consecutive anomalous steps before the Trainer rolls back to the last
+    # good checkpoint (with a reseeded RNG so the replayed window draws
+    # different noise/timesteps).
+    max_anomaly_strikes: int = 3
+    # Rollback budget: after this many rollbacks the run aborts loudly
+    # instead of thrashing between a poisoned basin and the checkpoint.
+    max_rollbacks: int = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -430,6 +454,22 @@ class Config:
         if not 0.0 <= t.cond_drop_prob <= 1.0:
             errors.append(
                 f"train.cond_drop_prob={t.cond_drop_prob} outside [0, 1]")
+        if t.loss_spike_factor != 0 and t.loss_spike_factor <= 1.0:
+            errors.append(
+                f"train.loss_spike_factor={t.loss_spike_factor} must be 0 "
+                "(off) or > 1 — a factor <= 1 would flag ordinary steps "
+                "whose loss sits at or above its own running mean")
+        if t.max_anomaly_strikes < 1:
+            errors.append(
+                f"train.max_anomaly_strikes={t.max_anomaly_strikes} must "
+                "be >= 1")
+        if t.max_rollbacks < 0:
+            errors.append(
+                f"train.max_rollbacks={t.max_rollbacks} must be >= 0")
+        if d.max_record_retries < 0:
+            errors.append(
+                f"data.max_record_retries={d.max_record_retries} must be "
+                ">= 0")
         for axis in ("model", "seq"):
             if getattr(self.mesh, axis) < 1:
                 errors.append(f"mesh.{axis} must be >= 1")

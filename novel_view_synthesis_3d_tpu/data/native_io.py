@@ -256,12 +256,87 @@ class NativePairLoader:
             pass
 
 
+class FaultTolerantNativeLoader:
+    """Quarantine-and-rebuild wrapper around NativePairLoader.
+
+    A corrupt record stops the whole C++ worker pool (the loader's error
+    contract), so recovery happens here: the worker tags its error with
+    the failing file path, the wrapper quarantines every record touching
+    that path and rebuilds the native loader without them — the same
+    skipped-and-reported semantics as SRNDataset.safe_pair on the python/
+    Grain backends. Bounded by `max_record_retries` consecutive rebuilds
+    (reset on each successful batch), then the original error re-raises.
+    """
+
+    def __init__(self, build, rgb_paths: Sequence[str],
+                 pose_paths: Sequence[str], instance_ids: Sequence[int],
+                 Ks: np.ndarray, max_record_retries: int = 3):
+        # `build` maps the (possibly filtered) record lists to a fresh
+        # NativePairLoader; rebuilt after each quarantine.
+        self._build = build
+        self._records = list(zip(rgb_paths, pose_paths, instance_ids, Ks))
+        self._retries = max_record_retries
+        self.quarantined: List[str] = []
+        self.fault_reports: List[dict] = []
+        self._loader = self._make()
+
+    def _make(self):
+        rgb, pose, inst, Ks = zip(*self._records)
+        # Compact the instance ids: quarantining can empty an instance,
+        # and the C++ loader rejects id gaps ("instance with no
+        # observations"). Grouping only needs ids to be consistent.
+        remap: dict = {}
+        inst = [remap.setdefault(i, len(remap)) for i in inst]
+        return self._build(list(rgb), list(pose), inst, np.stack(Ks))
+
+    def _quarantine_path(self, msg: str) -> bool:
+        bad = [i for i, (r, p, _, _) in enumerate(self._records)
+               if r in msg or p in msg]
+        if not bad:
+            return False
+        for i in bad:
+            path = self._records[i][0]
+            self.quarantined.append(path)
+            self.fault_reports.append({"path": path, "error": msg})
+        self._records = [rec for i, rec in enumerate(self._records)
+                         if i not in set(bad)]
+        import sys
+
+        print(f"warning: data fault (native loader): {msg!r} — "
+              f"{len(bad)} record(s) quarantined, loader rebuilt "
+              f"({len(self._records)} records remain)",
+              file=sys.stderr, flush=True)
+        return True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        failures = 0
+        while True:
+            try:
+                batch = next(self._loader)
+                return batch
+            except RuntimeError as exc:
+                failures += 1
+                if failures > self._retries or not self._records:
+                    raise
+                self._loader.close()
+                if not self._quarantine_path(str(exc)):
+                    raise  # not a record-level fault (e.g. tiny dataset)
+                self._loader = self._make()
+
+    def close(self) -> None:
+        self._loader.close()
+
+
 def make_native_loader(dataset, batch_size: int, *, num_cond: int = 1,
                        n_threads: int = 8,
                        prefetch_depth: int = 4, seed: int = 0,
                        shard_index: int = 0,
-                       shard_count: int = 1) -> NativePairLoader:
-    """Build a NativePairLoader from a data/srn.SRNDataset.
+                       shard_count: int = 1,
+                       max_record_retries: int = 3):
+    """Build a (fault-tolerant) native loader from a data/srn.SRNDataset.
 
     dataset.samples_per_instance > 1 applies the reference's
     instance-grouped batching (data_loader.py:183-195) inside the C++
@@ -279,10 +354,19 @@ def make_native_loader(dataset, batch_size: int, *, num_cond: int = 1,
             pose.append(p)
             inst.append(i)
             Ks.append(instance.K)
-    return NativePairLoader(
-        rgb, pose, inst, np.stack(Ks), sidelength=dataset.img_sidelength,
-        batch_size=batch_size, num_cond=num_cond,
-        samples_per_instance=getattr(dataset, "samples_per_instance", 1),
-        n_threads=n_threads,
-        prefetch_depth=prefetch_depth, seed=seed,
-        shard_index=shard_index, shard_count=shard_count)
+
+    def build(rgb_l, pose_l, inst_l, Ks_arr):
+        return NativePairLoader(
+            rgb_l, pose_l, inst_l, Ks_arr,
+            sidelength=dataset.img_sidelength,
+            batch_size=batch_size, num_cond=num_cond,
+            samples_per_instance=getattr(dataset, "samples_per_instance", 1),
+            n_threads=n_threads,
+            prefetch_depth=prefetch_depth, seed=seed,
+            shard_index=shard_index, shard_count=shard_count)
+
+    if max_record_retries <= 0:
+        return build(rgb, pose, inst, np.stack(Ks))
+    return FaultTolerantNativeLoader(
+        build, rgb, pose, inst, np.stack(Ks),
+        max_record_retries=max_record_retries)

@@ -35,6 +35,7 @@ def make_dataset(cfg: DataConfig) -> SRNDataset:
         max_observations_per_instance=cfg.max_observations_per_instance,
         specific_observation_idcs=cfg.specific_observation_idcs,
         samples_per_instance=cfg.samples_per_instance,
+        max_record_retries=cfg.max_record_retries,
     )
 
 
@@ -87,13 +88,19 @@ def make_grain_loader(dataset: SRNDataset, batch_size: int,
 
     ds_ref = dataset
 
+    # Fault wrapper: corrupt records are quarantined-and-redrawn inside
+    # the worker (SRNDataset.safe_*) instead of killing the worker pool.
+    # Duck-typed so non-SRN datasets without safe_* still work.
+    fetch_pair = getattr(ds_ref, "safe_pair", ds_ref.pair)
+    fetch_samples = getattr(ds_ref, "safe_samples", None) or ds_ref.samples
+
     class PairTransform(pygrain.RandomMapTransform):
         def random_map(self, idx, rng: np.random.Generator):
-            return ds_ref.pair(int(idx), rng, num_cond=num_cond)
+            return fetch_pair(int(idx), rng, num_cond=num_cond)
 
     class GroupTransform(pygrain.RandomMapTransform):
         def random_map(self, idx, rng: np.random.Generator):
-            records = ds_ref.samples(int(idx), rng, num_cond=num_cond)
+            records = fetch_samples(int(idx), rng, num_cond=num_cond)
             return {k: np.stack([r[k] for r in records])
                     for k in records[0]}
 
@@ -161,16 +168,21 @@ def iter_batches(dataset: SRNDataset, batch_size: int, *, seed: int = 0,
             f"dataset shard has {len(local)} records but the batch needs "
             f"{draws} index draws — with drop-last batching no batch can "
             "ever be formed; lower train.batch_size or provide more data")
+    # Fault wrapper (duck-typed: any dataset exposing .pair() works here;
+    # SRNDataset's safe_* variants add quarantine-and-redraw on top).
+    fetch_pair = getattr(dataset, "safe_pair", dataset.pair)
+    fetch_samples = (getattr(dataset, "safe_samples", None)
+                     or getattr(dataset, "samples", None))
     while True:
         order = rng.permutation(local)
         for start in range(0, len(order) - draws + 1, draws):
-            if spi == 1:  # any dataset exposing .pair() works here
-                records = [dataset.pair(int(i), rng, num_cond=num_cond)
+            if spi == 1:
+                records = [fetch_pair(int(i), rng, num_cond=num_cond)
                            for i in order[start:start + draws]]
             else:
                 records = [r for i in order[start:start + draws]
-                           for r in dataset.samples(int(i), rng,
-                                                    num_cond=num_cond)]
+                           for r in fetch_samples(int(i), rng,
+                                                  num_cond=num_cond)]
             yield {k: np.stack([r[k] for r in records]) for k in records[0]}
 
 

@@ -26,10 +26,13 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 from glob import glob
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from novel_view_synthesis_3d_tpu.utils import faultinject
 
 try:  # cv2 gives exact INTER_AREA parity with the reference resize
     import cv2
@@ -182,13 +185,21 @@ class SRNDataset:
                  max_num_instances: int = -1,
                  max_observations_per_instance: int = -1,
                  specific_observation_idcs: Optional[Sequence[int]] = None,
-                 samples_per_instance: int = 1):
+                 samples_per_instance: int = 1,
+                 max_record_retries: int = 3):
         if samples_per_instance < 1:
             raise ValueError(
                 f"samples_per_instance must be >= 1, got {samples_per_instance}")
         self.root_dir = root_dir
         self.img_sidelength = img_sidelength
         self.samples_per_instance = samples_per_instance
+        # Data fault tolerance (safe_pair/safe_samples): records whose
+        # image/pose failed to load, skipped for the rest of the run.
+        # Per-process state — Grain workers each hold their own copy, so a
+        # bad record is re-discovered (and re-reported) once per worker.
+        self.max_record_retries = max_record_retries
+        self.quarantined: set = set()
+        self.fault_reports: List[dict] = []
         instance_dirs = sorted(glob(os.path.join(root_dir, "*/")))
         if not instance_dirs:
             raise FileNotFoundError(f"no instances under {root_dir!r}")
@@ -240,11 +251,12 @@ class SRNDataset:
         as the first conditioning frame and draws the rest uniformly; frames
         are stacked on a leading axis (x (Fc,H,W,3), R1 (Fc,3,3), t1 (Fc,3)).
         """
+        faultinject.maybe_raise_record(int(flat_idx))
         obj, view = self.locate(flat_idx)
         inst = self.instances[obj]
-        view2 = int(rng.integers(len(inst)))
+        view2 = self._draw_view(obj, rng)
         target, pose2 = inst.view(view2)
-        cond_views = [view] + [int(rng.integers(len(inst)))
+        cond_views = [view] + [self._draw_view(obj, rng)
                                for _ in range(num_cond - 1)]
         xs, R1s, t1s = [], [], []
         for v in cond_views:
@@ -284,3 +296,99 @@ class SRNDataset:
             v = int(rng.integers(len(self.instances[obj])))
             records.append(self.pair(base + v, rng, num_cond=num_cond))
         return records
+
+    # ------------------------------------------------------------------
+    # Data fault tolerance (docs/DESIGN.md "Fault tolerance"): one corrupt
+    # image/pose must cost one record, not the run. The safe_* variants
+    # quarantine a failing record (skipped for the rest of the run,
+    # reported to stderr + fault_reports) and redraw a substitute, bounded
+    # by max_record_retries consecutive redraws. The pipeline backends all
+    # route through these (pipeline.iter_batches, the Grain transforms; the
+    # native loader quarantines by path in native_io).
+    # ------------------------------------------------------------------
+    def _draw_view(self, obj: int, rng: np.random.Generator) -> int:
+        """Uniform random view index of instance `obj`, avoiding
+        quarantined views. The first draw is the plain rng.integers call —
+        with nothing quarantined the random stream is bit-identical to the
+        pre-fault-tolerance one (resume/parity reproducibility)."""
+        inst = self.instances[obj]
+        v = int(rng.integers(len(inst)))
+        if not self.quarantined:
+            return v
+        base = int(self._offsets[obj])
+        if (base + v) not in self.quarantined:
+            return v
+        allowed = [w for w in range(len(inst))
+                   if (base + w) not in self.quarantined]
+        if not allowed:
+            raise RuntimeError(
+                f"data: every view of instance {inst.instance_dir!r} is "
+                "quarantined — nothing left to draw")
+        return int(allowed[int(rng.integers(len(allowed)))])
+
+    def _locate_failing_record(self, msg: str) -> Optional[int]:
+        """Flat index of the record whose image/pose path appears in an
+        error message, or None. Lets the quarantine hit the file that
+        actually failed even when it was a randomly-drawn sibling of the
+        indexed record. O(records) — fault-path only."""
+        for obj, inst in enumerate(self.instances):
+            for v, (c, p) in enumerate(zip(inst.color_paths,
+                                           inst.pose_paths)):
+                if c in msg or p in msg:
+                    return int(self._offsets[obj]) + v
+        return None
+
+    def _quarantine(self, flat_idx: int, exc: Exception) -> None:
+        self.quarantined.add(int(flat_idx))
+        obj, view = self.locate(flat_idx)
+        report = {
+            "record": int(flat_idx),
+            "instance": os.path.basename(
+                os.path.normpath(self.instances[obj].instance_dir)),
+            "view": view,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+        self.fault_reports.append(report)
+        print(f"warning: data fault: record {flat_idx} "
+              f"({report['instance']} view {view}) quarantined: "
+              f"{report['error']}", file=sys.stderr, flush=True)
+
+    def _safe_fetch(self, fetch, flat_idx: int,
+                    rng: np.random.Generator):
+        idx = int(flat_idx)
+        for _ in range(self.max_record_retries + 1):
+            if idx not in self.quarantined:
+                try:
+                    return fetch(idx)
+                except Exception as exc:
+                    # Quarantine the record whose FILE failed (it may be a
+                    # randomly-drawn sibling view, not the indexed record);
+                    # fall back to the index when the error names no known
+                    # path. Subsequent random view draws avoid quarantined
+                    # views (_draw_view), so the retry below can succeed on
+                    # the same index.
+                    failed = self._locate_failing_record(str(exc))
+                    self._quarantine(idx if failed is None else failed, exc)
+                    if failed is not None and failed != idx:
+                        continue  # same index, bad sibling now avoided
+            idx = int(rng.integers(len(self)))
+        raise RuntimeError(
+            f"data: {self.max_record_retries + 1} consecutive record draws "
+            f"failed or were quarantined ({len(self.quarantined)} "
+            f"quarantined total under {self.root_dir!r}) — the dataset is "
+            "too corrupt to keep training; see the quarantine reports "
+            "above")
+
+    def safe_pair(self, flat_idx: int, rng: np.random.Generator,
+                  num_cond: int = 1) -> dict:
+        """`pair` with quarantine-and-redraw instead of a fatal raise."""
+        return self._safe_fetch(
+            lambda i: self.pair(i, rng, num_cond=num_cond), flat_idx, rng)
+
+    def safe_samples(self, flat_idx: int, rng: np.random.Generator,
+                     num_cond: int = 1) -> List[dict]:
+        """`samples` with quarantine-and-redraw; retries the WHOLE group
+        from a substitute index so the instance-grouping contract (all
+        records from one instance) holds even through a fault."""
+        return self._safe_fetch(
+            lambda i: self.samples(i, rng, num_cond=num_cond), flat_idx, rng)

@@ -14,6 +14,20 @@ from typing import Optional
 import jax
 
 
+def _is_initialized() -> bool:
+    """jax.distributed.is_initialized() with a fallback for jax builds that
+    predate it (< 0.5): the distributed client handle in jax._src is the
+    same thing the public accessor reads. Still backend-free either way."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> None:
@@ -28,7 +42,7 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     jax.process_count, any computation), so the already-initialized check
     uses jax.distributed.is_initialized(), not jax.process_count().
     """
-    if jax.distributed.is_initialized():
+    if _is_initialized():
         return
     explicit = coordinator_address is not None
     # Opt-in env gate (NVS3D_MULTIHOST=1) rather than sniffing TPU_* vars:

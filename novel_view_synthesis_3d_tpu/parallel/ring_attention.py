@@ -27,6 +27,19 @@ from novel_view_synthesis_3d_tpu.parallel.mesh import SEQ_AXIS
 _NEG_INF = -1e30
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map with a fallback for jax builds that predate its
+    top-level promotion (< 0.6): jax.experimental.shard_map is the same
+    transform with the replication check under its older name."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def _block_update(q, k, v, m_prev, l_prev, o_prev, scale):
     """One flash-attention style block accumulation step.
 
@@ -81,9 +94,8 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ_AXIS,
     shard_map — the train-step layout where batch rides the 'data' axis).
     """
     spec = P(batch_axis, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(ring_self_attention_local, axis_name=axis_name, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )
     return fn(q, k, v)

@@ -6,21 +6,64 @@ baked into the file), restores with a mismatched prefix ('model0' vs
 'model<step>'), and has no training resume at all (train.py:159-167,
 sampling.py:104-114). Here: single logical (unreplicated) TrainState, async
 Orbax saves, restore-latest, and auto-resume in the Trainer.
+
+Fault tolerance (docs/DESIGN.md "Fault tolerance"): a torn write — host
+preempted mid-save — must not brick auto-resume. `restore` VERIFIES each
+candidate (Orbax restore succeeds AND every float leaf is finite) and walks
+back to the newest intact step; `save` retries with backoff before giving
+up, and a periodic-save failure degrades to a loud warning instead of
+killing a multi-day run (the final/preemption save still raises).
 """
 
 from __future__ import annotations
 
-import os
-from typing import Optional
+import time
+from typing import Any, List, Optional, Tuple
 
+import os
+
+import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from novel_view_synthesis_3d_tpu.train.state import TrainState
 
 
+def nonfinite_leaf_count(tree: Any) -> int:
+    """Number of float leaves containing any non-finite value.
+
+    Host numpy leaves (host-EMA checkpoints) are checked in place; device
+    leaves via one batched fetch of per-leaf all-finite flags (cheap next
+    to the restore IO itself)."""
+    device_flags = []
+    bad = 0
+    for leaf in jax.tree.leaves(tree):
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None or not np.issubdtype(np.dtype(dtype), np.floating):
+            continue
+        if isinstance(leaf, np.ndarray):
+            bad += int(not np.isfinite(leaf).all())
+        else:
+            import jax.numpy as jnp
+
+            device_flags.append(jnp.all(jnp.isfinite(leaf)))
+    if device_flags:
+        bad += sum(1 for ok in jax.device_get(device_flags) if not bool(ok))
+    return bad
+
+
 class CheckpointManager:
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_retries: int = 2, save_backoff_s: float = 0.5):
         self.directory = os.path.abspath(directory)
+        self.save_retries = save_retries
+        self.save_backoff_s = save_backoff_s
+        self.save_failures = 0  # cumulative failed save ATTEMPTS
+        # Provenance of the last restore() — {'step', 'rejected': [(step,
+        # reason), ...]} — so the Trainer can put a fallback line in the
+        # run log (silent recovery is indistinguishable from silent data
+        # loss).
+        self.last_restore: Optional[dict] = None
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -38,20 +81,103 @@ class CheckpointManager:
             # in-flight async save of that same step.
             self._mngr.wait_until_finished()
             self._mngr.delete(step)
-        return self._mngr.save(step, args=ocp.args.StandardSave(state),
-                               force=force)
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.save_retries + 1):
+            try:
+                saved = self._mngr.save(
+                    step, args=ocp.args.StandardSave(state), force=force)
+                if jax.default_backend() == "cpu":
+                    # Donation race (found by the fault-injection suite):
+                    # the train step donates state buffers, and on the CPU
+                    # backend Orbax's background serialization reads the
+                    # SAME host memory zero-copy — a fast next dispatch
+                    # overwrites it mid-write and tears the checkpoint.
+                    # Draining here makes CPU saves effectively synchronous
+                    # (host-memory writes, cheap at CPU-run scales); on
+                    # accelerators the device→host copy completes before
+                    # save() returns, so async stays async.
+                    self._mngr.wait_until_finished()
+                return saved
+            except Exception as exc:  # filesystem flake, async-save error
+                self.save_failures += 1
+                last_exc = exc
+                try:
+                    # A failed async save may hold a half-registered step;
+                    # drain before retrying so the retry starts clean.
+                    self._mngr.wait_until_finished()
+                except Exception:
+                    pass
+                if attempt < self.save_retries:
+                    time.sleep(self.save_backoff_s * (2 ** attempt))
+        if force:
+            # Final / preemption save: losing it silently loses the run.
+            raise RuntimeError(
+                f"checkpoint save of step {step} failed after "
+                f"{self.save_retries + 1} attempts") from last_exc
+        print(f"warning: checkpoint save of step {step} failed after "
+              f"{self.save_retries + 1} attempts ({last_exc!r}) — training "
+              "continues; the next save interval will retry", flush=True)
+        return False
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def all_steps(self) -> List[int]:
+        return list(self._mngr.all_steps())
+
     def restore(self, template: TrainState,
                 step: Optional[int] = None) -> Optional[TrainState]:
         """Restore into the structure of `template` (e.g. a freshly created
-        state); returns None when no checkpoint exists."""
-        step = self.latest_step() if step is None else step
-        if step is None:
+        state); returns None when no checkpoint exists.
+
+        With `step=None` (auto-resume), candidates are tried newest-first
+        and each is VERIFIED — an Orbax error (torn write, missing files)
+        or any non-finite float leaf rejects the step and falls back to the
+        next older one. Every rejection is recorded in `last_restore` and
+        printed. If steps exist but none verifies, raise (a silent fresh
+        start would quietly discard the run's progress). An explicit `step`
+        is still verified but never falls back — the caller asked for that
+        exact step."""
+        explicit = step is not None
+        candidates = ([step] if explicit
+                      else sorted(self._mngr.all_steps(), reverse=True))
+        if not candidates:
             return None
-        return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
+        rejected: List[Tuple[int, str]] = []
+        for s in candidates:
+            try:
+                state = self._mngr.restore(
+                    s, args=ocp.args.StandardRestore(template))
+            except Exception as exc:
+                reason = f"{type(exc).__name__}: {exc}"
+                rejected.append((s, reason))
+                print(f"warning: checkpoint step {s} failed to restore "
+                      f"({reason.splitlines()[0][:200]})", flush=True)
+                if explicit:
+                    raise
+                continue
+            bad = nonfinite_leaf_count(state)
+            if bad:
+                reason = f"{bad} non-finite leaves"
+                rejected.append((s, reason))
+                print(f"warning: checkpoint step {s} restored but holds "
+                      f"{bad} non-finite leaves — rejected", flush=True)
+                if explicit:
+                    raise RuntimeError(
+                        f"checkpoint step {s} holds {bad} non-finite "
+                        "leaves")
+                continue
+            self.last_restore = {"step": s, "rejected": rejected}
+            if rejected:
+                print(f"checkpoint fallback: step(s) "
+                      f"{[r[0] for r in rejected]} corrupt; restored intact "
+                      f"step {s}", flush=True)
+            return state
+        raise RuntimeError(
+            "no intact checkpoint: all steps "
+            f"{[r[0] for r in rejected]} under {self.directory!r} failed "
+            "verification "
+            f"({'; '.join(f'{s}: {r.splitlines()[0][:120]}' for s, r in rejected)})")
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()

@@ -1,0 +1,90 @@
+"""Step anomaly guard: detect bad steps inside the jitted train step.
+
+First rung of the fault-tolerance ladder (guard → rollback → checkpoint
+fallback, docs/DESIGN.md "Fault tolerance"): a single non-finite loss or
+gradient must not poison the parameters — once NaN enters Adam's moments
+every later step is NaN and the run is dead (the reference has no handling
+at all, SURVEY.md §5.3). The guard:
+
+  - flags a step whose loss or global grad norm is non-finite, or (with
+    `train.loss_spike_factor` > 0) whose loss exceeds factor × a running
+    EMA of accepted losses;
+  - skips the optimizer/EMA update for flagged steps via `jax.lax.cond`
+    (params bit-identical through the step), which composes with the
+    `steps_per_dispatch` fused scan because all guard state lives in the
+    TrainState carry;
+  - counts consecutive strikes; the Trainer rolls back to the last good
+    checkpoint when they exceed `train.max_anomaly_strikes` (bounded by
+    `train.max_rollbacks`, then abort).
+
+Everything here is scalar bookkeeping — zero cost next to the step.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+# EMA decay for the accepted-loss baseline the spike detector compares
+# against. 0.9 ≈ a ~10-step window: long enough to smooth batch noise,
+# short enough to track a fast-falling early loss curve.
+LOSS_EMA_DECAY = 0.9
+
+
+@flax.struct.dataclass
+class GuardState:
+    """Anomaly-guard bookkeeping; rides in the TrainState (scan carry +
+    checkpoint), all scalars."""
+
+    strikes: jnp.ndarray    # () int32 — consecutive anomalous steps
+    anomalies: jnp.ndarray  # () int32 — cumulative anomalous steps
+    loss_ema: jnp.ndarray   # () float32 — EMA of ACCEPTED losses
+    good_steps: jnp.ndarray  # () int32 — accepted steps (EMA warmup gate)
+
+
+def init_guard_state() -> GuardState:
+    return GuardState(
+        strikes=jnp.zeros((), jnp.int32),
+        anomalies=jnp.zeros((), jnp.int32),
+        loss_ema=jnp.zeros((), jnp.float32),
+        good_steps=jnp.zeros((), jnp.int32),
+    )
+
+
+def detect_anomaly(loss: jnp.ndarray, grad_norm: jnp.ndarray,
+                   guard: GuardState, spike_factor: float) -> jnp.ndarray:
+    """Traced () bool: is this step anomalous?
+
+    Non-finite loss/grad always flags. The spike test (`spike_factor` > 0,
+    off by default — it changes clean-run behavior only when it fires)
+    additionally flags loss > factor × EMA, gated on at least one accepted
+    step so the unseeded EMA can never flag step 0.
+    """
+    bad = jnp.logical_not(
+        jnp.logical_and(jnp.isfinite(loss), jnp.isfinite(grad_norm)))
+    if spike_factor > 0:
+        spike = jnp.logical_and(
+            guard.good_steps > 0,
+            loss > jnp.float32(spike_factor) * guard.loss_ema)
+        bad = jnp.logical_or(bad, spike)
+    return bad
+
+
+def update_guard(guard: GuardState, loss: jnp.ndarray,
+                 anomalous: jnp.ndarray) -> GuardState:
+    """Advance the guard: strikes reset on any accepted step; the loss EMA
+    folds in accepted losses only (an anomalous loss must not drag the
+    baseline it is judged against)."""
+    anomalous_i = anomalous.astype(jnp.int32)
+    seeded = guard.good_steps > 0
+    folded = jnp.where(
+        seeded,
+        LOSS_EMA_DECAY * guard.loss_ema
+        + (1.0 - LOSS_EMA_DECAY) * loss.astype(jnp.float32),
+        loss.astype(jnp.float32))
+    return GuardState(
+        strikes=jnp.where(anomalous, guard.strikes + 1, 0).astype(jnp.int32),
+        anomalies=guard.anomalies + anomalous_i,
+        loss_ema=jnp.where(anomalous, guard.loss_ema, folded),
+        good_steps=guard.good_steps + (1 - anomalous_i),
+    )

@@ -16,8 +16,12 @@ import jax
 
 
 class MetricsLogger:
+    # anomalies/rollbacks: cumulative fault-tolerance counters (guard
+    # skips and checkpoint rollbacks, train/guard.py + trainer) — in the
+    # main CSV, not a side channel, so a recovered-from fault is visible in
+    # the same place the loss curve is (no silent recovery).
     HEADER = ["step", "loss", "grad_norm", "lr", "steps_per_sec",
-              "imgs_per_sec_per_chip"]
+              "imgs_per_sec_per_chip", "anomalies", "rollbacks"]
 
     def __init__(self, results_folder: str, use_tensorboard: bool = False):
         os.makedirs(results_folder, exist_ok=True)
@@ -58,9 +62,12 @@ class MetricsLogger:
         loss = float(metrics.get("loss", float("nan")))
         gnorm = float(metrics.get("grad_norm", float("nan")))
         lr = float(metrics.get("lr", float("nan")))
+        anomalies = int(metrics.get("anomalies", 0))
+        rollbacks = int(metrics.get("rollbacks", 0))
         self._csv.writerow([step, loss, gnorm, f"{lr:.3e}",
                             f"{steps_per_sec:.3f}",
-                            f"{imgs_per_sec_per_chip:.3f}"])
+                            f"{imgs_per_sec_per_chip:.3f}",
+                            anomalies, rollbacks])
         self._csv_file.flush()
         if self._tb is not None:
             import tensorflow as tf
@@ -103,6 +110,20 @@ class MetricsLogger:
                 for k in sorted(metrics):
                     tf.summary.scalar(f"eval/{k}", float(metrics[k]),
                                       step=step)
+
+    def log_event(self, step: int, kind: str, detail: str = "") -> None:
+        """Append a fault-tolerance event (anomaly, rollback, restore
+        fallback, save failure) to events.csv and echo it to the run log.
+        Rare by construction — opened per call, no handle to leak."""
+        path = os.path.join(os.path.dirname(self.csv_path), "events.csv")
+        new = not os.path.exists(path) or os.path.getsize(path) == 0
+        with open(path, "a", newline="") as fh:
+            w = csv.writer(fh)
+            if new:
+                w.writerow(["step", "event", "detail"])
+            w.writerow([step, kind, detail])
+        print(f"[fault] step {step}: {kind}"
+              + (f" ({detail})" if detail else ""), flush=True)
 
     def close(self) -> None:
         self._csv_file.close()

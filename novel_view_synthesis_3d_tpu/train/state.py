@@ -17,6 +17,10 @@ import jax.numpy as jnp
 import optax
 
 from novel_view_synthesis_3d_tpu.config import TrainConfig
+from novel_view_synthesis_3d_tpu.train.guard import (
+    GuardState,
+    init_guard_state,
+)
 
 
 @flax.struct.dataclass
@@ -26,6 +30,11 @@ class TrainState:
     opt_state: Any
     rng: jax.Array  # base key; per-step keys are fold_in(rng, step)
     ema_params: Optional[Any] = None
+    # Anomaly-guard bookkeeping (train/guard.py). Lives in the state so it
+    # (a) threads through the steps_per_dispatch fused scan as part of the
+    # carry and (b) survives checkpoint/restore. None when
+    # train.anomaly_guard is off.
+    guard: Optional[GuardState] = None
 
 
 def make_lr_schedule(cfg: TrainConfig):
@@ -156,6 +165,7 @@ def create_train_state(cfg: TrainConfig, model, sample_batch: dict,
             # (Trainer._host_ema) — no device copy at all.
             ema_params=(jax.tree.map(jnp.copy, params)
                         if cfg.ema_decay > 0 and not cfg.ema_host else None),
+            guard=init_guard_state() if cfg.anomaly_guard else None,
         )
 
     if on_cpu:

@@ -30,7 +30,9 @@ import optax
 from novel_view_synthesis_3d_tpu.config import Config
 from novel_view_synthesis_3d_tpu.diffusion.schedules import DiffusionSchedule
 from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+from novel_view_synthesis_3d_tpu.train import guard as guard_lib
 from novel_view_synthesis_3d_tpu.train.state import TrainState, make_optimizer
+from novel_view_synthesis_3d_tpu.utils import faultinject
 
 
 def effective_accum_steps(batch_size: int, data_shards: int,
@@ -123,6 +125,9 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
     if tcfg.loss_weighting != "none" and tcfg.loss != "mse":
         raise ValueError("loss_weighting requires loss='mse'")
     tx, lr_schedule = make_optimizer(tcfg, return_schedule=True)
+    # Fault injection (utils/faultinject.py): read at TRACE time — a clean
+    # build compiles no injection ops at all.
+    fi_nan_steps = faultinject.nan_loss_steps()
 
     def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
@@ -207,15 +212,45 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
             grads = jax.tree.map(
                 lambda g, p: (g / accum).astype(p.dtype),
                 grads, state.params)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        if fi_nan_steps:
+            # Injected fault: poison loss AND gradients at the armed steps,
+            # exactly what a numerically-blown forward/backward produces.
+            bad_step = jnp.isin(state.step,
+                                jnp.asarray(fi_nan_steps, jnp.int32))
+            loss = jnp.where(bad_step, jnp.float32(jnp.nan), loss)
+            grads = jax.tree.map(
+                lambda g: jnp.where(bad_step, jnp.asarray(jnp.nan, g.dtype),
+                                    g), grads)
 
-        ema_params = state.ema_params
-        if ema_params is not None:
-            d = tcfg.ema_decay
-            ema_params = jax.tree.map(
-                lambda e, p: e * d + p.astype(e.dtype) * (1.0 - d),
-                ema_params, params)
+        grad_norm = optax.global_norm(grads)
+
+        def apply_update(_):
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            ema_params = state.ema_params
+            if ema_params is not None:
+                d = tcfg.ema_decay
+                ema_params = jax.tree.map(
+                    lambda e, p: e * d + p.astype(e.dtype) * (1.0 - d),
+                    ema_params, params)
+            return params, opt_state, ema_params
+
+        new_guard = None
+        if state.guard is not None:
+            # Anomaly guard (train/guard.py): an anomalous step keeps
+            # params/opt-state/EMA bit-identical (lax.cond skips the whole
+            # update) and advances only the strike counters; step still
+            # increments so the fold_in-derived keys move on.
+            anomalous = guard_lib.detect_anomaly(
+                loss, grad_norm, state.guard, tcfg.loss_spike_factor)
+            params, opt_state, ema_params = jax.lax.cond(
+                anomalous,
+                lambda _: (state.params, state.opt_state, state.ema_params),
+                apply_update, None)
+            new_guard = guard_lib.update_guard(state.guard, loss, anomalous)
+        else:
+            params, opt_state, ema_params = apply_update(None)
 
         new_state = TrainState(
             step=state.step + 1,
@@ -223,13 +258,17 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
             opt_state=opt_state,
             rng=state.rng,
             ema_params=ema_params,
+            guard=new_guard,
         )
         lr = lr_schedule(state.step) if callable(lr_schedule) else lr_schedule
         metrics = {
             "loss": loss,
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
             "lr": jnp.asarray(lr, jnp.float32),
         }
+        if new_guard is not None:
+            metrics["anomalies"] = new_guard.anomalies.astype(jnp.float32)
+            metrics["strikes"] = new_guard.strikes.astype(jnp.float32)
         return new_state, metrics
 
     repl = mesh_lib.replicated(mesh)
@@ -257,6 +296,12 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         state, ms = jax.lax.scan(train_step, state, batches)
         out = jax.tree.map(lambda a: jnp.mean(a, axis=0), ms)
         out["lr"] = ms["lr"][-1]
+        # Guard counters are cumulative/positional, not window averages:
+        # the logger (and the rollback check) want the value AFTER the
+        # window's last step.
+        for k in ("anomalies", "strikes"):
+            if k in ms:
+                out[k] = ms[k][-1]
         return state, out
 
     return jax.jit(

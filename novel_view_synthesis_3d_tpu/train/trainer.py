@@ -33,9 +33,11 @@ from novel_view_synthesis_3d_tpu.models.xunet import XUNet
 from novel_view_synthesis_3d_tpu.parallel import dist, mesh as mesh_lib
 from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
 from novel_view_synthesis_3d_tpu.train.checkpoint import CheckpointManager
+from novel_view_synthesis_3d_tpu.train.guard import init_guard_state
 from novel_view_synthesis_3d_tpu.train.metrics import MetricsLogger
 from novel_view_synthesis_3d_tpu.train.state import create_train_state
 from novel_view_synthesis_3d_tpu.train.step import make_train_step
+from novel_view_synthesis_3d_tpu.utils import faultinject
 from novel_view_synthesis_3d_tpu.utils.images import save_image_grid
 from novel_view_synthesis_3d_tpu.utils.profiling import (
     StepTimer,
@@ -125,7 +127,8 @@ class Trainer:
                         prefetch_depth=config.data.prefetch,
                         seed=config.data.shuffle_seed,
                         shard_index=jax.process_index(),
-                        shard_count=jax.process_count())
+                        shard_count=jax.process_count(),
+                        max_record_retries=config.data.max_record_retries)
                     self.data_iter = iter(self._native_loader)
                 else:
                     backend = "grain"  # graceful fallback
@@ -201,18 +204,30 @@ class Trainer:
 
         # --- checkpointing / metrics ---
         self.ckpt = CheckpointManager(tcfg.checkpoint_dir)
+        # Fault-tolerance bookkeeping (docs/DESIGN.md "Fault tolerance"):
+        # rollback budget consumed + last anomaly total observed (to log
+        # each new anomaly exactly once).
+        self._rollbacks = 0
+        self._anomalies_seen = 0
         if tcfg.resume:
             restored = self.ckpt.restore(self._ckpt_state())
             if restored is not None:
-                if self._host_ema is not None:
-                    self._host_ema = jax.tree.map(
-                        np.asarray, restored.ema_params)
-                    self._host_ema_pending = False
-                    restored = restored.replace(ema_params=None)
-                self.state = jax.device_put(restored, self._state_sharding)
-                self._host_ema_step = int(jax.device_get(restored.step))
-                print(f"resumed from checkpoint at step {int(self.state.step)}")
+                restored = self._adopt_restored_state(restored)
+                # Restore provenance line: which step actually resumed, and
+                # whether corrupt newer steps were walked past.
+                prov = self.ckpt.last_restore or {}
+                rejected = prov.get("rejected", [])
+                fallback = (f" (fell back past corrupt step(s) "
+                            f"{[s for s, _ in rejected]})" if rejected
+                            else "")
+                print(f"resumed from checkpoint at step "
+                      f"{int(self.state.step)}{fallback}")
         self.metrics = MetricsLogger(tcfg.results_folder)
+        prov = self.ckpt.last_restore or {}
+        for bad_step, reason in prov.get("rejected", []):
+            self.metrics.log_event(
+                int(prov["step"]), "restore_fallback",
+                f"step {bad_step} rejected: {reason.splitlines()[0][:160]}")
         self.results_folder = tcfg.results_folder
         os.makedirs(self.results_folder, exist_ok=True)
         # units_per_measure: each measured region covers one dispatch, i.e.
@@ -291,6 +306,93 @@ class Trainer:
         if self._host_ema is None:
             return self.state
         return self.state.replace(ema_params=self._host_ema)
+
+    def _adopt_restored_state(self, restored):
+        """Install a checkpoint-restored TrainState (resume or rollback):
+        peel the host-EMA tree back into host RAM, shard the rest onto the
+        mesh, and re-anchor the sparse-EMA step counter.
+
+        The restored leaves are explicitly COPIED before the donating train
+        step may consume them: on the CPU backend Orbax/tensorstore can
+        hand back arrays aliasing its own restore buffers, and jit
+        donation then writes outputs into that shared memory — observed as
+        garbage step counters right after a rollback (fault-injection
+        suite). jnp.copy is cheap next to the restore IO and guarantees
+        the state owns its buffers on every backend."""
+        if self._host_ema is not None:
+            self._host_ema = jax.tree.map(np.asarray, restored.ema_params)
+            self._host_ema_pending = False
+            restored = restored.replace(ema_params=None)
+        owned = jax.tree.map(
+            lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a,
+            restored)
+        self.state = jax.device_put(owned, self._state_sharding)
+        self._host_ema_step = int(jax.device_get(restored.step))
+        return restored
+
+    def _rollback(self, step_now: int) -> None:
+        """Anomaly-guard escalation: restore the last intact checkpoint.
+
+        Fired when `max_anomaly_strikes` consecutive steps were anomalous —
+        the skipped-update guard alone isn't recovering, so the optimizer
+        state (or the data window) is presumed poisoned. The restored state
+        gets a RESEEDED rng (same rng + same step would replay the exact
+        t/ε/dropout draws that blew up) and a cleared guard; the data
+        stream simply continues — the replayed steps see fresh batches.
+        Bounded by `max_rollbacks`, then abort: past that point the fault
+        is systematic and retrying only burns pod-hours."""
+        tcfg = self.config.train
+        self._rollbacks += 1
+        self.metrics.log_event(
+            step_now, "rollback",
+            f"{tcfg.max_anomaly_strikes} consecutive anomalies; attempt "
+            f"{self._rollbacks}/{tcfg.max_rollbacks}")
+        if self._rollbacks > tcfg.max_rollbacks:
+            raise RuntimeError(
+                f"anomaly guard: {tcfg.max_anomaly_strikes} consecutive "
+                f"anomalous steps at step {step_now} and the rollback "
+                f"budget (train.max_rollbacks={tcfg.max_rollbacks}) is "
+                "exhausted — aborting. Inspect metrics.csv/events.csv; "
+                "likely a systematic fault (bad data shard, lr blow-up), "
+                "not a transient.")
+        self.ckpt.wait()
+        restored = self.ckpt.restore(self._ckpt_state())
+        if restored is None:
+            raise RuntimeError(
+                f"anomaly guard: rollback requested at step {step_now} but "
+                "no checkpoint exists yet (train.save_every="
+                f"{tcfg.save_every}) — aborting before the anomaly "
+                "propagates")
+        restored = restored.replace(
+            rng=jax.random.fold_in(restored.rng, 0x5EED + self._rollbacks),
+            guard=(init_guard_state() if restored.guard is not None
+                   else None))
+        self._adopt_restored_state(restored)
+        self._anomalies_seen = 0
+        self._device_batch = None  # drop the prefetched (suspect) batch
+        self.metrics.log_event(
+            self.step, "rollback_restored",
+            f"resumed at step {self.step} with reseeded rng")
+
+    def _check_guard(self, step_now: int, step_metrics: dict) -> bool:
+        """Host-side half of the anomaly guard: log new anomalies, roll
+        back when strikes exceed the budget. Returns True if a rollback
+        happened (the loop should restart its iteration)."""
+        tcfg = self.config.train
+        if not tcfg.anomaly_guard or "strikes" not in step_metrics:
+            return False
+        strikes, anomalies = (int(v) for v in jax.device_get(
+            [step_metrics["strikes"], step_metrics["anomalies"]]))
+        if anomalies > self._anomalies_seen:
+            self.metrics.log_event(
+                step_now, "anomaly",
+                f"non-finite/spike step skipped (strikes={strikes}, "
+                f"total={anomalies})")
+            self._anomalies_seen = anomalies
+        if strikes >= tcfg.max_anomaly_strikes:
+            self._rollback(step_now)
+            return True
+        return False
 
     def _maybe_update_host_ema(self, step_now: int,
                                force: bool = False) -> None:
@@ -392,6 +494,9 @@ class Trainer:
                 # the timed region so timings reflect real device time.
                 step_now = self.step
 
+            if self._check_guard(step_now, step_metrics):
+                continue  # rolled back: restart the loop from the restore
+
             self._maybe_update_host_ema(step_now)
 
             # First-iteration log: step_now is 1 normally, K under fused
@@ -399,7 +504,10 @@ class Trainer:
             if (step_now % tcfg.log_every == 0
                     or step_now == tcfg.steps_per_dispatch):
                 logged = self.metrics.log(
-                    step_now, jax.device_get(step_metrics), tcfg.batch_size)
+                    step_now,
+                    dict(jax.device_get(step_metrics),
+                         rollbacks=self._rollbacks),
+                    tcfg.batch_size)
                 print(f"{step_now}: loss={logged['loss']:.5f} "
                       f"imgs/s/chip={logged['imgs_per_sec_per_chip']:.2f}")
                 last_metrics = logged
@@ -435,6 +543,11 @@ class Trainer:
                     # is the difference between the next step fitting HBM
                     # and an OOM (VERDICT r4 item 8).
                     self._release_probe_params(probe_params)
+
+            # Fault-injection SIGTERM drill (env-gated, inert otherwise):
+            # fires here so the flag is observed by the agreement check
+            # below within the same iteration.
+            faultinject.maybe_sigterm(step_now)
 
             if self._preempt_agreed():
                 print(f"preemption signal received at step {step_now}: "
@@ -507,14 +620,23 @@ class Trainer:
 
         No-op when the probe handed out the live state trees themselves
         (single-process, probe_dtype unset) — only a distinct pinned copy
-        is deleted."""
+        is deleted. Guarded PER LEAF, not just per tree (ADVICE r5):
+        jnp.asarray(a, dtype) is a no-copy alias when a leaf already has
+        the target dtype, so a future mixed-dtype param tree could hand
+        out a tree that fails the tree-level 'is' check while some of its
+        leaves ARE the live training buffers — deleting those would kill
+        the run."""
         if probe_params is None:
             return
         if (probe_params is self.state.params
                 or probe_params is self.state.ema_params):
             return
+        live = set()
+        for tree in (self.state.params, self.state.ema_params):
+            if tree is not None:
+                live.update(id(leaf) for leaf in jax.tree.leaves(tree))
         for leaf in jax.tree.leaves(probe_params):
-            if hasattr(leaf, "delete"):
+            if id(leaf) not in live and hasattr(leaf, "delete"):
                 leaf.delete()
 
     def _held_out_probe_batch(self, folder: str):

@@ -1,0 +1,127 @@
+"""Deterministic fault injection for the fault-tolerance subsystem.
+
+Every recovery path in the training stack (train/guard.py anomaly guard,
+train/checkpoint.py integrity fallback, data/srn.py record quarantine,
+trainer SIGTERM drill) is exercised by injecting the fault it recovers
+from, on CPU, in tier-1 tests (tests/test_fault_injection.py). Injection
+points are env-driven so a test — or a chaos drill on a real pod — can arm
+them without touching config files; with no NVS3D_FI_* variable set, every
+hook is inert and the hot path pays nothing (the NaN-loss hook is read at
+TRACE time, so a clean build contains no injection ops at all).
+
+Injection points:
+
+  NVS3D_FI_NAN_LOSS_AT      comma list of global steps; the jitted train
+                            step overwrites loss AND gradients with NaN at
+                            those steps (read when make_train_step traces —
+                            set it before the Trainer is built).
+  NVS3D_FI_RAISE_ON_RECORD  comma list of flat record indices;
+                            SRNDataset.pair raises InjectedFault for them
+                            (read per call).
+  NVS3D_FI_SIGTERM_AT       single step; the Trainer sends itself SIGTERM
+                            when the loop reaches it (read per call).
+
+plus `truncate_checkpoint`, a direct helper that corrupts an on-disk Orbax
+step the way a mid-write preemption does (the checkpoint-fallback drill).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import List, Optional, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the injection harness (never by real code)."""
+
+
+def _int_list(env: str) -> Tuple[int, ...]:
+    raw = os.environ.get(env, "").strip()
+    if not raw:
+        return ()
+    try:
+        return tuple(int(v) for v in raw.split(",") if v.strip())
+    except ValueError as e:
+        raise ValueError(f"{env}={raw!r} must be a comma list of ints") from e
+
+
+def nan_loss_steps() -> Tuple[int, ...]:
+    """Steps whose loss/grads the train step poisons (trace-time read)."""
+    return _int_list("NVS3D_FI_NAN_LOSS_AT")
+
+
+def record_fault_indices() -> Tuple[int, ...]:
+    return _int_list("NVS3D_FI_RAISE_ON_RECORD")
+
+
+def maybe_raise_record(flat_idx: int) -> None:
+    """Hook for SRNDataset.pair: raise for records armed via env."""
+    if flat_idx in record_fault_indices():
+        raise InjectedFault(
+            f"injected data fault at record {flat_idx} "
+            "(NVS3D_FI_RAISE_ON_RECORD)")
+
+
+def sigterm_step() -> Optional[int]:
+    steps = _int_list("NVS3D_FI_SIGTERM_AT")
+    return steps[0] if steps else None
+
+
+def maybe_sigterm(step: int) -> bool:
+    """Hook for the Trainer loop: deliver SIGTERM to this process at the
+    armed step (the preemption drill). Returns True if the signal fired."""
+    at = sigterm_step()
+    if at is not None and step >= at:
+        os.kill(os.getpid(), signal.SIGTERM)
+        # One shot: clear so the rescheduled (resumed) run isn't re-killed.
+        os.environ.pop("NVS3D_FI_SIGTERM_AT", None)
+        return True
+    return False
+
+
+def armed() -> List[str]:
+    """Names of the NVS3D_FI_* variables currently set (for loud logging:
+    a production entry point should refuse to run silently with faults
+    armed)."""
+    return sorted(k for k in os.environ
+                  if k.startswith("NVS3D_FI_") and os.environ[k].strip())
+
+
+def truncate_checkpoint(directory: str, step: Optional[int] = None,
+                        keep_bytes: int = 16) -> List[str]:
+    """Corrupt an on-disk Orbax checkpoint step like a torn write would.
+
+    Truncates every regular file under the step directory to `keep_bytes`
+    (metadata and array data alike), which is what a host dying mid-save
+    leaves behind. Returns the corrupted paths. `step=None` corrupts the
+    NEWEST step dir — the auto-resume target, i.e. the worst case the
+    fallback restore must handle.
+    """
+    directory = os.path.abspath(directory)
+    step_dirs = sorted(
+        (int(d), os.path.join(directory, d))
+        for d in os.listdir(directory) if d.isdigit())
+    if not step_dirs:
+        raise FileNotFoundError(f"no checkpoint steps under {directory!r}")
+    if step is None:
+        _, target = step_dirs[-1]
+    else:
+        matches = [p for s, p in step_dirs if s == step]
+        if not matches:
+            raise FileNotFoundError(
+                f"no step {step} under {directory!r} "
+                f"(have {[s for s, _ in step_dirs]})")
+        target = matches[0]
+    corrupted = []
+    for root, _, files in os.walk(target):
+        for fn in files:
+            path = os.path.join(root, fn)
+            try:
+                size = os.path.getsize(path)
+                with open(path, "r+b") as fh:
+                    fh.truncate(min(size, keep_bytes))
+                corrupted.append(path)
+            except OSError:
+                continue
+    return corrupted

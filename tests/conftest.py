@@ -47,6 +47,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running (subprocess pod dryruns, e2e "
                    "trainer runs, heavyweight step variants)")
+    config.addinivalue_line(
+        "markers", "faultinject: deterministic fault-injection recovery "
+                   "drills (utils/faultinject.py) — tier-1-safe, CPU-only; "
+                   "run alone with -m faultinject")
 
 
 def pytest_addoption(parser):

@@ -81,6 +81,14 @@ def main() -> int:
     for i in range(n_inst):
         inst = val.instances[i]
         name = os.path.basename(os.path.normpath(inst.instance_dir))
+        if name not in by_name:
+            raise SystemExit(
+                f"val instance {name!r} has no counterpart in the train "
+                "tree: the floor baselines (per-instance mean image, "
+                "nearest-pose train view) are only defined for PER-VIEW "
+                "splits where every instance appears in both trees (e.g. "
+                "quality_run's split-object layout). A per-instance split "
+                "cannot be floor-analyzed with this tool.")
         tr = by_name[name]
         tr_views = [tr.view(v) for v in range(len(tr))]
         mean_img = np.mean([img for img, _ in tr_views], axis=0)

@@ -37,6 +37,41 @@ def fmt(v) -> str:
     return str(v)
 
 
+def recovery_rows(search_dirs):
+    """(path, anomalies, rollbacks) per metrics.csv with recovery events.
+
+    The trainer logs cumulative anomaly-guard skips and checkpoint
+    rollbacks as metrics.csv columns (train/metrics.py) — a bench or
+    quality number produced by a run that silently recovered from faults
+    must say so next to the number. Pre-fault-tolerance CSVs (no such
+    columns) read as zero.
+    """
+    import csv
+    import glob
+
+    rows = []
+    seen = set()
+    for d in search_dirs:
+        for path in sorted(glob.glob(os.path.join(d, "**", "metrics.csv"),
+                                     recursive=True)):
+            if path in seen:
+                continue
+            seen.add(path)
+            anomalies = rollbacks = 0
+            try:
+                with open(path, newline="") as fh:
+                    for row in csv.DictReader(fh):
+                        anomalies = max(anomalies,
+                                        int(float(row.get("anomalies") or 0)))
+                        rollbacks = max(rollbacks,
+                                        int(float(row.get("rollbacks") or 0)))
+            except (OSError, ValueError):
+                continue
+            if anomalies or rollbacks:
+                rows.append((path, anomalies, rollbacks))
+    return rows
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     out_dir = args[0] if args else os.path.join("results", "tpu_r04")
@@ -66,6 +101,20 @@ def main() -> int:
                 "| {} | {} | {} | {} | | {} | |".format(
                     qdir, s.get("metric"), fmt(s.get("value")),
                     s.get("unit"), s.get("platform")))
+    # Recovery events: every training metrics.csv under the bench dir (and
+    # the quality sibling dirs) that recorded anomaly-guard skips or
+    # checkpoint rollbacks. "none" is an explicit claim, not silence.
+    quality_dirs = ([os.path.join("results", d) for d in os.listdir("results")
+                     if d.startswith("quality_tpu")]
+                    if os.path.isdir("results") else [])
+    recov = recovery_rows([out_dir] + quality_dirs)
+    lines += ["", "## Recovery events (anomaly guard / rollbacks)", ""]
+    if recov:
+        for path, anomalies, rollbacks in recov:
+            lines.append(f"- `{path}`: anomalies={anomalies} "
+                         f"rollbacks={rollbacks}")
+    else:
+        lines.append("- none recorded")
     text = "\n".join(lines) + "\n"
     print(text)
     if "--write" in sys.argv:
