@@ -33,7 +33,7 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache, ON by default at a repo-local path: the
 # driver's bench budget cannot absorb a cold paper256/base128 XLA compile
-# through the tunnel, so warm-up runs (tools/tpu_bench_watch_r3.py) populate
+# through the tunnel, so warm-up runs (tools/tpu_bench_watch.py) populate
 # this dir and the judged `python bench.py` reuses the compiled executables.
 CACHE_DIR = os.environ.get(
     "JAX_COMPILATION_CACHE_DIR",
@@ -539,66 +539,39 @@ def bench_profile(preset_name: str, steps: int, overrides=(),
 
 
 def _require_live_backend() -> None:
-    """Probe the default backend with retry/backoff; hard-fail if dead.
+    """Bounded backend reachability gate; hard-fail (rc=3) if dead.
 
-    The remote-accelerator tunnel can wedge (observed: jax.devices() blocks
-    forever after a tunnel outage). Round 1/2 postmortem: a single 120s
-    probe followed by a silent CPU fallback produced either a meaningless
-    CPU number (BENCH_r01) or a driver timeout on the slow CPU path
-    (BENCH_r02, rc=124). So now: probe in short disposable subprocesses,
-    RETRYING across the budget (the tunnel recovers in bursts), and if the
-    budget is exhausted exit non-zero with a clear message — a missing
-    number is honest, a CPU number labeled as the bench is not.
-
-    Knobs: NVS3D_PROBE_BUDGET_S (total, default 360), NVS3D_PROBE_TRY_S
-    (per attempt, default 90). Explicit JAX_PLATFORMS=cpu skips the probe
-    (CPU was requested); NVS3D_BENCH_ALLOW_CPU=1 restores the old fallback
-    for debugging.
+    The probe/retry machinery lives in parallel/dist.require_backend
+    (promoted there so cli train/sample/eval and the tools watcher share
+    it — round 1/2 postmortem: the remote-accelerator tunnel can wedge
+    such that jax.devices() blocks forever, and a single probe followed
+    by a silent CPU fallback produced either a meaningless CPU number
+    (BENCH_r01) or a driver timeout on the slow CPU path (BENCH_r02,
+    rc=124)). The bench keeps a LONGER default budget than the CLI
+    (NVS3D_PROBE_BUDGET_S, default 360 s) because the tunnel recovers in
+    bursts and a missing bench number costs a whole round; the exit is
+    still structured (dist.EXIT_BACKEND_UNREACHABLE + reason line), never
+    a silent hang. NVS3D_BENCH_ALLOW_CPU=1 restores the explicit CPU
+    fallback for debugging.
     """
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         return
-    import subprocess
+    from novel_view_synthesis_3d_tpu.parallel import dist
 
-    budget_s = float(os.environ.get("NVS3D_PROBE_BUDGET_S", "360"))
-    try_s = float(os.environ.get("NVS3D_PROBE_TRY_S", "90"))
-    deadline = time.monotonic() + budget_s
-    attempt = 0
-    while True:
-        attempt += 1
-        # A real tiny computation with a host fetch: a wedged tunnel has
-        # been observed passing backend init (jax.devices) yet hanging on
-        # the first execution. Popen.wait(timeout) + abandon-on-stuck: a
-        # child in uninterruptible tunnel IO survives SIGKILL until its
-        # syscall returns, and run() would block forever reaping it.
-        proc = subprocess.Popen(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp; "
-             "print(float(jnp.ones((8, 8)).sum()))"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        remaining = deadline - time.monotonic()
-        try:
-            if proc.wait(timeout=min(try_s, max(5.0, remaining))) == 0:
-                return
-        except subprocess.TimeoutExpired:
-            proc.kill()  # best effort; deliberately not reaped (see above)
-        if time.monotonic() >= deadline:
-            break
-        print(f"note: backend probe attempt {attempt} failed; retrying "
-              f"({deadline - time.monotonic():.0f}s of budget left)",
+    try:
+        dist.require_backend(default_budget_s=360.0)
+    except SystemExit:
+        if os.environ.get("NVS3D_BENCH_ALLOW_CPU") == "1":
+            print("warning: backend unreachable; NVS3D_BENCH_ALLOW_CPU=1 — "
+                  "falling back to CPU (NOT a device benchmark)",
+                  file=sys.stderr)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            jax.config.update("jax_platforms", "cpu")
+            return
+        print("error: refusing to emit a CPU number for a device "
+              "benchmark. Set NVS3D_BENCH_ALLOW_CPU=1 to override.",
               file=sys.stderr)
-        time.sleep(min(10.0, max(0.0, deadline - time.monotonic())))
-    if os.environ.get("NVS3D_BENCH_ALLOW_CPU") == "1":
-        print("warning: backend unreachable; NVS3D_BENCH_ALLOW_CPU=1 — "
-              "falling back to CPU (NOT a device benchmark)",
-              file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        jax.config.update("jax_platforms", "cpu")
-        return
-    print(f"error: default backend unreachable within {budget_s:.0f}s "
-          f"({attempt} probe attempts); refusing to emit a CPU number for "
-          "a device benchmark. Set NVS3D_BENCH_ALLOW_CPU=1 to override.",
-          file=sys.stderr)
-    raise SystemExit(3)
+        raise
 
 
 def main():

@@ -58,7 +58,6 @@ def _split_overrides(rest: List[str]) -> List[str]:
 # train
 # ---------------------------------------------------------------------------
 def cmd_train(args, overrides: List[str]) -> int:
-    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
     from novel_view_synthesis_3d_tpu.utils import faultinject
 
     armed = faultinject.armed()
@@ -73,8 +72,35 @@ def cmd_train(args, overrides: List[str]) -> int:
     cfg = build_config(args, overrides)
     if args.folder:
         cfg = cfg.override(**{"data.root_dir": args.folder})
+
+    if getattr(args, "supervise", False):
+        # Supervisor mode: hold no JAX state in THIS process (it must stay
+        # responsive while a child wedges); the child runs the same train
+        # command minus --supervise and is restarted on crash or stall.
+        from novel_view_synthesis_3d_tpu.train.supervisor import (
+            supervise, train_child_argv)
+
+        return supervise(
+            train_child_argv(args, overrides),
+            results_folder=cfg.train.results_folder,
+            max_restarts=cfg.train.max_restarts)
+
+    # Fail fast on an unreachable backend: a structured sub-60s diagnosis
+    # (exit code 3 + reason line) instead of a silent hang inside the
+    # first jax call (BENCH_r0* postmortems). CPU runs skip the probe.
+    from novel_view_synthesis_3d_tpu.parallel import dist
+    from novel_view_synthesis_3d_tpu.utils.watchdog import EXIT_STALL
+
+    dist.require_backend()
+
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
     trainer = Trainer(config=cfg, use_grain=not args.no_grain)
     trainer.train()
+    if trainer.stalled:
+        # Distinct exit code: the supervisor (or any operator tooling)
+        # can tell "completed" from "checkpointed and bailed on a stall".
+        return EXIT_STALL
     return 0
 
 
@@ -127,6 +153,10 @@ def _restore_params(cfg: Config, model, sample_batch: dict, step: Optional[int],
 
 
 def cmd_sample(args, overrides: List[str]) -> int:
+    from novel_view_synthesis_3d_tpu.parallel import dist
+
+    dist.require_backend()  # sub-60s structured failure on a dead tunnel
+
     import jax
     import jax.numpy as jnp
 
@@ -266,6 +296,10 @@ def cmd_sample(args, overrides: List[str]) -> int:
 # eval
 # ---------------------------------------------------------------------------
 def cmd_eval(args, overrides: List[str]) -> int:
+    from novel_view_synthesis_3d_tpu.parallel import dist
+
+    dist.require_backend()  # sub-60s structured failure on a dead tunnel
+
     import jax
 
     from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
@@ -432,6 +466,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="SRN dataset root (overrides data.root_dir)")
     p.add_argument("--no-grain", action="store_true",
                    help="in-process data loading (no worker processes)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run training in a supervised child process: "
+                        "restart on crash or watchdog-declared stall with "
+                        "exponential backoff (train.max_restarts), "
+                        "resuming from the newest intact checkpoint")
 
     p = sub.add_parser("sample",
                        help="sample novel views (reference sampling.py, PNGs "

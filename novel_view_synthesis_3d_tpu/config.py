@@ -147,6 +147,40 @@ class DataConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class WatchdogConfig:
+    """Hang/stall watchdog (utils/watchdog.py; docs/DESIGN.md "Stall
+    recovery"). Budgets are wall-clock seconds a single armed phase may
+    run before the watchdog declares a stall, dumps a diagnosis bundle
+    (all-thread stacks, heartbeat ages, device memory if reachable), logs
+    a `stall` row in events.csv, and escalates. Compile budgets are
+    separate from steady-state step budgets: the first dispatch of a jitted
+    program legitimately takes minutes (remote-tunnel XLA compiles have
+    been observed at 30+ min at base128), while a steady-state step that
+    takes 10 minutes is a wedged backend. Defaults are generous on purpose
+    — the watchdog exists to catch the hour-scale silent hangs that have
+    eaten whole bench rounds (BENCH_r0* rc=3, the 2400 s base128 sampling
+    stall), not to police slow steps."""
+
+    enabled: bool = True
+    # Monitor thread poll interval. Stall detection latency is one
+    # interval past the budget; the thread is asleep otherwise.
+    check_interval_s: float = 2.0
+    # Per-phase budgets (seconds). A phase is armed while the trainer is
+    # inside it; 0 disables that phase's deadline.
+    data_fetch_s: float = 600.0
+    step_s: float = 600.0
+    compile_s: float = 3600.0  # first dispatch of each jitted program
+    checkpoint_save_s: float = 900.0
+    eval_s: float = 1800.0
+    # Hard-exit grace: if an armed phase is STILL stuck this many seconds
+    # AFTER its budget expired (the main thread never came back to observe
+    # the soft stall flag — a true wedge, e.g. uninterruptible tunnel IO),
+    # the monitor thread dumps a final diagnosis and os._exit()s with
+    # EXIT_STALL so a supervisor can restart the host. 0 = disabled.
+    hard_exit_s: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """Training loop options (reference: train.py:82-126)."""
 
@@ -270,6 +304,15 @@ class TrainConfig:
     # Rollback budget: after this many rollbacks the run aborts loudly
     # instead of thrashing between a poisoned basin and the checkpoint.
     max_rollbacks: int = 2
+    # --- hang/stall robustness (docs/DESIGN.md "Stall recovery") ---
+    # Heartbeat watchdog over the run's phases (utils/watchdog.py).
+    watchdog: WatchdogConfig = dataclasses.field(
+        default_factory=WatchdogConfig)
+    # `nvs3d train --supervise` restart budget: the supervisor restarts a
+    # crashed or watchdog-stalled child (resuming via the checkpoint
+    # integrity walk-back) at most this many times, with exponential
+    # backoff, then gives up loudly.
+    max_restarts: int = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -470,6 +513,20 @@ class Config:
             errors.append(
                 f"data.max_record_retries={d.max_record_retries} must be "
                 ">= 0")
+        if t.max_restarts < 0:
+            errors.append(
+                f"train.max_restarts={t.max_restarts} must be >= 0")
+        wd = t.watchdog
+        if wd.check_interval_s <= 0:
+            errors.append(
+                f"train.watchdog.check_interval_s={wd.check_interval_s} "
+                "must be > 0")
+        for nm in ("data_fetch_s", "step_s", "compile_s",
+                   "checkpoint_save_s", "eval_s", "hard_exit_s"):
+            if getattr(wd, nm) < 0:
+                errors.append(
+                    f"train.watchdog.{nm}={getattr(wd, nm)} must be >= 0 "
+                    "(0 disables that deadline)")
         for axis in ("model", "seq"):
             if getattr(self.mesh, axis) < 1:
                 errors.append(f"mesh.{axis} must be >= 1")
@@ -496,7 +553,15 @@ class Config:
             for k, v in sub.items():
                 if k not in fields:
                     raise KeyError(f"unknown config field {tp.__name__}.{k}")
-                if isinstance(v, list):
+                ftype = fields[k].type
+                if isinstance(ftype, str):  # from __future__ annotations
+                    ftype = globals().get(ftype, ftype)
+                if dataclasses.is_dataclass(ftype) and isinstance(v, dict):
+                    # Nested sub-config (e.g. TrainConfig.watchdog): rebuild
+                    # the dataclass so dotted overrides round-trip through
+                    # to_dict() without degrading the field to a plain dict.
+                    v = build(ftype, v)
+                elif isinstance(v, list):
                     v = tuple(v)
                 kwargs[k] = v
             return tp(**kwargs)

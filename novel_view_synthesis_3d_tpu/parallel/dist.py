@@ -9,9 +9,19 @@ single-host (or under tests) this is a no-op.
 from __future__ import annotations
 
 import os
-from typing import Optional
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
 
 import jax
+
+# Structured exit code for "the accelerator backend never answered" —
+# historically the code bench.py exits with (BENCH_r0* rc=3), now shared by
+# every entry point that probes (cli train/sample/eval, bench, watchers).
+# Distinct from utils/watchdog.EXIT_STALL (74): unreachable-at-startup and
+# stalled-mid-run are different diagnoses.
+EXIT_BACKEND_UNREACHABLE = 3
 
 
 def _is_initialized() -> bool:
@@ -54,6 +64,102 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
             num_processes=num_processes,
             process_id=process_id,
         )
+
+
+def probe_backend(timeout_s: float = 45.0,
+                  require_accelerator: bool = False,
+                  env: Optional[dict] = None) -> Tuple[bool, str]:
+    """Bounded reachability probe of the default JAX backend.
+
+    Runs a REAL tiny computation with a host fetch in a DISPOSABLE child
+    process (promoted from bench.py/tools: a wedged remote-accelerator
+    tunnel has been observed passing backend init yet hanging on the first
+    execution, and a process stuck in that IO enters uninterruptible sleep
+    — SIGKILL doesn't reap it until the syscall returns, so the child is
+    abandoned, never reaped in-process). Returns (ok, reason); never
+    raises, never hangs past ~timeout_s.
+
+    `require_accelerator=True` additionally rejects a probe that answered
+    on CPU (the watcher semantics: CPU output is not TPU evidence).
+    `env` overrides the child's environment (e.g. the tools watcher pops
+    JAX_PLATFORMS so an ambient CPU pin doesn't shadow the accelerator).
+
+    Drill hooks (tier-1 tests exercise the full Popen/timeout machinery
+    without a real tunnel): NVS3D_FI_PROBE_HANG=1 makes the child sleep
+    forever, NVS3D_FI_PROBE_FAIL=1 makes it exit non-zero.
+    """
+    if os.environ.get("NVS3D_FI_PROBE_HANG") == "1":
+        code = "import time; time.sleep(3600)"
+    elif os.environ.get("NVS3D_FI_PROBE_FAIL") == "1":
+        code = "import sys; sys.exit(1)"
+    else:
+        code = ("import jax, jax.numpy as jnp; "
+                "print(float(jnp.ones((8, 8)).sum()), "
+                "jax.devices()[0].platform)")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = proc.communicate(timeout=max(1.0, timeout_s))
+    except subprocess.TimeoutExpired:
+        proc.kill()  # best effort; deliberately not reaped (see above)
+        return False, f"probe timed out after {timeout_s:.0f}s (backend " \
+                      "wedged: computation never returned)"
+    out = (out or "").strip()
+    if proc.returncode != 0:
+        return False, f"probe exited rc={proc.returncode}"
+    if require_accelerator and "cpu" in out:
+        return False, f"probe answered on CPU ({out!r}), not an accelerator"
+    return True, out
+
+
+def require_backend(budget_s: Optional[float] = None,
+                    try_s: Optional[float] = None,
+                    default_budget_s: float = 45.0,
+                    require_accelerator: bool = False) -> None:
+    """probe_backend with retry across a budget; SystemExit(3) if dead.
+
+    The structured replacement for the 360 s+ silent hangs of BENCH_r01-r05:
+    an unreachable backend becomes a sub-minute (at the default budget)
+    diagnosis — one reason line on stderr plus exit code
+    EXIT_BACKEND_UNREACHABLE — instead of a wedged process an external
+    watcher has to kill. Retries within the budget because the tunnel has
+    been observed recovering in bursts.
+
+    Knobs: NVS3D_PROBE_BUDGET_S (total; default `default_budget_s`),
+    NVS3D_PROBE_TRY_S (per attempt, default min(45, budget)). An explicit
+    JAX_PLATFORMS=cpu skips the probe entirely — CPU was requested and is
+    always reachable.
+    """
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return
+    if budget_s is None:
+        budget_s = float(os.environ.get("NVS3D_PROBE_BUDGET_S",
+                                        default_budget_s))
+    if try_s is None:
+        try_s = float(os.environ.get("NVS3D_PROBE_TRY_S",
+                                     min(45.0, budget_s)))
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    reason = "no probe attempted"
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        ok, reason = probe_backend(min(try_s, max(5.0, remaining)),
+                                   require_accelerator=require_accelerator)
+        if ok:
+            return
+        if time.monotonic() >= deadline:
+            break
+        print(f"note: backend probe attempt {attempt} failed ({reason}); "
+              f"retrying ({deadline - time.monotonic():.0f}s of budget "
+              "left)", file=sys.stderr)
+        time.sleep(min(10.0, max(0.0, deadline - time.monotonic())))
+    print(f"error: default backend unreachable within {budget_s:.0f}s "
+          f"({attempt} probe attempt(s); last: {reason}). Set "
+          "JAX_PLATFORMS=cpu for a CPU run, or fix the accelerator "
+          "tunnel.", file=sys.stderr)
+    raise SystemExit(EXIT_BACKEND_UNREACHABLE)
 
 
 def process_shard(n: int) -> tuple[int, int]:

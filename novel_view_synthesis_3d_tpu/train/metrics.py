@@ -16,12 +16,13 @@ import jax
 
 
 class MetricsLogger:
-    # anomalies/rollbacks: cumulative fault-tolerance counters (guard
-    # skips and checkpoint rollbacks, train/guard.py + trainer) — in the
-    # main CSV, not a side channel, so a recovered-from fault is visible in
-    # the same place the loss curve is (no silent recovery).
+    # anomalies/rollbacks/restarts: cumulative fault-tolerance counters
+    # (guard skips, checkpoint rollbacks, supervised restarts —
+    # train/guard.py + trainer + train/supervisor.py) — in the main CSV,
+    # not a side channel, so a recovered-from fault is visible in the same
+    # place the loss curve is (no silent recovery).
     HEADER = ["step", "loss", "grad_norm", "lr", "steps_per_sec",
-              "imgs_per_sec_per_chip", "anomalies", "rollbacks"]
+              "imgs_per_sec_per_chip", "anomalies", "rollbacks", "restarts"]
 
     def __init__(self, results_folder: str, use_tensorboard: bool = False):
         os.makedirs(results_folder, exist_ok=True)
@@ -64,10 +65,11 @@ class MetricsLogger:
         lr = float(metrics.get("lr", float("nan")))
         anomalies = int(metrics.get("anomalies", 0))
         rollbacks = int(metrics.get("rollbacks", 0))
+        restarts = int(metrics.get("restarts", 0))
         self._csv.writerow([step, loss, gnorm, f"{lr:.3e}",
                             f"{steps_per_sec:.3f}",
                             f"{imgs_per_sec_per_chip:.3f}",
-                            anomalies, rollbacks])
+                            anomalies, rollbacks, restarts])
         self._csv_file.flush()
         if self._tb is not None:
             import tensorflow as tf

@@ -1,0 +1,149 @@
+"""Supervised training: restart a crashed or stalled child run.
+
+`nvs3d train --supervise` wraps the actual training run in a child
+process and restarts it on ANY abnormal exit — a crash (non-zero rc,
+signal death) or a watchdog-declared stall (utils/watchdog.EXIT_STALL,
+the soft checkpoint-and-exit or the monitor's hard exit) — with
+exponential backoff, bounded by `train.max_restarts`. Each restart
+resumes via the Trainer's auto-resume + PR 1's checkpoint-integrity
+walk-back, so the run continues from the newest INTACT checkpoint even
+when the fault tore the latest one.
+
+The supervisor deliberately holds no JAX state: it must stay alive and
+responsive while the child wedges on a dead backend. Restart provenance
+is durable — every restart appends a `supervised_restart` row to the
+run's events.csv (step -1 = "outside the step loop"), and the child is
+told its restart generation via NVS3D_SUPERVISED_RESTARTS so the
+`restarts` column lands in metrics.csv next to the loss curve
+(tools/summarize_bench.py surfaces both).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+RESTART_ENV = "NVS3D_SUPERVISED_RESTARTS"
+
+
+def log_event(results_folder: str, kind: str, detail: str = "") -> None:
+    """events.csv append, schema-compatible with MetricsLogger.log_event
+    but standalone — the supervisor must not construct a MetricsLogger
+    (its __init__ opens/rotates metrics.csv, the child's file)."""
+    os.makedirs(results_folder, exist_ok=True)
+    path = os.path.join(results_folder, "events.csv")
+    new = not os.path.exists(path) or os.path.getsize(path) == 0
+    with open(path, "a", newline="") as fh:
+        w = csv.writer(fh)
+        if new:
+            w.writerow(["step", "event", "detail"])
+        w.writerow([-1, kind, detail])
+    print(f"[supervisor] {kind}" + (f" ({detail})" if detail else ""),
+          flush=True)
+
+
+def supervise(argv: Sequence[str], *, results_folder: str,
+              max_restarts: int = 3, backoff_s: float = 5.0,
+              env: Optional[dict] = None,
+              child_timeout_s: float = 0.0) -> int:
+    """Run `argv` as a child; restart on abnormal exit. Returns the final
+    exit code (0 = the child eventually completed cleanly).
+
+    `backoff_s` is the base of the exponential restart delay
+    (backoff_s · 2^(restart-1), capped at 300 s). `child_timeout_s` > 0
+    additionally bounds each child's total wall clock — the supervisor's
+    own last-resort hang guard for a child whose in-process watchdog is
+    disabled or itself wedged; on expiry the child is killed and the
+    restart path taken. SIGINT/SIGTERM to the supervisor forward to the
+    child and stop the restart loop (an operator kill or a preemption of
+    the supervisor host must not look like a crash to retry).
+    """
+    from novel_view_synthesis_3d_tpu.utils.watchdog import EXIT_STALL
+
+    argv = list(argv)
+    stop = {"requested": False}
+    child: dict = {"proc": None}
+
+    def forward(signum, frame):
+        stop["requested"] = True
+        proc = child["proc"]
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signum)
+            except OSError:
+                pass
+
+    old_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            old_handlers[sig] = signal.signal(sig, forward)
+        except ValueError:  # not the main thread (tests)
+            pass
+
+    restarts = 0
+    try:
+        while True:
+            child_env = dict(os.environ if env is None else env)
+            child_env[RESTART_ENV] = str(restarts)
+            proc = subprocess.Popen(argv, env=child_env)
+            child["proc"] = proc
+            try:
+                rc = proc.wait(timeout=child_timeout_s or None)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    pass  # uninterruptible backend IO: abandon the child
+                rc = EXIT_STALL
+                log_event(results_folder, "supervised_timeout",
+                          f"child exceeded {child_timeout_s:.0f}s; killed")
+            child["proc"] = None
+            if rc == 0:
+                if restarts:
+                    log_event(results_folder, "supervised_complete",
+                              f"run completed after {restarts} restart(s)")
+                return 0
+            if stop["requested"]:
+                print(f"[supervisor] stop requested; child exited rc={rc} "
+                      "— not restarting", flush=True)
+                return rc
+            kind = "stall" if rc == EXIT_STALL else (
+                f"signal {-rc}" if rc < 0 else f"crash rc={rc}")
+            if restarts >= max_restarts:
+                log_event(results_folder, "supervised_giveup",
+                          f"{kind} and the restart budget "
+                          f"(train.max_restarts={max_restarts}) is "
+                          "exhausted")
+                return rc
+            restarts += 1
+            delay = min(300.0, backoff_s * (2 ** (restarts - 1)))
+            log_event(results_folder, "supervised_restart",
+                      f"{kind}; restart {restarts}/{max_restarts} "
+                      f"after {delay:.1f}s backoff (resume from last "
+                      "intact checkpoint)")
+            time.sleep(delay)
+    finally:
+        for sig, handler in old_handlers.items():
+            signal.signal(sig, handler)
+
+
+def train_child_argv(args, overrides: Sequence[str]) -> List[str]:
+    """Reconstruct the `nvs3d train` child command from parsed args,
+    minus --supervise (the child must not recurse)."""
+    argv = [sys.executable, "-m", "novel_view_synthesis_3d_tpu", "train"]
+    if getattr(args, "preset", None):
+        argv += ["--preset", args.preset]
+    if getattr(args, "config", None):
+        argv += ["--config", args.config]
+    if getattr(args, "no_grain", False):
+        argv += ["--no-grain"]
+    if getattr(args, "folder", None):
+        argv.append(args.folder)
+    argv += list(overrides)
+    return argv

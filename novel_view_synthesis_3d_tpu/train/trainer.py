@@ -37,7 +37,7 @@ from novel_view_synthesis_3d_tpu.train.guard import init_guard_state
 from novel_view_synthesis_3d_tpu.train.metrics import MetricsLogger
 from novel_view_synthesis_3d_tpu.train.state import create_train_state
 from novel_view_synthesis_3d_tpu.train.step import make_train_step
-from novel_view_synthesis_3d_tpu.utils import faultinject
+from novel_view_synthesis_3d_tpu.utils import faultinject, watchdog
 from novel_view_synthesis_3d_tpu.utils.images import save_image_grid
 from novel_view_synthesis_3d_tpu.utils.profiling import (
     StepTimer,
@@ -248,27 +248,87 @@ class Trainer:
             except ValueError:
                 pass  # not the main thread (e.g. under some test runners)
 
+        # Hang/stall watchdog (utils/watchdog.py; docs/DESIGN.md "Stall
+        # recovery"). The monitor thread starts with train() and feeds on
+        # the loop's phase markers; _on_stall below runs ON THE MONITOR
+        # THREAD, so it only writes (events.csv row, flag) — escalation is
+        # observed by the main loop at the next cross-host agreement
+        # point, exactly like preemption.
+        self._stalled = False  # set by the watchdog; observed by the loop
+        self._fetches = 0  # host-batch fetch ordinal (data-stall drills)
+        self._step_host = self.step  # sync-free step estimate (watchdog)
+        # Supervised-restart generation (train/supervisor.py): rides into
+        # metrics.csv so a curve produced across restarts says so.
+        from novel_view_synthesis_3d_tpu.train.supervisor import RESTART_ENV
+        self._restarts = int(os.environ.get(RESTART_ENV, "0") or 0)
+        if self._restarts:
+            self.metrics.log_event(
+                self.step, "supervised_resume",
+                f"restart generation {self._restarts} resumed at step "
+                f"{self.step}")
+        self.watchdog = watchdog.from_config(
+            tcfg.watchdog, on_stall=self._on_stall,
+            diagnosis_dir=tcfg.results_folder,
+            # Device memory queries can themselves hang on a wedged
+            # backend; the bundle helper bounds them, but skip entirely in
+            # multi-process runs where a straggling query could collide
+            # with collectives.
+            query_device=jax.process_count() == 1)
+
     def _on_preempt(self, signum, frame) -> None:
         self._preempted = True
 
-    def _preempt_agreed(self) -> bool:
-        """Cross-host agreement on the preemption flag.
+    def _on_stall(self, phase: str, diagnosis_path: str) -> None:
+        """Watchdog escalation (monitor thread — flags only, no JAX calls).
 
-        SIGTERM can land at different step boundaries on different hosts; if
-        one host broke into the (collective) checkpoint save while another
-        entered the next train step's psum, the mismatched collectives would
-        hang the slice. Every host therefore joins an allgather each step
-        and all of them break together iff any host saw the signal. The
-        per-step allgather is a few µs over ICI — negligible next to a
-        train step.
+        Per-phase policy: a stalled checkpoint_save DEGRADES (diagnosis +
+        events.csv row; training continues — exiting through a save that
+        is itself stuck would be circular, and the save path already has
+        retry/degrade semantics); every other phase flags a cross-host-
+        agreed checkpoint-and-exit, the same escalation lane preemption
+        uses, so one stuck host can't wedge the slice."""
+        degrade = phase == "checkpoint_save"
+        self.metrics.log_event(
+            self.step_host_estimate, "stall",
+            f"phase {phase} exceeded its watchdog budget; diagnosis in "
+            f"{diagnosis_path}"
+            + ("; degrading (save retries continue)" if degrade
+               else "; checkpoint-and-exit requested"))
+        if not degrade:
+            self._stalled = True
+
+    @property
+    def step_host_estimate(self) -> int:
+        """Last step count observed WITHOUT a device sync — safe to read
+        from the watchdog thread while the main thread is stuck inside a
+        dispatch (self.step would join it in the hang)."""
+        return self._step_host
+
+    def _stop_agreed(self) -> int:
+        """Cross-host agreement on the exit flags (0 none, 1 preempted,
+        2 watchdog stall — max over hosts wins).
+
+        SIGTERM (or a stall) can land at different step boundaries on
+        different hosts; if one host broke into the (collective)
+        checkpoint save while another entered the next train step's psum,
+        the mismatched collectives would hang the slice. Every host
+        therefore joins an allgather each step and all of them break
+        together iff any host flagged. The per-step allgather is a few µs
+        over ICI — negligible next to a train step.
         """
+        local = 2 if self._stalled else (1 if self._preempted else 0)
         if jax.process_count() == 1:
-            return self._preempted
+            return local
         from jax.experimental import multihost_utils
 
-        flags = multihost_utils.process_allgather(
-            np.asarray(self._preempted))
-        return bool(np.any(flags))
+        flags = multihost_utils.process_allgather(np.asarray(local))
+        return int(np.max(flags))
+
+    @property
+    def stalled(self) -> bool:
+        """True once the watchdog escalated a stall (cli.cmd_train exits
+        with watchdog.EXIT_STALL so a supervisor restarts the run)."""
+        return self._stalled
 
     # ------------------------------------------------------------------
     @property
@@ -437,9 +497,19 @@ class Trainer:
         def clean(b):
             return {k: v for k, v in b.items() if k != "noise"}
 
+        # The host fetch is the part that stalls (starved loader, dead
+        # filesystem); the async device_put below never blocks. Armed as
+        # the watchdog's data_fetch phase, keyed by fetch ordinal for the
+        # deterministic stall drill.
+        with self.watchdog.phase("data_fetch"):
+            faultinject.maybe_stall("data", self._fetches)
+            self._fetches += 1
+            if spd <= 1:
+                host = clean(self._next_batch())
+            else:
+                host = [clean(self._next_batch()) for _ in range(spd)]
         if spd <= 1:
-            return mesh_lib.shard_batch(self.mesh, clean(self._next_batch()))
-        host = [clean(self._next_batch()) for _ in range(spd)]
+            return mesh_lib.shard_batch(self.mesh, host)
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *host)
         return mesh_lib.shard_batch(self.mesh, stacked, stacked=True)
 
@@ -447,6 +517,17 @@ class Trainer:
         tcfg = self.config.train
         last_metrics = None
         profiling = False
+        self.watchdog.start()
+        try:
+            self._train_loop(tcfg, last_metrics, profiling)
+        finally:
+            self.watchdog.stop()
+
+    def _train_loop(self, tcfg, last_metrics, profiling) -> None:
+        # The first dispatch of the jitted train step runs under the
+        # separate (long) compile budget; every later one under the
+        # steady-state step budget.
+        first_dispatch = True
         while self.step < tcfg.num_steps:
             if tcfg.profile_steps:
                 at = self.step
@@ -475,7 +556,9 @@ class Trainer:
                         "steps_per_dispatch batches; with "
                         "steps_per_dispatch>1 a partial trailing group "
                         "cannot be dispatched.") from None
-            with self.timer.measure():
+            with self.timer.measure(), self.watchdog.phase(
+                    "compile" if first_dispatch else "train_step"):
+                first_dispatch = False
                 self.state, step_metrics = self.train_step(
                     self.state, self._device_batch)
                 # Overlap the NEXT batch's host fetch + upload with the
@@ -493,6 +576,11 @@ class Trainer:
                 # state.step, which syncs on the whole step — keep it inside
                 # the timed region so timings reflect real device time.
                 step_now = self.step
+                self._step_host = step_now
+                # Deterministic hang drill: the injected sleep sits inside
+                # the armed train_step phase, exactly where a wedged
+                # dispatch would stall.
+                faultinject.maybe_stall("step", step_now)
 
             if self._check_guard(step_now, step_metrics):
                 continue  # rolled back: restart the loop from the restore
@@ -506,7 +594,8 @@ class Trainer:
                 logged = self.metrics.log(
                     step_now,
                     dict(jax.device_get(step_metrics),
-                         rollbacks=self._rollbacks),
+                         rollbacks=self._rollbacks,
+                         restarts=self._restarts),
                     tcfg.batch_size)
                 print(f"{step_now}: loss={logged['loss']:.5f} "
                       f"imgs/s/chip={logged['imgs_per_sec_per_chip']:.2f}")
@@ -517,7 +606,9 @@ class Trainer:
                 # Orbax gathers per-shard across hosts; device_get would
                 # crash on non-fully-addressable arrays in multi-host runs.
                 self._maybe_update_host_ema(step_now, force=True)
-                self.ckpt.save(step_now, self._ckpt_state())
+                with self.watchdog.phase("checkpoint_save"):
+                    faultinject.maybe_stall("save", step_now)
+                    self.ckpt.save(step_now, self._ckpt_state())
 
             sample_due = (tcfg.sample_every
                           and step_now % tcfg.sample_every == 0)
@@ -528,30 +619,34 @@ class Trainer:
                 # replication collective and get None back. Gathered ONCE
                 # even when both probes fire (on a pod each gather is a
                 # full cross-host all-gather of the param tree).
-                probe_params = self._probe_host_params()
-                try:
-                    if sample_due:
-                        self.dump_samples(step_now, params=probe_params)
-                    if eval_due:
-                        logged = self.eval_step(step_now, params=probe_params)
-                        if logged is not None:
-                            print(f"{step_now}: "
-                                  f"eval psnr={logged['psnr']:.2f} "
-                                  f"ssim={logged['ssim']:.4f}")
-                finally:
-                    # Free the pinned probe copy promptly — at paper256 it
-                    # is the difference between the next step fitting HBM
-                    # and an OOM (VERDICT r4 item 8).
-                    self._release_probe_params(probe_params)
+                with self.watchdog.phase("eval"):
+                    probe_params = self._probe_host_params()
+                    try:
+                        if sample_due:
+                            self.dump_samples(step_now, params=probe_params)
+                        if eval_due:
+                            logged = self.eval_step(step_now,
+                                                    params=probe_params)
+                            if logged is not None:
+                                print(f"{step_now}: "
+                                      f"eval psnr={logged['psnr']:.2f} "
+                                      f"ssim={logged['ssim']:.4f}")
+                    finally:
+                        # Free the pinned probe copy promptly — at paper256
+                        # it is the difference between the next step fitting
+                        # HBM and an OOM (VERDICT r4 item 8).
+                        self._release_probe_params(probe_params)
 
             # Fault-injection SIGTERM drill (env-gated, inert otherwise):
             # fires here so the flag is observed by the agreement check
             # below within the same iteration.
             faultinject.maybe_sigterm(step_now)
 
-            if self._preempt_agreed():
-                print(f"preemption signal received at step {step_now}: "
-                      "checkpointing and exiting")
+            stop = self._stop_agreed()
+            if stop:
+                print(("preemption signal received" if stop == 1 else
+                       "watchdog stall escalation") + f" at step {step_now}"
+                      ": checkpointing and exiting")
                 break
 
         if profiling:
@@ -560,9 +655,12 @@ class Trainer:
         # of this Trainer (sampling/eval on large configs wants the room).
         self._device_batch = None
         self._maybe_update_host_ema(self.step, force=True)
-        self.ckpt.save(self.step, self._ckpt_state(), force=True)
-        self.ckpt.wait()
-        print("training completed")
+        with self.watchdog.phase("checkpoint_save"):
+            self.ckpt.save(self.step, self._ckpt_state(), force=True)
+            self.ckpt.wait()
+        print("training completed" if not self._stalled else
+              f"training STALLED at step {self.step}; state checkpointed "
+              "for a supervised restart")
         if last_metrics is not None:
             print(f"final: {last_metrics}")
         timing = self.timer.summary()

@@ -20,6 +20,17 @@ Injection points:
                             (read per call).
   NVS3D_FI_SIGTERM_AT       single step; the Trainer sends itself SIGTERM
                             when the loop reaches it (read per call).
+  NVS3D_FI_STALL_DATA_AT    "<step>[:<seconds>]"; the Trainer's host batch
+  NVS3D_FI_STALL_STEP_AT    fetch / train-step dispatch / checkpoint save
+  NVS3D_FI_STALL_SAVE_AT    SLEEPS for <seconds> (default 30) when the
+                            loop is at exactly that global step — the hang
+                            drill for utils/watchdog.py. Exact-step match,
+                            so a supervised restart that resumes PAST the
+                            armed step does not re-stall.
+  NVS3D_FI_PROBE_HANG       "1": parallel/dist.probe_backend's disposable
+                            child sleeps forever (wedged-tunnel drill);
+  NVS3D_FI_PROBE_FAIL       "1": the probe child exits non-zero instead
+                            (dead-backend drill, no timeout burn).
 
 plus `truncate_checkpoint`, a direct helper that corrupts an on-disk Orbax
 step the way a mid-write preemption does (the checkpoint-fallback drill).
@@ -78,6 +89,46 @@ def maybe_sigterm(step: int) -> bool:
         os.environ.pop("NVS3D_FI_SIGTERM_AT", None)
         return True
     return False
+
+
+_STALL_ENVS = {
+    "data": "NVS3D_FI_STALL_DATA_AT",
+    "step": "NVS3D_FI_STALL_STEP_AT",
+    "save": "NVS3D_FI_STALL_SAVE_AT",
+}
+_DEFAULT_STALL_S = 30.0
+
+
+def stall_spec(kind: str) -> Optional[Tuple[int, float]]:
+    """(step, seconds) armed for a stall kind ('data'|'step'|'save').
+
+    Env format "<step>" (default 30 s) or "<step>:<seconds>"."""
+    env = _STALL_ENVS[kind]
+    raw = os.environ.get(env, "").strip()
+    if not raw:
+        return None
+    step_s, _, dur_s = raw.partition(":")
+    try:
+        return int(step_s), float(dur_s) if dur_s else _DEFAULT_STALL_S
+    except ValueError as e:
+        raise ValueError(
+            f"{env}={raw!r} must be '<step>' or '<step>:<seconds>'") from e
+
+
+def maybe_stall(kind: str, step: int) -> float:
+    """Hook for the Trainer's phases: sleep if a stall of `kind` is armed
+    at exactly this step (the hang drill). Returns the seconds slept (0.0
+    when inert). Exact match — a resumed run past the armed step runs
+    clean, so supervised-restart drills terminate."""
+    spec = stall_spec(kind)
+    if spec is None or spec[0] != step:
+        return 0.0
+    import time
+
+    print(f"[faultinject] stalling {kind} at step {step} for "
+          f"{spec[1]:.1f}s ({_STALL_ENVS[kind]})", flush=True)
+    time.sleep(spec[1])
+    return spec[1]
 
 
 def armed() -> List[str]:

@@ -15,6 +15,8 @@ from novel_view_synthesis_3d_tpu.data.srn import (
 from novel_view_synthesis_3d_tpu.data.pipeline import iter_batches, make_grain_loader
 from novel_view_synthesis_3d_tpu.data.synthetic import write_synthetic_srn
 
+pytestmark = pytest.mark.smoke
+
 
 @pytest.fixture(scope="module")
 def srn_root(tmp_path_factory):

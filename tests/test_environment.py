@@ -13,6 +13,8 @@ import re
 
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 _CONSTRAINTS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "constraints.txt")
 

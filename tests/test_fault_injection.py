@@ -33,7 +33,7 @@ from novel_view_synthesis_3d_tpu.data.synthetic import (
 from novel_view_synthesis_3d_tpu.train.trainer import Trainer
 from novel_view_synthesis_3d_tpu.utils import faultinject
 
-pytestmark = pytest.mark.faultinject
+pytestmark = [pytest.mark.faultinject, pytest.mark.smoke]
 
 
 @pytest.fixture(scope="module")
@@ -447,9 +447,9 @@ def test_summarize_bench_surfaces_recovery_counts(tmp_path):
     run.mkdir()
     with open(run / "metrics.csv", "w") as fh:
         fh.write("step,loss,grad_norm,lr,steps_per_sec,"
-                 "imgs_per_sec_per_chip,anomalies,rollbacks\n")
-        fh.write("1,0.5,1.0,1e-4,2.0,16.0,0,0\n")
-        fh.write("2,0.4,0.9,1e-4,2.0,16.0,3,1\n")
+                 "imgs_per_sec_per_chip,anomalies,rollbacks,restarts\n")
+        fh.write("1,0.5,1.0,1e-4,2.0,16.0,0,0,0\n")
+        fh.write("2,0.4,0.9,1e-4,2.0,16.0,3,1,2\n")
     clean = tmp_path / "runB"
     clean.mkdir()
     with open(clean / "metrics.csv", "w") as fh:
@@ -465,6 +465,6 @@ def test_summarize_bench_surfaces_recovery_counts(tmp_path):
         fh.write("1,0.5,1.0,1e-4,2.0,16.0\n")
     rows = summarize_bench.recovery_rows([str(tmp_path)])
     assert len(rows) == 1
-    path, anomalies, rollbacks = rows[0]
+    path, anomalies, rollbacks, restarts = rows[0]
     assert path.endswith(os.path.join("runA", "metrics.csv"))
-    assert anomalies == 3 and rollbacks == 1
+    assert anomalies == 3 and rollbacks == 1 and restarts == 2

@@ -6,6 +6,10 @@ import jax.numpy as jnp
 from novel_view_synthesis_3d_tpu.models.rays import camera_rays
 from novel_view_synthesis_3d_tpu.ops.posenc import posenc_ddpm, posenc_nerf
 
+import pytest
+
+pytestmark = pytest.mark.smoke
+
 
 def test_posenc_nerf_dims():
     x = jnp.ones((2, 4, 4, 3))

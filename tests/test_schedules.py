@@ -13,6 +13,8 @@ from novel_view_synthesis_3d_tpu.diffusion import (
     respace,
 )
 
+pytestmark = pytest.mark.smoke
+
 
 def test_cosine_betas_closed_form():
     T, s = 1000, 0.008

@@ -25,6 +25,8 @@ from novel_view_synthesis_3d_tpu.utils.geometry import (
 )
 from novel_view_synthesis_3d_tpu.utils.images import convert_image, normalize01
 
+pytestmark = pytest.mark.smoke
+
 
 # ---------------------------------------------------------------------------
 # geometry

@@ -17,6 +17,8 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 import _common  # noqa: E402
 
+pytestmark = pytest.mark.smoke
+
 
 @pytest.fixture(autouse=True)
 def fast_probe_interval(monkeypatch):

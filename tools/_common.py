@@ -93,26 +93,19 @@ def run_watcher(out_dir: str, matrix, max_wait_h: float,
     def probe_alive() -> bool:
         if probe_fn is not None:  # injected by tests (no real tunnel)
             return probe_fn()
-        code = ("import jax, jax.numpy as jnp; "
-                "x = jnp.ones((256, 256)); "
-                "print(float((x @ x).sum()), jax.devices()[0].platform)")
+        # Shared probe primitive (parallel/dist.probe_backend): a real
+        # computation in a disposable, abandonable child. JAX_PLATFORMS is
+        # popped so an ambient CPU pin doesn't shadow the accelerator, and
+        # require_accelerator rejects CPU answers (not TPU evidence).
+        sys.path.insert(0, repo)
+        from novel_view_synthesis_3d_tpu.parallel.dist import probe_backend
+
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)  # probe the real accelerator
-        proc = subprocess.Popen([sys.executable, "-c", code], env=env,
-                                stdout=subprocess.PIPE,
-                                stderr=subprocess.DEVNULL, text=True)
-        try:
-            out, _ = proc.communicate(timeout=PROBE_TIMEOUT_S)
-            if proc.returncode == 0 and "cpu" not in out:
-                log(f"probe OK: {out.strip()}")
-                return True
-            log(f"probe rc={proc.returncode} out={out.strip()!r} "
-                "(cpu or fail)")
-            return False
-        except subprocess.TimeoutExpired:
-            proc.kill()  # child may be unreapable; abandon
-            log("probe timed out — tunnel still wedged")
-            return False
+        ok, reason = probe_backend(PROBE_TIMEOUT_S,
+                                   require_accelerator=True, env=env)
+        log(f"probe OK: {reason}" if ok else f"probe failed: {reason}")
+        return ok
 
     def run_bench(name: str, argv: list, timeout_s: int):
         """Run one entry; returns None on success, else a failure reason."""

@@ -38,13 +38,14 @@ def fmt(v) -> str:
 
 
 def recovery_rows(search_dirs):
-    """(path, anomalies, rollbacks) per metrics.csv with recovery events.
+    """(path, anomalies, rollbacks, restarts) per metrics.csv with
+    recovery events.
 
-    The trainer logs cumulative anomaly-guard skips and checkpoint
-    rollbacks as metrics.csv columns (train/metrics.py) — a bench or
-    quality number produced by a run that silently recovered from faults
-    must say so next to the number. Pre-fault-tolerance CSVs (no such
-    columns) read as zero.
+    The trainer logs cumulative anomaly-guard skips, checkpoint rollbacks,
+    and supervised restarts as metrics.csv columns (train/metrics.py,
+    train/supervisor.py) — a bench or quality number produced by a run
+    that silently recovered from faults must say so next to the number.
+    Pre-fault-tolerance CSVs (no such columns) read as zero.
     """
     import csv
     import glob
@@ -57,7 +58,7 @@ def recovery_rows(search_dirs):
             if path in seen:
                 continue
             seen.add(path)
-            anomalies = rollbacks = 0
+            anomalies = rollbacks = restarts = 0
             try:
                 with open(path, newline="") as fh:
                     for row in csv.DictReader(fh):
@@ -65,10 +66,12 @@ def recovery_rows(search_dirs):
                                         int(float(row.get("anomalies") or 0)))
                         rollbacks = max(rollbacks,
                                         int(float(row.get("rollbacks") or 0)))
+                        restarts = max(restarts,
+                                       int(float(row.get("restarts") or 0)))
             except (OSError, ValueError):
                 continue
-            if anomalies or rollbacks:
-                rows.append((path, anomalies, rollbacks))
+            if anomalies or rollbacks or restarts:
+                rows.append((path, anomalies, rollbacks, restarts))
     return rows
 
 
@@ -108,11 +111,12 @@ def main() -> int:
                      if d.startswith("quality_tpu")]
                     if os.path.isdir("results") else [])
     recov = recovery_rows([out_dir] + quality_dirs)
-    lines += ["", "## Recovery events (anomaly guard / rollbacks)", ""]
+    lines += ["", "## Recovery events (anomaly guard / rollbacks / "
+                  "supervised restarts)", ""]
     if recov:
-        for path, anomalies, rollbacks in recov:
+        for path, anomalies, rollbacks, restarts in recov:
             lines.append(f"- `{path}`: anomalies={anomalies} "
-                         f"rollbacks={rollbacks}")
+                         f"rollbacks={rollbacks} restarts={restarts}")
     else:
         lines.append("- none recorded")
     text = "\n".join(lines) + "\n"

@@ -1,61 +1,123 @@
-"""Wait for the TPU tunnel to revive, then run the round-2 bench matrix.
+"""TPU bench watcher: wait for the tunnel, run a bench matrix, bank JSON.
 
-Round-1 postmortem (docs/DESIGN.md, memory): the axon tunnel wedged mid-run
-and stayed dead for hours; children stuck on it enter uninterruptible sleep
-(SIGKILL unreapable). So this watcher:
+THE one watcher. Rounds 2-5 each copy-pasted a `tpu_bench_watch_r*.py`
+variant whose only real difference was the MATRIX list and OUT dir
+(~675 duplicated lines); the probe/run/resume/retry machinery now lives
+in tools/_common.run_watcher (built on parallel/dist.probe_backend — the
+same bounded, abandonable-child probe primitive bench.py and the nvs3d
+CLI use), and this file is a thin parameterized front end:
 
-  - probes with a REAL computation in a disposable child (backend init has
-    been observed succeeding while the first execution hangs);
-  - uses Popen.wait(timeout) everywhere and abandons stuck children;
-  - runs the matrix SEQUENTIALLY with generous timeouts, never killing a
-    bench mid-computation unless its timeout expires (a killed mid-run
-    bench is the suspected round-1 wedge trigger);
-  - appends every result line to results/tpu_r02/log.txt and drops each
-    bench's JSON into results/tpu_r02/.
+    python tools/tpu_bench_watch.py [max_wait_hours]
+    python tools/tpu_bench_watch.py --matrix r5 --out results/tpu_r05 8.0
+    python tools/tpu_bench_watch.py --matrix my_round.json
 
-Matrix (VERDICT r1 items 1-3):
-  tiny64 train, base128 remat={False,True,dots}, paper256 (the BASELINE
-  metric), tiny64 256-step sampling, base128 profile.
+A JSON matrix file is either a bare list of [name, argv, timeout_s]
+entries or {"out": "results/tpu_rXX", "matrix": [...]}; argv paths are
+relative to the repo root. Built-in matrices live in MATRICES below —
+add the next round's queue there (or ship a JSON file) instead of
+copying this file.
 
-Usage: python tools/tpu_bench_watch.py [max_wait_hours]
+Semantics inherited from run_watcher (lessons of rounds 1-5, see
+docs/DESIGN.md): probe with a REAL computation in a disposable child and
+abandon stuck children; refuse CPU-fallback output as TPU evidence
+BEFORE persisting; resume across restarts via {name}.json artifacts; a
+persistent per-entry attempt ledger (max 2) so restarts neither forget
+nor re-queue hopeless entries; never start a bench whose timeout crosses
+the watcher deadline.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "results", "tpu_r02")
-PROBE_INTERVAL_S = 300
-PROBE_TIMEOUT_S = 120
+# Single source of truth for the warm-up↔judged-bench cache handoff: the
+# SAME default bench.py resolves when JAX_COMPILATION_CACHE_DIR is unset.
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bench import CACHE_DIR as CACHE  # noqa: E402
+from _common import run_watcher  # noqa: E402
 
-MATRIX = [
-    # (name, argv after `python`, timeout_s). "bench.py ..." entries emit
-    # the one-line JSON; the quality entry trains on the raytraced dataset
-    # at 64px on the real chip (VERDICT r1 item 5 at full scale).
-    # Completed on 2026-07-31 (artifacts committed in results/tpu_r02/):
-    # tiny64_train, base128_remat_{off,full,dots}. The remaining entries
-    # are ordered cheap-headline-first so a SHORT tunnel revival still
-    # banks the BASELINE metric-2 sample bench before paper256's long
-    # compile.
-    ("sample_tiny64_256", ["bench.py", "sample", "tiny64", "256"], 2400),
-    ("paper256_train", ["bench.py", "paper256", "10"], 3600),
-    ("sample_ar_tiny64", ["bench.py", "sample-ar", "tiny64", "8"], 2400),
-    ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
-    ("quality_tpu_64px", ["tools/quality_run.py",
-                          "results/quality_tpu_r02", "20000", "64"], 7200),
-    ("tiny64_train", ["bench.py", "tiny64", "30"], 1800),
-    ("base128_remat_off", ["bench.py", "base128", "20",
-                           "model.remat=False"], 2400),
-    ("base128_remat_full", ["bench.py", "base128", "20",
-                            "model.remat=True"], 2400),
-    ("base128_remat_dots", ["bench.py", "base128", "20",
-                            "model.remat=dots"], 2400),
-]
+
+def _q(name: str) -> str:
+    return os.path.join("results", name)
+
+
+# Built-in matrices, (name, argv-after-python, timeout_s) — judged
+# metrics first, so a short tunnel revival still banks the headline.
+MATRICES = {
+    # Round-5 queue (VERDICT r4 "Next round" ordering): bank tiny64 and
+    # warm the driver's exact bench program, then paper256 (the
+    # never-measured north star), quality, honest sampler headline,
+    # Pallas/dispatch A/B grid, k=2 pair, extras.
+    "r5": [
+        ("tiny64_train", ["bench.py", "tiny64", "30"], 1800),
+        ("analyze_paper256", ["bench.py", "analyze", "paper256"], 3600),
+        ("paper256_train", ["bench.py", "paper256", "10"], 5400),
+        ("analyze_paper256_adafactor",
+         ["bench.py", "analyze", "paper256",
+          "train.optimizer=adafactor"], 1800),
+        ("paper256_adafactor",
+         ["bench.py", "paper256", "10",
+          "train.optimizer=adafactor"], 5400),
+        ("paper256_probe_check",
+         ["tools/paper256_probe_check.py",
+          os.path.join("results", "tpu_r05", "p256probe"), "20"], 4800),
+        ("quality_tpu_64px",
+         ["tools/quality_run.py", _q("quality_tpu_r05"),
+          "20000", "64"], 7200),
+        ("sample_base128_256",
+         ["bench.py", "sample", "base128", "256"], 3600),
+        ("sample_tiny64_256", ["bench.py", "sample", "tiny64", "256"], 1800),
+        ("base128_train", ["bench.py", "base128", "20"], 2400),
+        ("tiny64_spd1", ["bench.py", "tiny64", "30",
+                         "train.steps_per_dispatch=1"], 1800),
+        ("tiny64_noflash", ["bench.py", "tiny64", "30",
+                            "model.use_flash_attention=False"], 1800),
+        ("tiny64_fusedgn", ["bench.py", "tiny64", "30",
+                            "model.use_fused_groupnorm=True"], 1800),
+        ("base128_noflash", ["bench.py", "base128", "20",
+                             "model.use_flash_attention=False"], 2400),
+        ("base128_fusedgn", ["bench.py", "base128", "20",
+                             "model.use_fused_groupnorm=True"], 2400),
+        ("base128_spd5", ["bench.py", "base128", "20",
+                          "train.steps_per_dispatch=5"], 2400),
+        ("base128_dots", ["bench.py", "base128", "20",
+                          "model.remat=dots"], 2400),
+        ("quality_tpu_k2", ["tools/quality_run.py", _q("quality_tpu_r05_k2"),
+                            "8000", "64", "model.num_cond_frames=2"], 5400),
+        ("quality_tpu_k1_matched",
+         ["tools/quality_run.py", _q("quality_tpu_r05_k1m"),
+          "8000", "64"], 5400),
+        ("sampler_comparison_quality64",
+         ["tools/sampler_comparison.py",
+          os.path.join(_q("quality_tpu_r05"), "work", "val"),
+          os.path.join(_q("quality_tpu_r05"), "sampler_comparison.json"),
+          "--config",
+          os.path.join(_q("quality_tpu_r05"), "work", "config.json"),
+          "--num-instances", "6", "--views-per-instance", "2"], 3600),
+        ("base128_bs16", ["bench.py", "base128", "20",
+                          "train.batch_size=16"], 2400),
+        ("sample_dpmpp32_tiny64", ["bench.py", "sample", "tiny64", "32",
+                                   "diffusion.sampler=dpm++"], 1800),
+        ("sample_ar_tiny64", ["bench.py", "sample-ar", "tiny64", "8"], 2400),
+        ("profile_base128", ["bench.py", "profile", "base128", "5"], 2400),
+        ("sample_tiny64_256_bf16",
+         ["bench.py", "sample", "tiny64", "256",
+          "model.dtype=bfloat16"], 1800),
+    ],
+}
+
+DEFAULT_OUTS = {"r5": os.path.join(REPO, "results", "tpu_r05")}
+
+# Module-level defaults: tools/tpu_extra_watch.py (and tests) override
+# MATRIX/OUT and call main() — the pre-consolidation API.
+MATRIX = MATRICES["r5"]
+OUT = DEFAULT_OUTS["r5"]
 
 
 def log(msg: str) -> None:
@@ -66,98 +128,43 @@ def log(msg: str) -> None:
         fh.write(line + "\n")
 
 
-def probe_alive() -> bool:
-    code = ("import jax, jax.numpy as jnp; "
-            "x = jnp.ones((256, 256)); "
-            "print(float((x @ x).sum()), jax.devices()[0].platform)")
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)  # probe the real accelerator, like
-    # run_bench does — an ambient cpu pin would otherwise make the probe
-    # report 'cpu' forever and the watcher would never run a bench.
-    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.DEVNULL, text=True)
-    try:
-        out, _ = proc.communicate(timeout=PROBE_TIMEOUT_S)
-        if proc.returncode == 0 and "cpu" not in out:
-            log(f"probe OK: {out.strip()}")
-            return True
-        log(f"probe rc={proc.returncode} out={out.strip()!r} (cpu or fail)")
-        return False
-    except subprocess.TimeoutExpired:
-        proc.kill()  # child may be unreapable; abandon
-        log("probe timed out — tunnel still wedged")
-        return False
-
-
-def run_bench(name: str, argv: list, timeout_s: int) -> bool:
-    log(f"running {name}: {' '.join(argv)}")
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)  # use the real accelerator
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/nvs3d_jax_cache")
-    out_path = os.path.join(OUT, f"{name}.out")
-    script, script_args = argv[0], argv[1:]
-    with open(out_path, "w") as fh:
-        proc = subprocess.Popen(
-            [sys.executable, os.path.join(REPO, script)] + script_args,
-            stdout=fh, stderr=subprocess.STDOUT, env=env, cwd=REPO)
-        try:
-            rc = proc.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            log(f"{name}: TIMED OUT after {timeout_s}s (output in {out_path})")
-            return False
-    tail = open(out_path).read().strip().splitlines()
-    result = next((ln for ln in reversed(tail) if ln.startswith("{")), None)
-    log(f"{name}: rc={rc} result={result}")
-    platform = None
-    if result:
-        try:
-            platform = json.loads(result).get("platform")
-        except json.JSONDecodeError:
-            pass
-        with open(os.path.join(OUT, f"{name}.json"), "w") as fh:
-            fh.write(result + "\n")
-    if platform == "cpu":
-        # bench.py's own liveness probe fell back to CPU mid-matrix: exit-0
-        # CPU numbers must NOT count as TPU evidence (VERDICT r1 weak #1).
-        log(f"{name}: completed on CPU fallback — counting as failure")
-        return False
-    return rc == 0
+def load_matrix(spec: str):
+    """(matrix, default_out) from a built-in name or a JSON file path."""
+    if spec in MATRICES:
+        return MATRICES[spec], DEFAULT_OUTS.get(spec)
+    with open(spec) as fh:
+        data = json.load(fh)
+    out = None
+    if isinstance(data, dict):
+        out = data.get("out")
+        if out is not None and not os.path.isabs(out):
+            out = os.path.join(REPO, out)
+        data = data["matrix"]
+    matrix = []
+    for entry in data:
+        name, argv, timeout_s = entry
+        if not isinstance(argv, list) or not argv:
+            raise ValueError(f"matrix entry {name!r}: argv must be a "
+                             "non-empty list")
+        matrix.append((str(name), [str(a) for a in argv], float(timeout_s)))
+    return matrix, out
 
 
 def main() -> None:
-    max_wait_h = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
-    deadline = time.time() + max_wait_h * 3600
-    log(f"watching for TPU (max {max_wait_h:.1f}h)")
-    done = set()
-    failed = set()
-    while time.time() < deadline:
-        if probe_alive():
-            log("TPU alive — running matrix")
-            for name, argv, timeout_s in MATRIX:
-                if name in done or name in failed:
-                    continue  # resume after a mid-matrix tunnel death
-                if run_bench(name, argv, timeout_s):
-                    done.add(name)
-                elif probe_alive():
-                    # The bench itself failed (OOM, timeout, bug) with the
-                    # tunnel healthy — retrying won't change the outcome.
-                    failed.add(name)
-                    log(f"{name}: failed with tunnel alive — not retrying")
-                else:
-                    log("tunnel died mid-matrix; resuming watch")
-                    break
-            if len(done) + len(failed) == len(MATRIX):
-                log(f"matrix finished: ok={json.dumps(sorted(done))} "
-                    f"failed={json.dumps(sorted(failed))}")
-                return
-        remaining = deadline - time.time()
-        if remaining <= 0:
-            break
-        time.sleep(min(PROBE_INTERVAL_S, remaining))
-    log(f"deadline reached: ok={json.dumps(sorted(done))} "
-        f"failed={json.dumps(sorted(failed))}")
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("max_wait_hours", nargs="?", type=float,
+                        default=10.0)
+    parser.add_argument("--matrix", default=None,
+                        help=f"built-in name ({', '.join(MATRICES)}) or "
+                             "path to a JSON matrix file")
+    parser.add_argument("--out", default=None,
+                        help="artifact dir (default: the matrix's own, "
+                             f"else {OUT})")
+    args = parser.parse_args()
+    matrix, out = (MATRIX, None) if args.matrix is None \
+        else load_matrix(args.matrix)
+    out = args.out or out or OUT
+    run_watcher(out, matrix, args.max_wait_hours, CACHE)
 
 
 if __name__ == "__main__":
