@@ -35,19 +35,14 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 # driver's bench budget cannot absorb a cold paper256/base128 XLA compile
 # through the tunnel, so warm-up runs (tools/tpu_bench_watch.py) populate
 # this dir and the judged `python bench.py` reuses the compiled executables.
-CACHE_DIR = os.environ.get(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-if CACHE_DIR:
-    try:
-        os.makedirs(CACHE_DIR, exist_ok=True)
-    except OSError as e:  # read-only checkout: skip the cache, don't die
-        print(f"warning: compilation cache dir unavailable ({e}); "
-              "continuing without persistent cache", file=sys.stderr)
-        CACHE_DIR = ""
-    else:
-        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# One shared helper wires this for bench, cli, and tools alike.
+from novel_view_synthesis_3d_tpu.utils.xla_cache import (  # noqa: E402
+    setup_compilation_cache)
+
+CACHE_DIR = setup_compilation_cache(
+    default_dir=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    min_entry_bytes=0) or ""
 
 import jax.numpy as jnp
 import numpy as np
@@ -560,7 +555,7 @@ def _require_live_backend() -> None:
 
     try:
         dist.require_backend(default_budget_s=360.0)
-    except SystemExit:
+    except SystemExit as e:
         if os.environ.get("NVS3D_BENCH_ALLOW_CPU") == "1":
             print("warning: backend unreachable; NVS3D_BENCH_ALLOW_CPU=1 — "
                   "falling back to CPU (NOT a device benchmark)",
@@ -571,7 +566,25 @@ def _require_live_backend() -> None:
         print("error: refusing to emit a CPU number for a device "
               "benchmark. Set NVS3D_BENCH_ALLOW_CPU=1 to override.",
               file=sys.stderr)
+        # Structured result even on failure: the probe path used to exit
+        # rc=3 with NO JSON line, so BENCH_r0*.json archives recorded
+        # "parsed": null with the reason buried in a .out file. One
+        # machine-readable object says what and why.
+        print(json.dumps(_probe_failure_result(
+            int(e.code) if isinstance(e.code, int) else 3,
+            dist.LAST_FAILURE_REASON)))
         raise
+
+
+def _probe_failure_result(rc: int, reason) -> dict:
+    """The JSON object bench emits when the backend probe fails."""
+    return {
+        "rc": rc,
+        "reason": reason or "backend probe failed (no reason recorded)",
+        "metric": "probe_failure",
+        "value": None,
+        "platform": None,
+    }
 
 
 def main():

@@ -1,4 +1,4 @@
-"""Command-line entry points: train / sample / eval / prep / config.
+"""Command-line entry points: train / sample / serve / eval / prep / config.
 
 The reference's entry points are two hardwired scripts with zero flags
 (`/root/reference/train.py:174-176` — dataset path literal 'cars_train_val';
@@ -24,6 +24,8 @@ import numpy as np
 
 from novel_view_synthesis_3d_tpu.config import (
     Config, PRESET_NAMES, get_preset)
+from novel_view_synthesis_3d_tpu.utils.xla_cache import (
+    setup_compilation_cache)
 
 
 def build_config(args, overrides: Sequence[str]) -> Config:
@@ -92,6 +94,10 @@ def cmd_train(args, overrides: List[str]) -> int:
     from novel_view_synthesis_3d_tpu.utils.watchdog import EXIT_STALL
 
     dist.require_backend()
+    # Persistent compilation cache BEFORE the first jitted dispatch:
+    # until this call only bench/tests/tools had it wired, so every CLI
+    # train run paid the full XLA compile (utils/xla_cache.py).
+    setup_compilation_cache()
 
     from novel_view_synthesis_3d_tpu.train.trainer import Trainer
 
@@ -156,6 +162,7 @@ def cmd_sample(args, overrides: List[str]) -> int:
     from novel_view_synthesis_3d_tpu.parallel import dist
 
     dist.require_backend()  # sub-60s structured failure on a dead tunnel
+    setup_compilation_cache()  # warm repeat samples skip the XLA compile
 
     import jax
     import jax.numpy as jnp
@@ -293,12 +300,117 @@ def cmd_sample(args, overrides: List[str]) -> int:
 
 
 # ---------------------------------------------------------------------------
+# serve
+# ---------------------------------------------------------------------------
+def cmd_serve(args, overrides: List[str]) -> int:
+    """Micro-batched sampling service (sample/service.py).
+
+    Requests come from --requests (a JSON-lines file; each line selects a
+    conditioning view and a target pose by dataset index and may override
+    seed / sample_steps / guidance_weight / deadline_ms) or, with no
+    file, a --num-requests demo sweep over the instance's poses. Every
+    request's image lands in --out; a JSON summary line (requests/sec,
+    queue-wait and device-time percentiles, program-cache counters)
+    closes the run — the serving twin of eval's result line.
+    """
+    from novel_view_synthesis_3d_tpu.parallel import dist
+
+    dist.require_backend()  # sub-60s structured failure on a dead tunnel
+    setup_compilation_cache()  # the warm-traffic contract starts on disk
+
+    import jax
+
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.sample.service import (
+        Rejected, SamplingService)
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+    from novel_view_synthesis_3d_tpu.utils.images import save_image
+
+    cfg = build_config(args, overrides)
+    ds = SRNDataset(args.folder or cfg.data.root_dir,
+                    img_sidelength=cfg.data.img_sidelength)
+    model = XUNet(cfg.model)
+    inst0 = ds.instances[0]
+    x0, pose0 = inst0.view(0)
+    sample_batch = _sample_model_batch({
+        "x": x0[None], "target": x0[None],
+        "R1": pose0[None, :3, :3], "t1": pose0[None, :3, 3],
+        "R2": pose0[None, :3, :3], "t2": pose0[None, :3, 3],
+        "K": inst0.K[None],
+    })
+    params, step = _restore_params(cfg, model, sample_batch, args.step,
+                                   reference_ckpt=args.reference_ckpt)
+    print(f"restored checkpoint at step {step}")
+
+    # Multi-chip: one coalesced batch serves data-parallel through the
+    # mesh (buckets that divide the data axis shard via shard_batch).
+    mesh = None
+    if len(jax.devices()) > 1:
+        from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.fit_local_mesh(cfg.mesh)
+
+    def build_request(spec: dict) -> dict:
+        inst = ds.instances[int(spec.get("instance", 0)) % ds.num_instances]
+        cx, cpose = inst.view(int(spec.get("cond_view", 0)) % len(inst))
+        _, tpose = inst.view(int(spec.get("target_view", 1)) % len(inst))
+        return {
+            "x": cx, "R1": cpose[:3, :3], "t1": cpose[:3, 3],
+            "R2": tpose[:3, :3], "t2": tpose[:3, 3], "K": inst.K,
+        }
+
+    if args.requests:
+        with open(args.requests) as fh:
+            specs = [json.loads(ln) for ln in fh if ln.strip()]
+    else:
+        specs = [{"instance": args.instance, "cond_view": args.cond_view,
+                  "target_view": i + 1, "seed": args.seed + i}
+                 for i in range(args.num_requests)]
+    if not specs:
+        raise SystemExit("no requests (empty --requests file)")
+
+    os.makedirs(args.out, exist_ok=True)
+    service = SamplingService(model, params, cfg.diffusion, cfg.serve,
+                              mesh=mesh, results_folder=args.out)
+    try:
+        tickets = []
+        for i, spec in enumerate(specs):
+            try:
+                tickets.append((i, service.submit(
+                    build_request(spec),
+                    seed=int(spec.get("seed", args.seed + i)),
+                    sample_steps=spec.get("sample_steps",
+                                          args.sample_steps),
+                    guidance_weight=spec.get("guidance_weight"),
+                    deadline_ms=spec.get("deadline_ms"))))
+            except Rejected as e:
+                print(f"request {i}: rejected ({e})")
+        served = 0
+        for i, ticket in tickets:
+            try:
+                img = ticket.result()
+            except Exception as e:
+                print(f"request {i}: failed ({e})")
+                continue
+            save_image(img, os.path.join(args.out, f"request_{i:04d}.png"))
+            served += 1
+    finally:
+        service.stop()
+    print(json.dumps(dict(service.summary(), served=served,
+                          submitted=len(specs),
+                          checkpoint_step=step)))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # eval
 # ---------------------------------------------------------------------------
 def cmd_eval(args, overrides: List[str]) -> int:
     from novel_view_synthesis_3d_tpu.parallel import dist
 
     dist.require_backend()  # sub-60s structured failure on a dead tunnel
+    setup_compilation_cache()  # repeat evals skip the XLA compile
 
     import jax
 
@@ -511,6 +623,32 @@ def make_parser() -> argparse.ArgumentParser:
                    help="also write denoise.gif showing the reverse "
                         "diffusion of the first view (not with --stochastic)")
 
+    p = sub.add_parser("serve",
+                       help="micro-batched sampling service: coalesce "
+                            "concurrent requests into padded power-of-two "
+                            "buckets served from a compiled-program cache")
+    _add_common(p)
+    p.add_argument("folder", nargs="?", default=None)
+    p.add_argument("--out", default="./serve",
+                   help="request PNGs + the service events.csv land here")
+    p.add_argument("--requests", default=None, metavar="JSONL",
+                   help="JSON-lines request file (fields: instance, "
+                        "cond_view, target_view, seed, sample_steps, "
+                        "guidance_weight, deadline_ms); default: a "
+                        "--num-requests demo sweep")
+    p.add_argument("--num-requests", type=int, default=8)
+    p.add_argument("--instance", type=int, default=0)
+    p.add_argument("--cond-view", type=int, default=0)
+    p.add_argument("--sample-steps", type=int, default=None,
+                   help="respaced steps (default: serve.sample_steps or "
+                        "diffusion.sample_timesteps)")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: latest)")
+    p.add_argument("--reference-ckpt", default=None,
+                   help="serve a reference-format flax msgpack checkpoint; "
+                        "pair with --preset reference")
+    p.add_argument("--seed", type=int, default=0)
+
     p = sub.add_parser("eval", help="PSNR/SSIM/FID over held-out views")
     _add_common(p)
     p.add_argument("folder", nargs="?", default=None)
@@ -582,6 +720,7 @@ def make_parser() -> argparse.ArgumentParser:
 _COMMANDS = {
     "train": cmd_train,
     "sample": cmd_sample,
+    "serve": cmd_serve,
     "eval": cmd_eval,
     "prep": cmd_prep,
     "config": cmd_config,

@@ -316,6 +316,39 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Sampling-service front-end (sample/service.py; `nvs3d serve`).
+
+    The service coalesces concurrent requests into padded batches at
+    power-of-two bucket sizes and dispatches each bucket through an LRU
+    cache of compiled sampler programs — warm traffic never recompiles
+    (docs/DESIGN.md "Serving")."""
+
+    # Largest coalesced batch (top of the power-of-two bucket ladder).
+    max_batch: int = 8
+    # Bounded request queue: a submit past this depth is REJECTED with a
+    # reason (events.csv `reject` row) instead of growing latency unboundedly.
+    queue_depth: int = 64
+    # How long the batcher holds the oldest queued request open for
+    # co-riders before dispatching a partial bucket. 0 = dispatch
+    # immediately (no coalescing beyond what is already queued).
+    flush_timeout_ms: float = 10.0
+    # Default per-request queue-wait deadline; a request still undispatched
+    # past it is rejected (deadline_exceeded). 0 = no deadline. Requests
+    # can override per call.
+    default_deadline_ms: float = 0.0
+    # LRU capacity of the sampler-program cache, in (bucket, sampler
+    # config) entries. Each entry holds a compiled XLA program.
+    program_cache_entries: int = 8
+    # Respaced reverse-process steps for served requests; 0 = use
+    # diffusion.sample_timesteps.
+    sample_steps: int = 0
+    # Where the service writes its events.csv (rejections, deadline
+    # expiries) — same schema as the trainer's.
+    results_folder: str = "./serve"
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device mesh for distributed execution (replaces reference pmap, §2.3).
 
@@ -335,6 +368,7 @@ class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
     # ------------------------------------------------------------------
     # Validation
@@ -527,6 +561,28 @@ class Config:
                 errors.append(
                     f"train.watchdog.{nm}={getattr(wd, nm)} must be >= 0 "
                     "(0 disables that deadline)")
+        sv = self.serve
+        if sv.max_batch < 1 or (sv.max_batch & (sv.max_batch - 1)) != 0:
+            errors.append(
+                f"serve.max_batch={sv.max_batch} must be a power of two "
+                "(the micro-batcher's bucket ladder is 1, 2, 4, …)")
+        if sv.queue_depth < 1:
+            errors.append(f"serve.queue_depth={sv.queue_depth} must be >= 1")
+        if sv.flush_timeout_ms < 0:
+            errors.append(
+                f"serve.flush_timeout_ms={sv.flush_timeout_ms} must be >= 0")
+        if sv.default_deadline_ms < 0:
+            errors.append(f"serve.default_deadline_ms="
+                          f"{sv.default_deadline_ms} must be >= 0")
+        if sv.program_cache_entries < 1:
+            errors.append(
+                f"serve.program_cache_entries={sv.program_cache_entries} "
+                "must be >= 1")
+        if sv.sample_steps < 0 or sv.sample_steps > self.diffusion.timesteps:
+            errors.append(
+                f"serve.sample_steps={sv.sample_steps} must be in "
+                f"[0, diffusion.timesteps={self.diffusion.timesteps}] "
+                "(0 = diffusion.sample_timesteps)")
         for axis in ("model", "seq"):
             if getattr(self.mesh, axis) < 1:
                 errors.append(f"mesh.{axis} must be >= 1")
@@ -572,6 +628,7 @@ class Config:
             data=build(DataConfig, d.get("data", {})),
             train=build(TrainConfig, d.get("train", {})),
             mesh=build(MeshConfig, d.get("mesh", {})),
+            serve=build(ServeConfig, d.get("serve", {})),
         )
 
     @classmethod

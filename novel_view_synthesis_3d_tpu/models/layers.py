@@ -102,14 +102,28 @@ class GroupNorm(nn.Module):
     @nn.compact
     def __call__(self, h: jnp.ndarray) -> jnp.ndarray:
         B, F, H, W, C = h.shape
-        if self.fused and self.per_frame and fits_vmem(H * W, C, h.dtype):
-            scale, bias = _GNParams(features=C, name="GroupNorm_0")()
-            # out_dtype=self.dtype matches the XLA branch's semantics:
-            # nn.GroupNorm casts to the module dtype, THEN swish runs in
-            # that dtype.
-            y = fused_group_norm(h.reshape(B * F, H * W, C), scale, bias,
-                                 32, 1e-6, self.act, self.dtype)
-            return y.reshape(B, F, H, W, C)
+        if self.fused and self.per_frame:
+            if fits_vmem(H * W, C, h.dtype):
+                scale, bias = _GNParams(features=C, name="GroupNorm_0")()
+                # out_dtype=self.dtype matches the XLA branch's semantics:
+                # nn.GroupNorm casts to the module dtype, THEN swish runs
+                # in that dtype.
+                y = fused_group_norm(h.reshape(B * F, H * W, C), scale,
+                                     bias, 32, 1e-6, self.act, self.dtype)
+                return y.reshape(B, F, H, W, C)
+            # Silent fallbacks hide perf cliffs: paper256's top level
+            # loses the fused kernel here and the byte budget regresses
+            # with no trace. One line per (H·W, C, dtype) per process —
+            # fired at trace time, so steady-state steps stay clean.
+            from novel_view_synthesis_3d_tpu.utils.profiling import log_once
+
+            log_once(
+                ("fused_gn_fallback", H * W, C, str(h.dtype)),
+                f"note: fused GroupNorm falling back to XLA for slab "
+                f"(H·W={H * W}, C={C}, {h.dtype}): "
+                f"{H * W * C * jnp.dtype(h.dtype).itemsize} bytes exceeds "
+                "the kernel's VMEM budget (ops/fused_groupnorm.py) — this "
+                "level pays ~3 HBM passes per GN instead of 2")
         norm = nn.GroupNorm(num_groups=32, dtype=self.dtype)
         if self.per_frame:
             y = norm(h.reshape(B * F, H, W, C)).reshape(B, F, H, W, C)

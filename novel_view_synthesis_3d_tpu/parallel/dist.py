@@ -23,6 +23,12 @@ import jax
 # stalled-mid-run are different diagnoses.
 EXIT_BACKEND_UNREACHABLE = 3
 
+# Last require_backend failure reason (one line), for callers that emit a
+# structured result object after catching the SystemExit — bench.py writes
+# {"rc": 3, "reason": ...} so a BENCH_r0*.json records WHY a round produced
+# no number instead of a bare "parsed": null.
+LAST_FAILURE_REASON: Optional[str] = None
+
 
 def _is_initialized() -> bool:
     """jax.distributed.is_initialized() with a fallback for jax builds that
@@ -159,6 +165,9 @@ def require_backend(budget_s: Optional[float] = None,
           f"({attempt} probe attempt(s); last: {reason}). Set "
           "JAX_PLATFORMS=cpu for a CPU run, or fix the accelerator "
           "tunnel.", file=sys.stderr)
+    global LAST_FAILURE_REASON
+    LAST_FAILURE_REASON = (f"backend unreachable within {budget_s:.0f}s "
+                           f"({attempt} attempt(s); last: {reason})")
     raise SystemExit(EXIT_BACKEND_UNREACHABLE)
 
 

@@ -294,6 +294,17 @@ def num_data_shards(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS]
 
 
+def divides_data_axis(mesh: Optional[Mesh], n: int) -> bool:
+    """True when a batch of n rows can shard evenly over the 'data' axis.
+
+    The serving micro-batcher (sample/service.py) uses this to pick its
+    bucket ladder: buckets that divide the data axis dispatch through
+    `shard_batch` (one coalesced batch served data-parallel across the
+    mesh); anything else would leave ragged shards, so those buckets fall
+    back to single-device dispatch rather than crash mid-serve."""
+    return mesh is not None and n % num_data_shards(mesh) == 0
+
+
 def validate_global_batch(mesh: Mesh, global_batch_size: int) -> None:
     n = num_data_shards(mesh)
     if global_batch_size % n != 0:

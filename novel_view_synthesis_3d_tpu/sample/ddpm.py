@@ -70,10 +70,25 @@ def _doubled_pose_embs(model, params, cond: dict):
     return precompute_pose_embs(model, params, doubled, mask)
 
 
+def _step_noise(key, z):
+    """N(0,1) noise for one reverse step.
+
+    `key` is either a single PRNG key (one stream for the whole batch —
+    the training-side samplers' historical behavior, bit-preserved) or a
+    (B, 2) stacked key vector: one independent stream PER SAMPLE, which
+    makes row i of a batched reverse process depend only on (cond_i,
+    key_i) — the property `make_request_sampler` needs so the serving
+    micro-batcher's padding and batch composition cannot change any
+    request's image."""
+    if key.ndim == 2:
+        return jax.vmap(lambda k: jax.random.normal(k, z.shape[1:]))(key)
+    return jax.random.normal(key, z.shape)
+
+
 def _posterior_sample(schedule: DiffusionSchedule, x0, z, t, key):
     """Draw z_{t−1} ~ q(z_{t−1}|z_t, x̂₀); noiseless at t=0."""
     mean, _, log_var = schedule.q_posterior(x0, z, t)
-    noise = jax.random.normal(key, z.shape)
+    noise = _step_noise(key, z)
     nonzero = jnp.reshape(  # no noise at the final step; scalar or (B,) t
         (t > 0).astype(z.dtype), jnp.shape(t) + (1,) * (z.ndim - jnp.ndim(t)))
     return mean + nonzero * jnp.exp(0.5 * log_var) * noise
@@ -144,7 +159,7 @@ def _make_update(schedule: DiffusionSchedule, config: DiffusionConfig,
         eta = config.ddim_eta
 
         def update(z, t, outs, key, aux):
-            noise = jax.random.normal(key, z.shape)
+            noise = _step_noise(key, z)
             return schedule.ddim_step(to_x0(z, t, outs), z, t, noise, eta), aux
 
         return update, no_aux
@@ -245,6 +260,53 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
             last = z if trajectory_views is None else z[:trajectory_views]
             traj = jnp.concatenate([traj, last[None]], axis=0)
         return carry[0], traj
+
+    return sample
+
+
+def make_request_sampler(model, schedule: DiffusionSchedule,
+                         config: DiffusionConfig):
+    """Per-sample-keyed sampler for the serving micro-batcher
+    (sample/service.py).
+
+    sample(params, keys, cond) -> (B, H, W, 3) with keys a (B, 2) stack
+    of PRNG keys: row i's init noise and every per-step draw come from
+    keys[i]'s stream ONLY, so the output row depends on (cond row i,
+    keys[i]) alone — coalescing a request into any bucket, alongside any
+    co-riders or pad rows, reproduces its solo image (CPU/TPU row math is
+    per-sample; see test_serve.py padding-invariance tests). The
+    training-side `make_sampler` keeps its single-key whole-batch stream
+    untouched (bit-compatibility with every golden/e2e test).
+
+    The model forward, CFG doubling, and pose-embedding hoist are shared
+    with `make_sampler`; only the RNG layout differs.
+    """
+    w = config.guidance_weight
+    update, init_aux = _make_update(schedule, config)
+    T = schedule.num_timesteps
+
+    @jax.jit
+    def sample(params, keys, cond: dict) -> jnp.ndarray:
+        z_shape = cond["x"].shape[-3:]  # (H, W, 3)
+        both = jax.vmap(jax.random.split)(keys)       # (B, 2, 2)
+        keys0, k_init = both[:, 0], both[:, 1]
+        z0 = jax.vmap(lambda k: jax.random.normal(k, z_shape))(k_init)
+        ts = jnp.arange(T - 1, -1, -1)
+        pose_embs = _doubled_pose_embs(model, params, cond)
+
+        def body(carry, t):
+            z, ks, aux = carry
+            both = jax.vmap(jax.random.split)(ks)
+            ks, k_step = both[:, 0], both[:, 1]
+            batch = dict(cond, z=z,
+                         logsnr=jnp.full((z.shape[0],), schedule.logsnr(t)))
+            outs = _cfg_eps(model, params, batch, w, pose_embs=pose_embs)
+            # k_step is (B, 2): _step_noise draws per-sample streams.
+            z, aux = update(z, t, outs, k_step, aux)
+            return (z, ks, aux), None
+
+        (z, _, _), _ = jax.lax.scan(body, (z0, keys0, init_aux(z0)), ts)
+        return z
 
     return sample
 

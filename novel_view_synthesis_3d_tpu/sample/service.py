@@ -1,0 +1,471 @@
+"""Sampling service: dynamic micro-batching over a bounded request queue.
+
+The ROADMAP north star is "serve heavy traffic from millions of users",
+but until this module sampling was a one-shot CLI path: every request
+shape compiled a fresh XLA program and requests ran one at a time at
+batch sizes far below what keeps an accelerator's MXU fed. The reverse
+process is 100s of UNet steps on a doubled-batch (CFG), so per-request
+latency is dominated by device compute — exactly the regime where
+micro-batching (torchgpipe, arXiv 2004.09910) and keeping the device fed
+from the host side (MinatoLoader, arXiv 2509.10712) pay off.
+
+Architecture (docs/DESIGN.md "Serving"):
+
+  - a BOUNDED request queue with backpressure: a submit past
+    `serve.queue_depth` is rejected immediately with a reason (and an
+    events.csv `reject` row — the trainer's fault-event convention)
+    instead of growing tail latency without bound;
+  - a worker thread COALESCES queued requests into one batch: it holds
+    the oldest request open for `serve.flush_timeout_ms` so co-riders
+    can join, up to `serve.max_batch`, and pads the group to the next
+    power-of-two BUCKET size (pad rows are repeats of the last request
+    and are sliced off the result — `make_request_sampler`'s per-sample
+    RNG streams guarantee padding cannot change any request's image);
+  - an LRU SAMPLER-PROGRAM CACHE keyed by (bucket, image size, k,
+    sampler/steps/guidance config): warm traffic never recompiles, and
+    the bucket ladder bounds the number of distinct programs to
+    log2(max_batch)+1 per sampler config;
+  - per-request DEADLINES: a request still queued past its deadline is
+    rejected (deadline_exceeded) rather than served uselessly late;
+  - SHARD-AWARE dispatch: when the service is built over a device mesh,
+    buckets that divide the mesh 'data' axis dispatch through
+    `parallel/mesh.shard_batch`, so a multi-chip mesh serves one
+    coalesced batch data-parallel;
+  - instrumentation via `utils/profiling.ServiceStats`: per-request
+    queue-wait / compile / device spans and a requests-per-second
+    counter (tools/serve_bench.py reads these).
+"""
+
+from __future__ import annotations
+
+import collections
+import csv
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from novel_view_synthesis_3d_tpu.config import DiffusionConfig, ServeConfig
+from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
+from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+from novel_view_synthesis_3d_tpu.sample.ddpm import make_request_sampler
+from novel_view_synthesis_3d_tpu.utils.profiling import ServiceStats
+
+COND_KEYS = ("x", "R1", "t1", "R2", "t2", "K")
+
+
+class ServeError(RuntimeError):
+    """Base class for request-level serving failures."""
+
+
+class Rejected(ServeError):
+    """Request refused at submit time (backpressure / bad input)."""
+
+
+class DeadlineExceeded(ServeError):
+    """Request expired in the queue before dispatch."""
+
+
+def bucket_for(n: int, max_batch: int) -> int:
+    """Smallest power-of-two bucket >= n, capped at max_batch."""
+    if n < 1:
+        raise ValueError(f"bucket_for: n={n} must be >= 1")
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max_batch)
+
+
+class Ticket:
+    """Handle for one submitted request; `result()` blocks until served.
+
+    `timing` (populated at resolution) carries the request's spans:
+    queue_wait_s, device_s (or compile_s for the batch that warmed its
+    program), plus the bucket and real batch size it rode in."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self.timing: dict = {}
+        self._done = threading.Event()
+        self._image: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not served within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._image
+
+    # -- resolution (worker thread) ------------------------------------
+    def _resolve(self, image: np.ndarray, timing: dict) -> None:
+        self._image = image
+        self.timing.update(timing)
+        self._done.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+
+class _Request:
+    __slots__ = ("ticket", "cond", "key", "program_key", "t_submit",
+                 "deadline_s")
+
+    def __init__(self, ticket: Ticket, cond: Dict[str, np.ndarray],
+                 key: np.ndarray, program_key: tuple, t_submit: float,
+                 deadline_s: float):
+        self.ticket = ticket
+        self.cond = cond
+        self.key = key
+        self.program_key = program_key
+        self.t_submit = t_submit
+        self.deadline_s = deadline_s  # 0 = none
+
+
+class SamplerProgramCache:
+    """LRU of compiled request-sampler programs.
+
+    Keyed by (bucket, sidelength, num_cond_frames, sampler, steps,
+    guidance, cfg_rescale, ddim_eta, objective): everything that changes
+    the XLA program a served batch runs. `builds` counts cache misses
+    (each one is a retrace + compile); `jit_entries()` sums the live
+    jitted functions' compiled-executable counts — the counter the
+    zero-recompile-after-warmup assertion reads (tools/serve_bench.py,
+    tests/test_serve.py)."""
+
+    def __init__(self, factory: Callable[..., Callable], capacity: int):
+        self._factory = factory
+        self._capacity = max(1, capacity)
+        self._entries: "collections.OrderedDict[tuple, dict]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.builds = 0
+        self.hits = 0
+
+    def get(self, key: tuple, *factory_args) -> dict:
+        """Entry dict {fn, warm} for `key`, building (and evicting) as
+        needed. `warm` flips True after the entry's first dispatch — the
+        span-labeling bit (first call = compile span)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry
+        fn = self._factory(*factory_args)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:  # raced another builder
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry
+            entry = {"fn": fn, "warm": False}
+            self._entries[key] = entry
+            self.builds += 1
+            while len(self._entries) > self._capacity:
+                self._entries.popitem(last=False)
+            return entry
+
+    def jit_entries(self) -> int:
+        with self._lock:
+            fns = [e["fn"] for e in self._entries.values()]
+        total = 0
+        for fn in fns:
+            size = getattr(fn, "_cache_size", None)
+            total += int(size()) if callable(size) else 1
+        return total
+
+    def counters(self) -> dict:
+        with self._lock:
+            n = len(self._entries)
+            builds, hits = self.builds, self.hits
+        return {"programs_built": builds, "cache_hits": hits,
+                "programs_live": n, "jit_cache_entries": self.jit_entries()}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class SamplingService:
+    """Micro-batching front-end over `make_request_sampler`.
+
+    submit() is thread-safe and non-blocking (reject-on-full); a single
+    worker thread batches, dispatches, and resolves tickets. One service
+    instance serves ONE model + checkpoint; per-request knobs (seed,
+    sample_steps, guidance_weight, deadline) ride on the request, and
+    requests are only coalesced with others running the same program.
+    """
+
+    def __init__(self, model, params, diffusion: DiffusionConfig,
+                 serve: Optional[ServeConfig] = None, *,
+                 mesh=None, results_folder: Optional[str] = None,
+                 start: bool = True):
+        self.model = model
+        self.diffusion = diffusion
+        self.serve = serve or ServeConfig()
+        self.mesh = mesh
+        self.stats = ServiceStats()
+        self._results_folder = results_folder or self.serve.results_folder
+        self._events_lock = threading.Lock()
+        # Params placement: replicated over the mesh when serving
+        # data-parallel, else committed to the default device (host-side
+        # numpy params would re-upload per dispatch).
+        if mesh is not None:
+            self.params = mesh_lib.replicate(mesh, params)
+        else:
+            self.params = jax.device_put(params, jax.devices()[0])
+        # Bucket ladder: powers of two up to max_batch; with a mesh, only
+        # buckets the 'data' axis divides evenly are shard-dispatchable —
+        # the others still serve, on the default device.
+        self._buckets = []
+        b = 1
+        while b <= self.serve.max_batch:
+            self._buckets.append(b)
+            b *= 2
+        self._programs = SamplerProgramCache(
+            self._build_program, self.serve.program_cache_entries)
+        self._lock = threading.Lock()
+        self._queue_cv = threading.Condition(self._lock)
+        self._queue: collections.deque = collections.deque()
+        self._next_id = 0
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "SamplingService":
+        if self._worker is None:
+            self._stop.clear()
+            self._worker = threading.Thread(
+                target=self._run, daemon=True, name="sampling-service")
+            self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the worker; queued-but-undispatched requests fail with
+        Rejected('service stopped')."""
+        self._stop.set()
+        with self._queue_cv:
+            self._queue_cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=10.0)
+            self._worker = None
+        with self._lock:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for req in leftovers:
+            req.ticket._fail(Rejected("service stopped"))
+
+    def __enter__(self) -> "SamplingService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission ----------------------------------------------------
+    def submit(self, cond: Dict[str, np.ndarray], *, seed: int = 0,
+               sample_steps: Optional[int] = None,
+               guidance_weight: Optional[float] = None,
+               deadline_ms: Optional[float] = None) -> Ticket:
+        """Enqueue one request; returns immediately with a Ticket.
+
+        `cond` holds UNBATCHED conditioning: x (H, W, 3), R1/R2 (3, 3),
+        t1/t2 (3,), K (3, 3) — the service stacks requests into the
+        batch axis. Raises Rejected when the queue is full (the events
+        log records why), or on malformed conditioning.
+        """
+        missing = [k for k in COND_KEYS if k not in cond]
+        if missing:
+            raise Rejected(f"request missing conditioning keys {missing}")
+        x = np.asarray(cond["x"])
+        if x.ndim != 3:
+            raise Rejected(
+                f"cond['x'] must be unbatched (H, W, 3); got {x.shape}")
+        steps = sample_steps or self.serve.sample_steps or \
+            self.diffusion.sample_timesteps
+        w = (self.diffusion.guidance_weight
+             if guidance_weight is None else float(guidance_weight))
+        if deadline_ms is None:
+            deadline_ms = self.serve.default_deadline_ms
+        program_key = (int(x.shape[0]), int(x.shape[1]), int(steps), w)
+        ticket = Ticket(self._claim_id())
+        req = _Request(
+            ticket,
+            {k: np.asarray(cond[k]) for k in COND_KEYS},
+            np.asarray(jax.random.PRNGKey(seed)),
+            program_key, time.monotonic(),
+            float(deadline_ms) / 1000.0 if deadline_ms else 0.0)
+        with self._queue_cv:
+            if self._stop.is_set():
+                raise Rejected("service stopped")
+            if len(self._queue) >= self.serve.queue_depth:
+                self._log_event(
+                    ticket.request_id, "reject",
+                    f"queue full (depth {self.serve.queue_depth})")
+                raise Rejected(
+                    f"queue full (serve.queue_depth="
+                    f"{self.serve.queue_depth}); retry with backoff")
+            self._queue.append(req)
+            self._queue_cv.notify_all()
+        return ticket
+
+    def _claim_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    # -- observability -------------------------------------------------
+    def compile_counters(self) -> dict:
+        return self._programs.counters()
+
+    def summary(self) -> dict:
+        return dict(self.stats.summary(), **self.compile_counters())
+
+    def _log_event(self, request_id: int, kind: str, detail: str) -> None:
+        """events.csv append, schema-compatible with the trainer's
+        MetricsLogger.log_event (step,event,detail — request id in the
+        step column). Rare by construction (rejections and expiries)."""
+        path = os.path.join(self._results_folder, "events.csv")
+        try:
+            with self._events_lock:
+                os.makedirs(self._results_folder, exist_ok=True)
+                new = not os.path.exists(path) or os.path.getsize(path) == 0
+                with open(path, "a", newline="") as fh:
+                    w = csv.writer(fh)
+                    if new:
+                        w.writerow(["step", "event", "detail"])
+                    w.writerow([request_id, kind, detail])
+        except OSError:
+            pass  # the event log must never be the serving fault
+
+    # -- batching worker -----------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            group = self._collect_group()
+            if not group:
+                continue
+            try:
+                self._dispatch(group)
+            except BaseException as exc:  # resolve, don't kill the worker
+                for req in group:
+                    req.ticket._fail(
+                        ServeError(f"dispatch failed: {exc!r}"))
+
+    def _collect_group(self) -> List[_Request]:
+        """Pop one coalescable group: same program key, oldest first,
+        held open for flush_timeout_ms or until max_batch riders."""
+        flush_s = self.serve.flush_timeout_ms / 1000.0
+        with self._queue_cv:
+            while not self._queue and not self._stop.is_set():
+                self._queue_cv.wait(timeout=0.1)
+            if self._stop.is_set():
+                return []
+            first = self._queue[0]
+            key = first.program_key
+            deadline = first.t_submit + flush_s
+            while True:
+                ready = sum(1 for r in self._queue if r.program_key == key)
+                if ready >= self.serve.max_batch or self._stop.is_set():
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._queue_cv.wait(timeout=min(remaining, 0.05))
+            if self._stop.is_set():
+                return []  # stop() fails whatever is still queued
+            group: List[_Request] = []
+            kept: List[_Request] = []
+            for r in self._queue:
+                if (r.program_key == key
+                        and len(group) < self.serve.max_batch):
+                    group.append(r)
+                else:
+                    kept.append(r)
+            self._queue.clear()
+            self._queue.extend(kept)
+        # Expire requests whose queue wait blew their deadline — serving
+        # them would spend device time on an answer nobody is waiting for.
+        now = time.monotonic()
+        live = []
+        for r in group:
+            waited = now - r.t_submit
+            if r.deadline_s and waited > r.deadline_s:
+                self._log_event(
+                    r.ticket.request_id, "deadline",
+                    f"queued {waited * 1e3:.1f}ms > deadline "
+                    f"{r.deadline_s * 1e3:.0f}ms")
+                r.ticket._fail(DeadlineExceeded(
+                    f"request waited {waited * 1e3:.1f}ms, deadline was "
+                    f"{r.deadline_s * 1e3:.0f}ms"))
+            else:
+                live.append(r)
+        return live
+
+    def _build_program(self, steps: int, w: float):
+        import dataclasses
+
+        dcfg = self.diffusion
+        if w != dcfg.guidance_weight:
+            dcfg = dataclasses.replace(dcfg, guidance_weight=w)
+        schedule = sampling_schedule(dcfg, steps)
+        return make_request_sampler(self.model, schedule, dcfg)
+
+    def _dispatch(self, group: List[_Request]) -> None:
+        n = len(group)
+        bucket = bucket_for(n, self.serve.max_batch)
+        H, W, steps, w = group[0].program_key
+        # Pad rows repeat the LAST request (any valid row works — per-
+        # sample RNG streams make rows independent); their outputs are
+        # dropped below. Pad keys are zeros: never read by real rows.
+        pad = bucket - n
+        cond = {
+            k: np.stack([r.cond[k] for r in group]
+                        + [group[-1].cond[k]] * pad)
+            for k in COND_KEYS
+        }
+        keys = np.stack([r.key for r in group]
+                        + [np.zeros_like(group[-1].key)] * pad)
+        if mesh_lib.divides_data_axis(self.mesh, bucket):
+            cond_dev = mesh_lib.shard_batch(self.mesh, cond)
+            keys_dev = mesh_lib.shard_batch(self.mesh, keys)
+        else:
+            dev = jax.devices()[0]
+            cond_dev = jax.device_put(cond, dev)
+            keys_dev = jax.device_put(keys, dev)
+        entry = self._programs.get((bucket, H, W, steps, w), steps, w)
+        cold = not entry["warm"]
+        t_disp = time.monotonic()
+        t0 = time.perf_counter()
+        imgs = np.asarray(jax.device_get(
+            entry["fn"](self.params, keys_dev, cond_dev)))
+        elapsed = time.perf_counter() - t0
+        entry["warm"] = True
+        span = "compile" if cold else "device"
+        for i, r in enumerate(group):
+            timing = {
+                "queue_wait_s": max(0.0, t_disp - r.t_submit),
+                f"{span}_s": elapsed,
+                "bucket": bucket,
+                "batch_n": n,
+            }
+            self.stats.record_span("queue_wait", timing["queue_wait_s"])
+            self.stats.record_span(span, elapsed)
+            r.ticket._resolve(imgs[i], timing)
+        self.stats.count_requests(n)
+
+
+def request_cond_from_batch(batch: Dict[str, np.ndarray],
+                            i: int = 0) -> Dict[str, np.ndarray]:
+    """Unbatched request conditioning from row i of a batched cond dict
+    (test/bench convenience)."""
+    return {k: np.asarray(batch[k])[i] for k in COND_KEYS}

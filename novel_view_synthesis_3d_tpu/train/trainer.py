@@ -11,8 +11,10 @@ sample dumps, and optional jax.profiler traces.
 from __future__ import annotations
 
 import os
+import queue
 import signal
-from typing import Iterator, Optional
+import threading
+from typing import Callable, Iterator, Optional
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +60,93 @@ def _sample_model_batch(batch: dict) -> dict:
         "t2": jnp.asarray(batch["t2"]),
         "K": jnp.asarray(batch["K"]),
     }
+
+
+class _DevicePrefetcher:
+    """Bounded background uploader: runs `make_batch` (host fetch + async
+    device_put) up to `depth` batches ahead of the consumer.
+
+    Replaces the hardcoded depth-1 prefetch slot: with depth > 1 a slow
+    fetch (cold page cache, contended loader workers) is absorbed by the
+    buffered batches instead of stalling the very next step. `data.prefetch`
+    sets the depth — the same knob that sizes the loaders' host-side
+    prefetch, so one number describes the whole feed pipeline.
+
+    Terminal conditions ride the queue in-band: StopIteration from the
+    data iterator parks the prefetcher in an 'ended' state (get() raises
+    StopIteration — only fatal if the trainer actually needs another
+    batch, preserving the finite-injected-iterator contract), and any
+    other exception re-raises in the consumer. The producer thread is a
+    daemon: a fetch wedged in uninterruptible IO can't block interpreter
+    exit (the run watchdog catches the stall itself — the consumer blocks
+    inside its armed `data_fetch` phase once the buffer drains)."""
+
+    _END = "end"
+    _ERROR = "error"
+    _BATCH = "batch"
+
+    def __init__(self, make_batch: Callable[[], object], depth: int):
+        self._make_batch = make_batch
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._terminal = None  # sticky ("end"|"error", exc) once popped
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="device-prefetch")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = (self._BATCH, self._make_batch())
+            except StopIteration:
+                item = (self._END, None)
+            except BaseException as exc:  # propagate to the consumer
+                item = (self._ERROR, exc)
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+            if item[0] != self._BATCH:
+                return
+
+    def get(self):
+        """Next device batch; raises StopIteration at stream end, or the
+        producer's exception. Blocks while the buffer is empty — callers
+        arm the watchdog's data_fetch phase around this."""
+        if self._terminal is not None:
+            kind, exc = self._terminal
+            raise StopIteration if kind == self._END else exc
+        kind, val = self._q.get()
+        if kind == self._BATCH:
+            return val
+        self._terminal = (kind, val)
+        if kind == self._END:
+            raise StopIteration
+        raise val
+
+    def flush(self) -> None:
+        """Drop buffered batches (rollback: the staged data is suspect).
+        Terminal items stay sticky; the producer simply refills."""
+        while True:
+            try:
+                kind, val = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if kind != self._BATCH:
+                self._terminal = (kind, val)
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Drain so a producer blocked on a full queue can observe _stop.
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
 
 
 class Trainer:
@@ -153,7 +242,12 @@ class Trainer:
             mesh=self.mesh if config.model.sequence_parallel else None)
         first_batch = next(self.data_iter)
         self._held_batch = first_batch
-        self._device_batch = None  # depth-1 prefetch slot (see train())
+        self._device_batch = None  # staged batch for the NEXT dispatch
+        # Background device prefetcher (train()): fetches + uploads up to
+        # data.prefetch batches ahead. The lock serializes its data_iter
+        # access against main-thread peeks (eval probe, dump_samples).
+        self._prefetcher: Optional[_DevicePrefetcher] = None
+        self._data_lock = threading.Lock()
         # Fixed probe batch for eval_every: scoring the SAME views every
         # time makes the PSNR/SSIM curve comparable across steps (a fresh
         # random batch per eval would swing several dB on content alone).
@@ -336,16 +430,18 @@ class Trainer:
         return int(jax.device_get(self.state.step))
 
     def _next_batch(self) -> dict:
-        if self._held_batch is not None:
-            batch, self._held_batch = self._held_batch, None
-            return batch
-        return next(self.data_iter)
+        with self._data_lock:
+            if self._held_batch is not None:
+                batch, self._held_batch = self._held_batch, None
+                return batch
+            return next(self.data_iter)
 
     def _peek_batch(self) -> dict:
         """Look at the next batch without consuming it from the loop."""
-        if self._held_batch is None:
-            self._held_batch = next(self.data_iter)
-        return self._held_batch
+        with self._data_lock:
+            if self._held_batch is None:
+                self._held_batch = next(self.data_iter)
+            return self._held_batch
 
     # ------------------------------------------------------------------
     def _host_params(self):
@@ -429,7 +525,9 @@ class Trainer:
                    else None))
         self._adopt_restored_state(restored)
         self._anomalies_seen = 0
-        self._device_batch = None  # drop the prefetched (suspect) batch
+        self._device_batch = None  # drop the staged (suspect) batch
+        if self._prefetcher is not None:
+            self._prefetcher.flush()  # ...and the buffered ones behind it
         self.metrics.log_event(
             self.step, "rollback_restored",
             f"resumed at step {self.step} with reseeded rng")
@@ -485,8 +583,15 @@ class Trainer:
             self._host_ema, params)
         self._host_ema_step = step_now
 
-    def _upload_next_batch(self):
-        """Fetch the next host batch(es) and start the async device upload.
+    def _make_device_batch(self):
+        """One dispatch's worth of data: host fetch + async device upload.
+
+        Runs on the prefetcher thread (train()) up to data.prefetch
+        batches ahead of the consumer; the device_put inside shard_batch
+        is async, so buffered batches are in flight to HBM while the
+        device executes earlier steps. The stall drill keys on the fetch
+        ordinal — deterministic regardless of how far ahead the
+        prefetcher runs.
 
         With train.steps_per_dispatch = K > 1, K consecutive batches are
         stacked on a leading step axis and consumed by one fused-scan
@@ -497,30 +602,41 @@ class Trainer:
         def clean(b):
             return {k: v for k, v in b.items() if k != "noise"}
 
-        # The host fetch is the part that stalls (starved loader, dead
-        # filesystem); the async device_put below never blocks. Armed as
-        # the watchdog's data_fetch phase, keyed by fetch ordinal for the
-        # deterministic stall drill.
-        with self.watchdog.phase("data_fetch"):
-            faultinject.maybe_stall("data", self._fetches)
-            self._fetches += 1
-            if spd <= 1:
-                host = clean(self._next_batch())
-            else:
-                host = [clean(self._next_batch()) for _ in range(spd)]
+        faultinject.maybe_stall("data", self._fetches)
+        self._fetches += 1
         if spd <= 1:
+            host = clean(self._next_batch())
             return mesh_lib.shard_batch(self.mesh, host)
+        host = [clean(self._next_batch()) for _ in range(spd)]
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *host)
         return mesh_lib.shard_batch(self.mesh, stacked, stacked=True)
+
+    def _staged_batch(self):
+        """The next device batch, blocking under the armed data_fetch
+        phase: when the prefetch buffer is drained by a stalled loader,
+        the consumer blocks HERE and the watchdog sees the stall exactly
+        as it did when the fetch was inline."""
+        with self.watchdog.phase("data_fetch"):
+            if self._prefetcher is not None:
+                return self._prefetcher.get()
+            return self._make_device_batch()
 
     def train(self) -> None:
         tcfg = self.config.train
         last_metrics = None
         profiling = False
         self.watchdog.start()
+        # Device prefetch honoring data.prefetch (was a hardcoded depth-1
+        # slot): the background thread keeps up to `depth` staged batches
+        # uploading while the device runs, so a fetch hiccup shorter than
+        # depth × step-time never stalls a dispatch.
+        self._prefetcher = _DevicePrefetcher(
+            self._make_device_batch, depth=self.config.data.prefetch)
         try:
             self._train_loop(tcfg, last_metrics, profiling)
         finally:
+            self._prefetcher.stop()
+            self._prefetcher = None
             self.watchdog.stop()
 
     def _train_loop(self, tcfg, last_metrics, profiling) -> None:
@@ -541,12 +657,12 @@ class Trainer:
                     jax.profiler.start_trace(
                         os.path.join(self.results_folder, "profile"))
                     profiling = True
-            # Depth-1 device prefetch: the batch for THIS step was uploaded
-            # while the previous step ran on device (shard_batch issues an
-            # async device_put). The first iteration pays one cold upload.
+            # Device batches come from the background prefetcher (up to
+            # data.prefetch staged uploads in flight); a StopIteration is
+            # only fatal when a step actually needs the missing batch.
             if self._device_batch is None:
                 try:
-                    self._device_batch = self._upload_next_batch()
+                    self._device_batch = self._staged_batch()
                 except StopIteration:
                     raise RuntimeError(
                         "data_iter exhausted before train.num_steps="
@@ -561,20 +677,12 @@ class Trainer:
                 first_dispatch = False
                 self.state, step_metrics = self.train_step(
                     self.state, self._device_batch)
-                # Overlap the NEXT batch's host fetch + upload with the
-                # device executing the step just dispatched. Inside the
-                # timed region deliberately: pipeline step time is
-                # max(device step, host data work), which is what the
-                # timer should report. A finite injected data_iter may
-                # exhaust here — only fatal if another step actually needs
-                # the batch (the loop top re-raises via _upload_next_batch).
-                try:
-                    self._device_batch = self._upload_next_batch()
-                except StopIteration:
-                    self._device_batch = None
+                self._device_batch = None  # consumed (donated) by the step
                 # Dispatch is async; the step read below device_gets
                 # state.step, which syncs on the whole step — keep it inside
                 # the timed region so timings reflect real device time.
+                # (The NEXT batch's fetch + upload overlaps this step on
+                # the prefetcher thread.)
                 step_now = self.step
                 self._step_host = step_now
                 # Deterministic hang drill: the injected sleep sits inside

@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import contextlib
 import os
+import sys
+import threading
 import time
-from typing import Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 import jax
 import numpy as np
@@ -81,6 +83,78 @@ class StepTimer:
             "p90_s": float(np.percentile(arr, 90)),
             "p99_s": float(np.percentile(arr, 99)),
         }
+
+
+class ServiceStats:
+    """Serving-side instrumentation: per-request span timings plus a
+    requests-per-second counter (sample/service.py).
+
+    Spans are named ('queue_wait', 'compile', 'device', …); each record is
+    one request's seconds in that span. Thread-safe — the micro-batcher's
+    worker thread records while callers read summaries. Percentiles use
+    the same p50/p90/p99 ladder as StepTimer so serving and training
+    timing read alike."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: Dict[str, List[float]] = {}
+        self._requests = 0
+        self._t0: Optional[float] = None
+
+    def record_span(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._spans.setdefault(name, []).append(float(seconds))
+
+    def count_requests(self, n: int = 1) -> None:
+        """Count completed requests; the RPS window opens at the first."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = time.perf_counter()
+            self._requests += n
+
+    def span_summary(self, name: str) -> dict:
+        with self._lock:
+            vals = list(self._spans.get(name, ()))
+        if not vals:
+            return {}
+        arr = np.asarray(vals)
+        return {
+            "count": int(arr.size),
+            "mean_s": float(arr.mean()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p90_s": float(np.percentile(arr, 90)),
+            "p99_s": float(np.percentile(arr, 99)),
+        }
+
+    def summary(self) -> dict:
+        with self._lock:
+            names = sorted(self._spans)
+            requests = self._requests
+            elapsed = (time.perf_counter() - self._t0
+                       if self._t0 is not None else 0.0)
+        out: dict = {"requests": requests}
+        if elapsed > 0:
+            out["requests_per_sec"] = requests / elapsed
+        for name in names:
+            out[name] = self.span_summary(name)
+        return out
+
+
+_logged_once: set = set()
+
+
+def log_once(key, msg: str) -> bool:
+    """Emit `msg` on stderr the FIRST time `key` is seen; drop repeats.
+
+    For conditions that are worth exactly one line per process — e.g. a
+    fused kernel silently falling back to XLA (ops/fused_groupnorm.py via
+    models/layers.py): the fallback fires per traced call site, and a log
+    per trace would be noise while zero logs hides a perf cliff."""
+    if key in _logged_once:
+        return False
+    _logged_once.add(key)
+    print(msg, file=sys.stderr, flush=True)
+    return True
 
 
 def enable_nan_checks(enabled: bool = True) -> None:

@@ -1,0 +1,67 @@
+"""Persistent XLA compilation-cache wiring, shared by every entry point.
+
+Before this module, only bench.py, tests/conftest.py, and the tools
+watcher enabled `jax_compilation_cache_dir` — each with its own copy of
+the three config updates — while the cli.py train/sample/eval entry
+points paid a full XLA recompile on every run (minutes at base128+
+through a remote tunnel). One helper, called by all of them:
+
+  - `JAX_COMPILATION_CACHE_DIR` (env) wins when set — the contract the
+    tools watcher and bench already rely on;
+  - otherwise a caller-supplied default directory (the CLI uses a
+    per-user cache dir, bench keeps its repo-local `.jax_cache`);
+  - `NVS3D_NO_COMPILE_CACHE=1` disables entirely (debugging cold
+    compiles, read-only home directories in exotic CI).
+
+Knobs (env-overridable because the right floor differs between a laptop
+CPU run and a pod): `NVS3D_CACHE_MIN_COMPILE_S` — only compilations at
+least this long are persisted (default 1.0 s, matching bench/tools);
+`NVS3D_CACHE_MIN_ENTRY_BYTES` — minimum executable size persisted
+(default -1 = everything, matching tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+# The CLI default: per-user, survives checkouts, never pollutes a
+# read-only repo dir. Overridable via JAX_COMPILATION_CACHE_DIR.
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "nvs3d_xla_cache")
+
+
+def setup_compilation_cache(
+        default_dir: Optional[str] = DEFAULT_CACHE_DIR,
+        min_compile_secs: float = 1.0,
+        min_entry_bytes: int = -1) -> Optional[str]:
+    """Enable the persistent compilation cache; returns the active dir.
+
+    Call before the first jitted dispatch (jax.config updates are
+    effective any time before a program is compiled). Returns None —
+    and leaves jax untouched — when caching is disabled or the cache
+    directory cannot be created (a broken cache dir must never kill a
+    run that would merely compile slower without it).
+    """
+    if os.environ.get("NVS3D_NO_COMPILE_CACHE") == "1":
+        return None
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_dir
+    if not cache_dir:
+        return None
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError as e:
+        print(f"warning: compilation cache dir {cache_dir!r} unavailable "
+              f"({e}); continuing without persistent cache", file=sys.stderr)
+        return None
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get("NVS3D_CACHE_MIN_COMPILE_S", min_compile_secs)))
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes",
+        int(os.environ.get("NVS3D_CACHE_MIN_ENTRY_BYTES", min_entry_bytes)))
+    return cache_dir

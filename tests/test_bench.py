@@ -62,9 +62,12 @@ def test_bench_data_python_backend():
 
 def test_bench_hard_fails_without_backend_instead_of_cpu_fallback():
     """BENCH r1/r2 postmortem contract: an unreachable accelerator must
-    produce rc=3 and NO JSON line (a CPU number labeled as the device
-    bench is worse than no number). The probe child is pointed at a
-    platform name that cannot initialize, with a tiny retry budget."""
+    produce rc=3 and never a CPU number labeled as the device bench. The
+    failure now comes WITH a structured {"rc": 3, "reason": ...} object
+    (value/platform null) so BENCH_r0*.json archives record WHY a round
+    produced no number instead of a bare "parsed": null. The probe child
+    is pointed at a platform name that cannot initialize, with a tiny
+    retry budget."""
     env = dict(os.environ,
                # A platform name no host provides: backend init fails
                # everywhere, including real TPU VMs (JAX_PLATFORMS="tpu"
@@ -77,5 +80,24 @@ def test_bench_hard_fails_without_backend_instead_of_cpu_fallback():
          "tiny64", "1"] + TINY,
         capture_output=True, text=True, timeout=120, env=env, cwd=REPO_ROOT)
     assert out.returncode == 3, (out.returncode, out.stderr[-500:])
-    assert not [l for l in out.stdout.splitlines() if l.startswith("{")]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, lines
+    result = json.loads(lines[0])
+    assert result["rc"] == 3
+    assert result["metric"] == "probe_failure"
+    assert result["value"] is None
+    assert result["platform"] is None  # never a CPU number in disguise
+    assert "unreachable" in result["reason"]
     assert "refusing to emit a CPU number" in out.stderr
+
+
+def test_probe_failure_result_shape():
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    obj = bench._probe_failure_result(3, None)
+    assert obj == {"rc": 3, "metric": "probe_failure", "value": None,
+                   "platform": None,
+                   "reason": "backend probe failed (no reason recorded)"}
+    assert bench._probe_failure_result(3, "tunnel wedged")["reason"] == \
+        "tunnel wedged"

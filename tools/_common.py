@@ -12,14 +12,23 @@ import os
 
 
 def init_jax_env() -> None:
+    import sys
+
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ["JAX_COMPILATION_CACHE_DIR"])
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # Shared compile-cache wiring (utils/xla_cache.py — the same helper
+    # the cli entry points use). Tools keep their historical env-only
+    # contract: no cache unless JAX_COMPILATION_CACHE_DIR is set (the
+    # watcher sets it explicitly per round).
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from novel_view_synthesis_3d_tpu.utils.xla_cache import (
+        setup_compilation_cache)
+
+    setup_compilation_cache(default_dir=None, min_entry_bytes=0)
 
 
 # --- TPU bench watcher machinery (round watchers supply only a MATRIX) ---

@@ -1,0 +1,272 @@
+"""Serving-throughput bench: micro-batched sampling service vs the
+one-shot sequential baseline. CPU-runnable — the first hardware-
+independent perf number in the BENCH trajectory.
+
+Prints ONE JSON line:
+
+  {"metric": "serve_rps_<preset>", "value": <requests/sec>,
+   "vs_baseline": <x>, "baseline_value": <requests/sec>, ...}
+
+`vs_baseline` compares against the status-quo serving path this PR
+replaces: per request, a FRESH `make_sampler` jit closure built and
+called sequentially at batch 1 — exactly what `nvs3d sample` does per
+invocation (every request re-traces; the persistent compilation cache,
+which the baseline is given too, spares it the full XLA compile). The
+service side answers from its warm sampler-program cache and coalesces
+concurrent requests into padded power-of-two buckets.
+
+`warm_sequential_sec_per_req` is reported for transparency: on a 1-core
+CPU host batching itself is roughly throughput-neutral (the chip is
+saturated at batch 1) and the win is program reuse; on accelerators with
+idle MXU headroom the batching term multiplies in.
+
+The run also performs a warm MIXED-SIZE sweep across >= 3 bucket sizes
+and asserts zero new sampler compilations (from the program cache's jit
+counters) — the "warm traffic never recompiles" contract. A violation
+exits rc=1.
+
+Usage:
+  python tools/serve_bench.py [--preset tiny64] [--concurrency 8]
+      [--requests 16] [--steps 4] [--sidelength 16] [--max-batch 4]
+
+`--sidelength` downsizes the preset's image for bench runtime (the
+tiny64 model is resolution-free; 16 px keeps the CPU run under ~2 min).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools._common import init_jax_env  # noqa: E402
+
+init_jax_env()
+
+# Like bench.py, the persistent compile cache is ON by default at the
+# repo-local path (env wins): it keeps bench re-runs warm AND gives the
+# one-shot baseline the same compile-cache benefit the CLI now has —
+# the reported vs_baseline is program-reuse + batching, not cold compiles.
+from novel_view_synthesis_3d_tpu.utils.xla_cache import (  # noqa: E402
+    setup_compilation_cache)
+
+setup_compilation_cache(
+    default_dir=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache"),
+    min_entry_bytes=0)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def build(preset: str, sidelength: int, steps: int):
+    from novel_view_synthesis_3d_tpu.config import get_preset
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    cfg = get_preset(preset).override(**{
+        "data.img_sidelength": sidelength,
+        "diffusion.sample_timesteps": steps,
+    }).validate()
+    model = XUNet(cfg.model)
+    batch = make_example_batch(batch_size=8, sidelength=sidelength, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((batch["x"].shape[0],)),
+        "R1": jnp.asarray(batch["R1"]), "t1": jnp.asarray(batch["t1"]),
+        "R2": jnp.asarray(batch["R2"]), "t2": jnp.asarray(batch["t2"]),
+        "K": jnp.asarray(batch["K"]),
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((batch["x"].shape[0],)), train=False)["params"]
+    params = jax.device_put(params, jax.devices()[0])
+    conds = [{k: np.asarray(mb[k])[i % mb["x"].shape[0]]
+              for k in ("x", "R1", "t1", "R2", "t2", "K")}
+             for i in range(max(8, mb["x"].shape[0]))]
+    return cfg, model, params, conds
+
+
+def bench_baseline(cfg, model, params, conds, n_requests: int) -> float:
+    """Sequential one-shot path: fresh jit closure per request, batch 1.
+
+    One untimed cold run populates the persistent compilation cache
+    first, so the baseline pays retrace + cache hit per request — the
+    best the old path can do — not the one-time cold compile."""
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        sampling_schedule)
+    from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
+
+    dcfg = cfg.diffusion
+    steps = dcfg.sample_timesteps
+
+    def one_shot(i: int):
+        sampler = make_sampler(model, sampling_schedule(dcfg, steps), dcfg)
+        cond = {k: jnp.asarray(v)[None]
+                for k, v in conds[i % len(conds)].items()}
+        return np.asarray(jax.device_get(
+            sampler(params, jax.random.PRNGKey(i), cond)))
+
+    one_shot(0)  # untimed: populates the persistent compile cache
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        one_shot(i + 1)
+    return n_requests / (time.perf_counter() - t0)
+
+
+def warm_service(service, conds, buckets) -> None:
+    """Compile each bucket's program once (group sizes = bucket sizes)."""
+    seed = 10_000
+    for b in buckets:
+        tickets = [service.submit(conds[j % len(conds)], seed=seed + j)
+                   for j in range(b)]
+        seed += b
+        for t in tickets:
+            t.result(timeout=600)
+
+
+def bench_service(service, conds, n_requests: int,
+                  concurrency: int) -> float:
+    """Closed-loop load: `concurrency` submitter threads, wall-clock RPS."""
+    per_thread = max(1, n_requests // concurrency)
+    total = per_thread * concurrency
+    errors = []
+
+    def client(tid: int):
+        for j in range(per_thread):
+            try:
+                service.submit(conds[(tid + j) % len(conds)],
+                               seed=1000 + tid * per_thread + j
+                               ).result(timeout=600)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    if errors:
+        raise SystemExit(f"serve_bench: {len(errors)} request(s) failed; "
+                         f"first: {errors[0]!r}")
+    return total / elapsed
+
+
+def mixed_size_sweep(service, conds, buckets) -> dict:
+    """Warm sweep across every bucket size; returns the compile-counter
+    delta (must be zero — warm traffic never recompiles)."""
+    before = service.compile_counters()
+    seed = 50_000
+    # Group sizes that land in each bucket, including non-power-of-two
+    # groups that PAD up (3 -> bucket 4).
+    sizes = sorted(set(
+        list(buckets) + [b - 1 for b in buckets if b - 1 >= 1]))
+    for n in sizes:
+        tickets = [service.submit(conds[j % len(conds)], seed=seed + j)
+                   for j in range(n)]
+        seed += n
+        for t in tickets:
+            t.result(timeout=600)
+    after = service.compile_counters()
+    return {
+        "swept_group_sizes": sizes,
+        "programs_built_delta": after["programs_built"]
+        - before["programs_built"],
+        "jit_cache_entries_delta": after["jit_cache_entries"]
+        - before["jit_cache_entries"],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", default="tiny64")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--baseline-requests", type=int, default=6)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--sidelength", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--flush-timeout-ms", type=float, default=25.0)
+    args = ap.parse_args()
+
+    from novel_view_synthesis_3d_tpu.config import ServeConfig
+    from novel_view_synthesis_3d_tpu.sample.service import SamplingService
+
+    cfg, model, params, conds = build(args.preset, args.sidelength,
+                                      args.steps)
+    scfg = ServeConfig(max_batch=args.max_batch,
+                       flush_timeout_ms=args.flush_timeout_ms,
+                       queue_depth=max(64, 2 * args.requests),
+                       results_folder="/tmp/nvs3d_serve_bench")
+    buckets = []
+    b = 1
+    while b <= args.max_batch:
+        buckets.append(b)
+        b *= 2
+    if len(buckets) < 3:
+        raise SystemExit("--max-batch must be >= 4 so the warm sweep "
+                         "covers >= 3 bucket sizes")
+
+    service = SamplingService(model, params, cfg.diffusion, scfg)
+    try:
+        warm_service(service, conds, buckets)
+
+        # Warm sequential floor (batch-1 program, no coalescing): the
+        # transparency number that isolates program-reuse from batching.
+        t0 = time.perf_counter()
+        for i in range(4):
+            service.submit(conds[i % len(conds)], seed=200 + i
+                           ).result(timeout=600)
+        warm_seq = (time.perf_counter() - t0) / 4
+
+        rps = bench_service(service, conds, args.requests, args.concurrency)
+        sweep = mixed_size_sweep(service, conds, buckets)
+        base_rps = bench_baseline(cfg, model, params, conds,
+                                  args.baseline_requests)
+        stats = service.stats
+        result = {
+            "metric": f"serve_rps_{args.preset}",
+            "value": round(rps, 3),
+            "unit": "req/s",
+            "vs_baseline": round(rps / base_rps, 3),
+            "baseline_value": round(base_rps, 3),
+            "baseline": "one-shot sequential path: fresh make_sampler jit "
+                        "closure per request, batch 1, persistent compile "
+                        "cache warm",
+            "warm_sequential_sec_per_req": round(warm_seq, 4),
+            "concurrency": args.concurrency,
+            "requests": args.requests,
+            "sample_steps": args.steps,
+            "sidelength": args.sidelength,
+            "buckets": buckets,
+            "queue_wait": stats.span_summary("queue_wait"),
+            "device": stats.span_summary("device"),
+            "compile": stats.span_summary("compile"),
+            "mixed_size_sweep": sweep,
+            "compile_counters": service.compile_counters(),
+            "platform": jax.default_backend(),
+        }
+        print(json.dumps(result))
+        if (sweep["programs_built_delta"] != 0
+                or sweep["jit_cache_entries_delta"] != 0):
+            print("error: warm mixed-size sweep triggered new sampler "
+                  f"compilations ({sweep}) — the program cache is not "
+                  "holding its zero-recompile contract", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        service.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
