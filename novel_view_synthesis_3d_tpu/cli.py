@@ -389,7 +389,10 @@ def cmd_serve(args, overrides: List[str]) -> int:
         served = 0
         for i, ticket in tickets:
             try:
-                img = ticket.result()
+                # Bounded wait: a dispatch wedged on the device must
+                # surface as a per-request TimeoutError, not an eternal
+                # hang (the serving-side analog of the run watchdog).
+                img = ticket.result(timeout=args.timeout)
             except Exception as e:
                 print(f"request {i}: failed ({e})")
                 continue
@@ -648,6 +651,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="serve a reference-format flax msgpack checkpoint; "
                         "pair with --preset reference")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-request wall-clock budget in seconds "
+                        "(queue wait + compile + device); a wedged "
+                        "dispatch reports TimeoutError per request "
+                        "instead of hanging the CLI forever")
 
     p = sub.add_parser("eval", help="PSNR/SSIM/FID over held-out views")
     _add_common(p)

@@ -300,8 +300,10 @@ def divides_data_axis(mesh: Optional[Mesh], n: int) -> bool:
     The serving micro-batcher (sample/service.py) uses this to pick its
     bucket ladder: buckets that divide the data axis dispatch through
     `shard_batch` (one coalesced batch served data-parallel across the
-    mesh); anything else would leave ragged shards, so those buckets fall
-    back to single-device dispatch rather than crash mid-serve."""
+    mesh); anything else would leave ragged shards, so those buckets
+    dispatch replicated over the mesh instead (params are committed to
+    the mesh's device set, so a single-device fallback would hand jit
+    incompatible placements) rather than crash mid-serve."""
     return mesh is not None and n % num_data_shards(mesh) == 0
 
 

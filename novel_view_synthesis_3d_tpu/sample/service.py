@@ -30,7 +30,9 @@ Architecture (docs/DESIGN.md "Serving"):
   - SHARD-AWARE dispatch: when the service is built over a device mesh,
     buckets that divide the mesh 'data' axis dispatch through
     `parallel/mesh.shard_batch`, so a multi-chip mesh serves one
-    coalesced batch data-parallel;
+    coalesced batch data-parallel; ragged buckets dispatch replicated
+    over the same mesh (params live on the mesh's device set, so this
+    is the placement-compatible fallback — wasteful, never wrong);
   - instrumentation via `utils/profiling.ServiceStats`: per-request
     queue-wait / compile / device spans and a requests-per-second
     counter (tools/serve_bench.py reads these).
@@ -133,9 +135,10 @@ class _Request:
 class SamplerProgramCache:
     """LRU of compiled request-sampler programs.
 
-    Keyed by (bucket, sidelength, num_cond_frames, sampler, steps,
-    guidance, cfg_rescale, ddim_eta, objective): everything that changes
-    the XLA program a served batch runs. `builds` counts cache misses
+    Keyed by (bucket, H, W, steps, guidance, sampler, cfg_rescale,
+    ddim_eta, objective, schedule) — see `SamplingService._cache_key`:
+    everything that changes the XLA program a served batch runs.
+    `builds` counts cache misses
     (each one is a retrace + compile); `jit_entries()` sums the live
     jitted functions' compiled-executable counts — the counter the
     zero-recompile-after-warmup assertion reads (tools/serve_bench.py,
@@ -411,6 +414,18 @@ class SamplingService:
                 live.append(r)
         return live
 
+    def _cache_key(self, bucket: int, H: int, W: int, steps: int,
+                   w: float) -> tuple:
+        """Full program-cache key: the per-request shape/steps/guidance
+        knobs PLUS every DiffusionConfig field the compiled sampler bakes
+        in (sampler, cfg_rescale, ddim_eta, objective, schedule). The
+        config fields are constant for one service instance today, but
+        keying on them keeps the cache correct if per-request overrides
+        are ever extended to cover them."""
+        d = self.diffusion
+        return (bucket, H, W, steps, w, d.sampler, d.cfg_rescale,
+                d.ddim_eta, d.objective, d.schedule)
+
     def _build_program(self, steps: int, w: float):
         import dataclasses
 
@@ -438,11 +453,20 @@ class SamplingService:
         if mesh_lib.divides_data_axis(self.mesh, bucket):
             cond_dev = mesh_lib.shard_batch(self.mesh, cond)
             keys_dev = mesh_lib.shard_batch(self.mesh, keys)
+        elif self.mesh is not None:
+            # Ragged bucket (doesn't divide the 'data' axis): replicate the
+            # batch over the mesh. Params are committed to the mesh's device
+            # set, so a single-device put here would make jit reject the
+            # mixed placement; replicated compute is merely wasteful.
+            rep = mesh_lib.replicated(self.mesh)
+            cond_dev = jax.device_put(cond, rep)
+            keys_dev = jax.device_put(keys, rep)
         else:
             dev = jax.devices()[0]
             cond_dev = jax.device_put(cond, dev)
             keys_dev = jax.device_put(keys, dev)
-        entry = self._programs.get((bucket, H, W, steps, w), steps, w)
+        entry = self._programs.get(
+            self._cache_key(bucket, H, W, steps, w), steps, w)
         cold = not entry["warm"]
         t_disp = time.monotonic()
         t0 = time.perf_counter()

@@ -90,18 +90,21 @@ class _DevicePrefetcher:
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._terminal = None  # sticky ("end"|"error", exc) once popped
+        self._gen = 0  # bumped by flush(); stale-generation batches drop
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="device-prefetch")
         self._thread.start()
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            gen = self._gen  # read BEFORE the fetch: a flush() during
+            # make_batch leaves this item stale, and get() discards it
             try:
-                item = (self._BATCH, self._make_batch())
+                item = (self._BATCH, self._make_batch(), gen)
             except StopIteration:
-                item = (self._END, None)
+                item = (self._END, None, gen)
             except BaseException as exc:  # propagate to the consumer
-                item = (self._ERROR, exc)
+                item = (self._ERROR, exc, gen)
             while not self._stop.is_set():
                 try:
                     self._q.put(item, timeout=0.2)
@@ -118,20 +121,27 @@ class _DevicePrefetcher:
         if self._terminal is not None:
             kind, exc = self._terminal
             raise StopIteration if kind == self._END else exc
-        kind, val = self._q.get()
-        if kind == self._BATCH:
-            return val
-        self._terminal = (kind, val)
-        if kind == self._END:
-            raise StopIteration
-        raise val
+        while True:
+            kind, val, gen = self._q.get()
+            if kind == self._BATCH:
+                if gen != self._gen:
+                    continue  # fetched before a flush(): suspect, drop
+                return val
+            self._terminal = (kind, val)
+            if kind == self._END:
+                raise StopIteration
+            raise val
 
     def flush(self) -> None:
-        """Drop buffered batches (rollback: the staged data is suspect).
-        Terminal items stay sticky; the producer simply refills."""
+        """Drop buffered batches (rollback: the staged data is suspect) —
+        including one currently inside make_batch on the producer thread,
+        which lands in the queue AFTER this returns but carries the old
+        generation and is discarded by get(). Terminal items stay sticky;
+        the producer simply refills."""
+        self._gen += 1  # before the drain: an in-flight fetch stays stale
         while True:
             try:
-                kind, val = self._q.get_nowait()
+                kind, val, _gen = self._q.get_nowait()
             except queue.Empty:
                 return
             if kind != self._BATCH:

@@ -15,12 +15,13 @@ tqdm import). Here:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import sys
 import threading
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, Optional
 
 import jax
 import numpy as np
@@ -93,17 +94,29 @@ class ServiceStats:
     one request's seconds in that span. Thread-safe — the micro-batcher's
     worker thread records while callers read summaries. Percentiles use
     the same p50/p90/p99 ladder as StepTimer so serving and training
-    timing read alike."""
+    timing read alike.
 
-    def __init__(self):
+    Each span keeps only the most recent `window` records (a long-lived
+    service serving millions of requests must not grow host memory per
+    request): percentiles reflect that sliding window, while `count` is
+    the total ever recorded for the span."""
+
+    def __init__(self, window: int = 4096):
         self._lock = threading.Lock()
-        self._spans: Dict[str, List[float]] = {}
+        self._window = max(1, window)
+        self._spans: Dict[str, "collections.deque"] = {}
+        self._span_totals: Dict[str, int] = {}
         self._requests = 0
         self._t0: Optional[float] = None
 
     def record_span(self, name: str, seconds: float) -> None:
         with self._lock:
-            self._spans.setdefault(name, []).append(float(seconds))
+            dq = self._spans.get(name)
+            if dq is None:
+                dq = self._spans[name] = collections.deque(
+                    maxlen=self._window)
+            dq.append(float(seconds))
+            self._span_totals[name] = self._span_totals.get(name, 0) + 1
 
     def count_requests(self, n: int = 1) -> None:
         """Count completed requests; the RPS window opens at the first."""
@@ -115,11 +128,12 @@ class ServiceStats:
     def span_summary(self, name: str) -> dict:
         with self._lock:
             vals = list(self._spans.get(name, ()))
+            total = self._span_totals.get(name, 0)
         if not vals:
             return {}
         arr = np.asarray(vals)
         return {
-            "count": int(arr.size),
+            "count": total,
             "mean_s": float(arr.mean()),
             "p50_s": float(np.percentile(arr, 50)),
             "p90_s": float(np.percentile(arr, 90)),

@@ -230,6 +230,14 @@ def test_mesh_sharded_dispatch_matches_single(setup, ref_sampler, tmp_path):
         for i in (0, 3, 7):  # spot-check across shards
             ref = ref_sampler(conds[i], seeds[i])
             np.testing.assert_allclose(imgs[i], ref, rtol=1e-5, atol=1e-5)
+        # Ragged bucket (1 request on an 8-shard data axis — the common
+        # low-concurrency case): must SERVE via mesh-replicated dispatch,
+        # not crash on params/batch device-set mismatch.
+        lone = svc.submit(conds[2], seed=99)
+        img = lone.result(timeout=600)
+        assert lone.timing["bucket"] == 1
+        np.testing.assert_allclose(img, ref_sampler(conds[2], 99),
+                                   rtol=1e-5, atol=1e-5)
     finally:
         svc.stop()
 
@@ -290,6 +298,32 @@ def test_device_prefetcher_propagates_errors_and_flushes():
     pf.flush()  # rollback path: staged batches dropped, terminal kept
     with pytest.raises(RuntimeError, match="loader died"):
         pf.get()
+    pf.stop()
+
+
+def test_device_prefetcher_flush_discards_in_flight_batch():
+    """A batch INSIDE make_batch when flush() fires is enqueued after
+    flush returns; the generation counter must still discard it — a
+    pre-rollback 'suspect' batch may never reach the consumer."""
+    import threading
+
+    from novel_view_synthesis_3d_tpu.train.trainer import _DevicePrefetcher
+
+    in_fetch_2 = threading.Event()
+    release = threading.Event()
+
+    def make(n=[0]):  # noqa: B006 - deliberate shared counter
+        n[0] += 1
+        if n[0] == 2:
+            in_fetch_2.set()
+            assert release.wait(10)
+        return n[0]
+
+    pf = _DevicePrefetcher(make, depth=4)
+    assert in_fetch_2.wait(10)  # batch 1 queued, batch 2 mid-fetch
+    pf.flush()  # drops batch 1; batch 2 is in-flight and must die too
+    release.set()
+    assert pf.get() == 3  # batch 2 (stale generation) was discarded
     pf.stop()
 
 
@@ -387,3 +421,19 @@ def test_service_stats_summary():
     assert "requests_per_sec" in s
     assert s["queue_wait"]["count"] == 3
     assert abs(s["queue_wait"]["p50_s"] - 0.2) < 1e-9
+
+
+def test_service_stats_window_bounds_memory():
+    """Span storage must not grow with total requests served (long-lived
+    service): only the newest `window` records back the percentiles,
+    while `count` stays the total ever recorded."""
+    from novel_view_synthesis_3d_tpu.utils.profiling import ServiceStats
+
+    st = ServiceStats(window=8)
+    for i in range(100):
+        st.record_span("device", float(i))
+    assert len(st._spans["device"]) == 8  # bounded
+    s = st.span_summary("device")
+    assert s["count"] == 100  # totals survive the window
+    # Percentiles reflect the sliding window (last 8 records: 92..99).
+    assert s["p50_s"] >= 92.0
