@@ -121,22 +121,33 @@ def _median(xs):
 
 
 def bench_framework(state, step, device_batch, steps: int,
-                    steps_per_dispatch: int = 1) -> float:
+                    steps_per_dispatch: int = 1, tracer=None) -> float:
     # Warmup/compile. Sync points use device_get (a real host fetch):
     # block_until_ready has been observed returning early through the
     # remote-accelerator tunnel, producing physically impossible timings.
     # With fused multi-step dispatch each call advances steps_per_dispatch
     # training steps; per-step time still divides by `steps`.
+    # `tracer` (obs.Tracer) records per-dispatch spans for the embedded
+    # telemetry snapshot: 'train_step' is the HOST-side dispatch (async —
+    # device time accumulates into the rep-closing 'd2h' sync), so the
+    # two together split dispatch overhead from device wait.
+    if tracer is None:
+        from novel_view_synthesis_3d_tpu.obs import NullTracer
+
+        tracer = NullTracer()
     dispatches = max(1, steps // max(1, steps_per_dispatch))
     steps = dispatches * max(1, steps_per_dispatch)
-    state, m = step(state, device_batch)
-    float(jax.device_get(m["loss"]))
+    with tracer.span("compile"):
+        state, m = step(state, device_batch)
+        float(jax.device_get(m["loss"]))
     reps = []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
         for _ in range(dispatches):
-            state, m = step(state, device_batch)
-        float(jax.device_get(m["loss"]))
+            with tracer.span("train_step"):
+                state, m = step(state, device_batch)
+        with tracer.span("d2h"):
+            float(jax.device_get(m["loss"]))
         reps.append((time.perf_counter() - t0) / steps)
     return _median(reps)
 
@@ -659,8 +670,18 @@ def main():
     # `state`, so its device buffers are deleted after the first call.
     host_params = jax.device_get(state.params)
 
-    sec_fw = bench_framework(state, step, device_batch, steps, spd)
+    # Telemetry snapshot (obs/): per-phase span percentiles + device
+    # memory ride in the judged JSON so BENCH_*.json trajectories carry
+    # utilization, not just steps/sec.
+    from novel_view_synthesis_3d_tpu import obs
+    from novel_view_synthesis_3d_tpu.obs import devmon as obs_devmon
+
+    tracer = obs.Tracer(registry=obs.get_registry())
+    devmon = obs_devmon.DeviceMonitor(obs.get_registry(), poll_s=0)
+
+    sec_fw = bench_framework(state, step, device_batch, steps, spd, tracer)
     imgs_per_sec_chip = B / sec_fw / n_chips
+    mem_snapshot = devmon.snapshot()  # right after the hot loop: peak HBM
 
     sec_ref = bench_reference_style(cfg, model, schedule, host_params, batch,
                                     steps)
@@ -678,18 +699,13 @@ def main():
     # older spd-implicit JSONs can't be confused with newer defaults.
     result["steps_per_dispatch"] = spd
     if flops:
-        # Space-normalized: v5e reports device_kind "TPU v5 lite". Dense
-        # bf16 peak per chip from public spec sheets: v5e/v5litepod 197 TF
-        # (394 is its int8 TOPS figure, not bf16); v4 275 TF; v6e/trillium
-        # 918 TF. Unknown kinds report raw flops/bytes without a
-        # utilization claim. cost_analysis() on an SPMD executable reports
-        # whole-program flops in the JAX versions pinned here, so MFU
-        # normalizes by peak * n_chips; on one chip the two conventions
-        # coincide.
-        kind = jax.devices()[0].device_kind.lower().replace(" ", "")
-        peak = next((v for k, v in (("v5lite", 197e12), ("v5e", 197e12),
-                                    ("v6", 918e12), ("v4", 275e12))
-                     if k in kind), None)
+        # Peak table lives in obs/devmon.py (one home — the trainer's MFU
+        # gauge reads the same numbers). Unknown kinds report raw
+        # flops/bytes without a utilization claim. cost_analysis() on an
+        # SPMD executable reports whole-program flops in the JAX versions
+        # pinned here, so MFU normalizes by peak * n_chips; on one chip
+        # the two conventions coincide.
+        peak = obs_devmon.device_peak_flops()
         result["flops_per_step"] = flops
         result["achieved_tflops_per_sec"] = round(flops / sec_fw / 1e12, 2)
         if peak:
@@ -702,6 +718,14 @@ def main():
         # results/tpu_r04/tiny64_train.json). Keyed *_est to say so.
         result["hbm_bytes_per_step_est"] = byts
         result["hbm_gbytes_per_sec_est"] = round(byts / sec_fw / 1e9, 1)
+    # Embedded telemetry: per-phase span percentiles (host dispatch vs
+    # sync wait vs the reference loop's phases) and the device-memory
+    # snapshot. Rounded — the judged line stays human-readable.
+    spans = {
+        name: {k: (round(v, 6) if isinstance(v, float) else v)
+               for k, v in s.items()}
+        for name, s in tracer.summary().items()}
+    result["telemetry"] = {"spans": spans, "device_memory": mem_snapshot}
     print(json.dumps(result))
 
 

@@ -371,8 +371,16 @@ def cmd_serve(args, overrides: List[str]) -> int:
         raise SystemExit("no requests (empty --requests file)")
 
     os.makedirs(args.out, exist_ok=True)
+    # Unified telemetry (obs/): the service's pipeline spans (queue_wait →
+    # batch_form → compile/device → respond) land in trace.json next to
+    # the request PNGs, and the /metrics endpoint — when obs.metrics_port
+    # is set — exposes the same registry the spans' histograms feed.
+    from novel_view_synthesis_3d_tpu import obs
+
+    telemetry = obs.RunTelemetry.create(cfg.obs, args.out)
     service = SamplingService(model, params, cfg.diffusion, cfg.serve,
-                              mesh=mesh, results_folder=args.out)
+                              mesh=mesh, results_folder=args.out,
+                              tracer=telemetry.tracer)
     try:
         tickets = []
         for i, spec in enumerate(specs):
@@ -400,6 +408,7 @@ def cmd_serve(args, overrides: List[str]) -> int:
             served += 1
     finally:
         service.stop()
+        telemetry.finalize()  # trace.json + gauges flushed into --out
     print(json.dumps(dict(service.summary(), served=served,
                           submitted=len(specs),
                           checkpoint_step=step)))

@@ -349,6 +349,49 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Unified telemetry layer (novel_view_synthesis_3d_tpu/obs/;
+    docs/DESIGN.md "Observability"): span tracing with Perfetto export,
+    the metrics registry + sinks, and utilization gauges. Everything here
+    is host-side — no jitted code changes, zero new recompiles."""
+
+    # Master switch. False: NullTracer, no JSONL, no device polling, no
+    # endpoint — the legacy metrics.csv/events.csv still write (they are
+    # the run's primary record, not optional telemetry).
+    enabled: bool = True
+    # Span tracing: collect trainer/serving phase spans and export
+    # <results_folder>/trace.json (Chrome-trace JSON, Perfetto-loadable)
+    # at the end of the run.
+    trace: bool = True
+    # Bounded span buffer: a million-step run keeps the most recent spans
+    # and counts the rest as dropped instead of growing host memory.
+    trace_max_events: int = 200_000
+    # Prometheus text-exposition endpoint (/metrics + /healthz, stdlib
+    # http.server). 0 (default) = no socket is ever opened; set a port to
+    # serve from `nvs3d train` and `nvs3d serve`.
+    metrics_port: int = 0
+    # Bind address for the endpoint. 127.0.0.1 by default — an
+    # unauthenticated scrape target must not face the network; scrape
+    # remotely over an SSH tunnel (docs/TPU_VM_SETUP.md).
+    metrics_host: str = "127.0.0.1"
+    # telemetry.jsonl sink: machine-readable span/gauge/event stream in
+    # the results folder (tools/summarize_bench.py reads it).
+    jsonl: bool = True
+    # Device-memory poll period (seconds) for the bytes-in-use/peak/limit
+    # gauges; 0 disables the monitor thread.
+    device_poll_s: float = 10.0
+    # On-demand jax.profiler window over the step range [a, b): XProf
+    # captures line up with span timestamps. (0, 0) = off. Complements
+    # train.profile_from/profile_steps (kept for back-compat).
+    xprof_steps: Tuple[int, int] = (0, 0)
+    # One-time jit(...).lower().cost_analysis() FLOPs estimate of the
+    # train step, feeding the MFU / imgs-per-sec gauges and the mfu
+    # column in metrics.csv. Costs one extra trace (no XLA compile) at
+    # startup.
+    cost_analysis: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device mesh for distributed execution (replaces reference pmap, §2.3).
 
@@ -369,6 +412,7 @@ class Config:
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
 
     # ------------------------------------------------------------------
     # Validation
@@ -583,6 +627,24 @@ class Config:
                 f"serve.sample_steps={sv.sample_steps} must be in "
                 f"[0, diffusion.timesteps={self.diffusion.timesteps}] "
                 "(0 = diffusion.sample_timesteps)")
+        ob = self.obs
+        if not 0 <= ob.metrics_port <= 65535:
+            errors.append(
+                f"obs.metrics_port={ob.metrics_port} must be in [0, 65535] "
+                "(0 = endpoint off)")
+        if ob.trace_max_events < 1:
+            errors.append(
+                f"obs.trace_max_events={ob.trace_max_events} must be >= 1")
+        if ob.device_poll_s < 0:
+            errors.append(
+                f"obs.device_poll_s={ob.device_poll_s} must be >= 0 "
+                "(0 disables the device-memory monitor)")
+        xp = tuple(ob.xprof_steps)
+        if len(xp) != 2 or any(int(v) < 0 for v in xp) or (
+                xp != (0, 0) and xp[1] <= xp[0]):
+            errors.append(
+                f"obs.xprof_steps={ob.xprof_steps} must be (start, end) "
+                "with 0 <= start < end, or (0, 0) for off")
         for axis in ("model", "seq"):
             if getattr(self.mesh, axis) < 1:
                 errors.append(f"mesh.{axis} must be >= 1")
@@ -629,6 +691,7 @@ class Config:
             train=build(TrainConfig, d.get("train", {})),
             mesh=build(MeshConfig, d.get("mesh", {})),
             serve=build(ServeConfig, d.get("serve", {})),
+            obs=build(ObsConfig, d.get("obs", {})),
         )
 
     @classmethod

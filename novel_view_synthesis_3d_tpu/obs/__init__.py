@@ -1,0 +1,138 @@
+"""obs: the unified telemetry layer (docs/DESIGN.md "Observability").
+
+Three pillars, one package:
+
+  - tracing (obs.trace): hierarchical spans with Perfetto/Chrome-trace
+    export and an on-demand jax.profiler window;
+  - metrics (obs.registry + obs.bus + obs.server): a counter/gauge/
+    histogram registry with pluggable sinks — the legacy
+    metrics.csv/events.csv formats (EventBus is the ONLY writer), a
+    JSONL sink, and a Prometheus /metrics endpoint;
+  - utilization (obs.devmon): device-memory polling and MFU gauges.
+
+`RunTelemetry.create(cfg.obs, results_folder)` wires all of it for one
+run; trainer, serving CLI, and bench each hold one. Everything is
+host-side and cheap: no jitted code changes, zero new steady-state
+recompiles, and with `obs.metrics_port` unset no socket is ever opened.
+
+This module imports no jax at load time — the supervisor process uses
+the bus while deliberately holding no JAX state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from novel_view_synthesis_3d_tpu.obs.bus import (  # noqa: F401
+    EVENTS_HEADER,
+    EventBus,
+    append_event,
+)
+from novel_view_synthesis_3d_tpu.obs.registry import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
+from novel_view_synthesis_3d_tpu.obs.server import (  # noqa: F401
+    MetricsServer,
+    start_metrics_server,
+)
+from novel_view_synthesis_3d_tpu.obs.trace import (  # noqa: F401
+    NullTracer,
+    Tracer,
+    XProfWindow,
+)
+
+TRACE_FILE = "trace.json"
+
+
+@dataclasses.dataclass
+class RunTelemetry:
+    """One run's telemetry bundle: tracer + bus + registry (+ device
+    monitor, xprof window, metrics endpoint). Create via `create`;
+    `finalize()` exports trace.json and stops the background pieces —
+    idempotent, safe in finally blocks."""
+
+    tracer: object
+    bus: EventBus
+    registry: MetricsRegistry
+    devmon: Optional[object] = None
+    xprof: Optional[XProfWindow] = None
+    server: Optional[MetricsServer] = None
+    results_folder: str = "."
+    _finalized: bool = False
+
+    @classmethod
+    def create(cls, ocfg, results_folder: str, *,
+               registry: Optional[MetricsRegistry] = None,
+               start_server: bool = True) -> "RunTelemetry":
+        """Wire a run's telemetry from an ObsConfig.
+
+        `start_server=False` suppresses the endpoint even when
+        obs.metrics_port is set (the supervisor child vs parent, tests).
+        With ocfg.enabled False everything degrades to no-ops: a
+        NullTracer, a bus with the JSONL sink off, no monitor/server.
+        """
+        registry = registry if registry is not None else get_registry()
+        bus = EventBus(results_folder,
+                       jsonl=ocfg.enabled and ocfg.jsonl)
+        if ocfg.enabled and ocfg.trace:
+            tracer = Tracer(
+                max_events=ocfg.trace_max_events,
+                registry=registry,
+                on_complete=(bus.span_record if ocfg.jsonl else None))
+        else:
+            tracer = NullTracer()
+        devmon = None
+        if ocfg.enabled and ocfg.device_poll_s > 0:
+            from novel_view_synthesis_3d_tpu.obs.devmon import DeviceMonitor
+
+            devmon = DeviceMonitor(
+                registry, poll_s=ocfg.device_poll_s,
+                jsonl_cb=(bus.gauge_record if ocfg.jsonl else None))
+            devmon.start()
+        xprof = None
+        if ocfg.enabled and tuple(ocfg.xprof_steps) != (0, 0):
+            xprof = XProfWindow(os.path.join(results_folder, "xprof"),
+                                tuple(ocfg.xprof_steps))
+        server = None
+        if start_server and ocfg.enabled and ocfg.metrics_port:
+            server = start_metrics_server(
+                registry, port=ocfg.metrics_port, host=ocfg.metrics_host)
+            print(f"obs: serving /metrics and /healthz on "
+                  f"{server.url('')} (obs.metrics_port)")
+        return cls(tracer=tracer, bus=bus, registry=registry,
+                   devmon=devmon, xprof=xprof, server=server,
+                   results_folder=results_folder)
+
+    def export_trace(self, path: Optional[str] = None) -> Optional[str]:
+        if isinstance(self.tracer, NullTracer):
+            return None
+        return self.tracer.export_chrome_trace(
+            path or os.path.join(self.results_folder, TRACE_FILE))
+
+    def finalize(self, export_trace: bool = True) -> None:
+        if self._finalized:
+            return
+        self._finalized = True
+        if self.xprof is not None:
+            self.xprof.close()
+        if self.devmon is not None:
+            # Final sample first: the run's last allocations (and the
+            # peak) land in the gauges/JSONL even for sub-period runs.
+            try:
+                self.devmon.poll()
+            except Exception:
+                pass
+            self.devmon.stop()
+        if export_trace:
+            try:
+                self.export_trace()
+            except OSError:
+                pass  # telemetry export must never fail the run
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+        self.bus.close()

@@ -1,0 +1,187 @@
+"""EventBus: the single write path for run telemetry files.
+
+Before this module, three independent writers appended to the same CSV
+formats — the trainer's MetricsLogger (metrics.csv + events.csv), the
+supervisor's standalone log_event, and the sampling service's
+_log_event — each with its own open/flush policy. They now all route
+through here: this module is the ONLY place in the package that names
+``events.csv`` / ``metrics.csv`` (a conformance test enforces it), so the
+schema and durability policy cannot fork again.
+
+Sinks:
+
+  - ``metrics.csv``: the training curve table. Header comes from the
+    producer (MetricsLogger.HEADER); a resumed run with a DIFFERENT
+    header rotates the old file aside rather than appending misaligned
+    rows (the pre-existing policy, now in one place).
+  - ``events.csv``: the fault/serve event log, schema fixed at
+    ``step,event,detail`` — byte-compatible with every PR-1/2/3 consumer
+    (tools/summarize_bench.py, the watchdog/fault drills).
+  - ``telemetry.jsonl``: machine-readable mirror for everything the CSVs
+    can't carry — span records, gauge samples, arbitrary rows — one JSON
+    object per line (tools/summarize_bench.py's telemetry section reads
+    this).
+
+Durability policy (ONE place): every row is flushed to the OS on write
+(a crash loses at most the current line); fsync is deliberately not
+issued per row — metrics are telemetry, not state, and per-row fsync on
+network filesystems has been observed costing more than the train step.
+
+No jax imports here: the supervisor process (train/supervisor.py) writes
+events while deliberately holding no JAX state.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import threading
+import time
+from typing import IO, Optional, Sequence
+
+EVENTS_HEADER = ("step", "event", "detail")
+_METRICS_FILE = "metrics.csv"
+_EVENTS_FILE = "events.csv"
+_JSONL_FILE = "telemetry.jsonl"
+
+
+def metrics_csv_path(results_folder: str) -> str:
+    return os.path.join(results_folder, _METRICS_FILE)
+
+
+def events_csv_path(results_folder: str) -> str:
+    return os.path.join(results_folder, _EVENTS_FILE)
+
+
+def jsonl_path(results_folder: str) -> str:
+    return os.path.join(results_folder, _JSONL_FILE)
+
+
+class _CsvTable:
+    """Append-only CSV with header ownership + schema-rotation.
+
+    If the file already exists with a DIFFERENT header (older build), it
+    is rotated to ``<path>.old`` instead of appending misaligned rows
+    under the stale header."""
+
+    def __init__(self, path: str, header: Sequence[str]):
+        self.path = path
+        self.header = list(header)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if os.path.exists(path) and os.path.getsize(path):
+            with open(path) as fh:
+                old_header = fh.readline().strip().split(",")
+            if old_header != self.header:
+                os.replace(path, path + ".old")
+        self._fh: IO = open(path, "a", newline="")
+        self._csv = csv.writer(self._fh)
+        if self._fh.tell() == 0:
+            self._csv.writerow(self.header)
+            self._fh.flush()
+
+    def append(self, row: Sequence) -> None:
+        self._csv.writerow(row)
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def append_event(results_folder: str, step: int, kind: str,
+                 detail: str = "", *, echo: Optional[str] = None) -> None:
+    """One events.csv row, opened per call (events are rare by
+    construction — no handle to leak across the supervisor's child
+    generations or the service's lifetime). Schema: step,event,detail.
+
+    `echo`: optional prefix for a human-readable stdout line (e.g.
+    "[fault]", "[supervisor]"); None stays silent.
+    """
+    os.makedirs(results_folder, exist_ok=True)
+    path = events_csv_path(results_folder)
+    new = not os.path.exists(path) or os.path.getsize(path) == 0
+    with open(path, "a", newline="") as fh:
+        w = csv.writer(fh)
+        if new:
+            w.writerow(EVENTS_HEADER)
+        w.writerow([step, kind, detail])
+        fh.flush()
+    if echo is not None:
+        print(f"{echo} step {step}: {kind}"
+              + (f" ({detail})" if detail else ""), flush=True)
+
+
+class EventBus:
+    """Per-run telemetry fan-out over one results folder.
+
+    Thread-safe: the trainer's main loop, the device-monitor thread, and
+    the tracer's completion callback all publish concurrently. Sinks are
+    lazy — files appear only once something is written to them, so a
+    bus constructed for a run that never emits JSONL leaves no empty
+    file behind."""
+
+    def __init__(self, results_folder: str, *, jsonl: bool = True):
+        self.results_folder = results_folder
+        self._jsonl_enabled = jsonl
+        self._lock = threading.Lock()
+        self._metrics: Optional[_CsvTable] = None
+        self._jsonl_fh: Optional[IO] = None
+
+    # -- metrics.csv ---------------------------------------------------
+    def metrics_row(self, header: Sequence[str], row: Sequence) -> None:
+        """Append one metrics.csv row; the first call fixes the header
+        (rotating any stale-schema file aside)."""
+        with self._lock:
+            if self._metrics is None:
+                self._metrics = _CsvTable(
+                    metrics_csv_path(self.results_folder), header)
+            self._metrics.append(row)
+
+    # -- events.csv ----------------------------------------------------
+    def event(self, step: int, kind: str, detail: str = "", *,
+              echo: Optional[str] = "[fault]") -> None:
+        """events.csv row + JSONL mirror + optional stdout echo."""
+        append_event(self.results_folder, step, kind, detail, echo=echo)
+        self.jsonl_row({"kind": "event", "step": step, "event": kind,
+                        "detail": detail})
+
+    # -- telemetry.jsonl -----------------------------------------------
+    def jsonl_row(self, obj: dict) -> None:
+        if not self._jsonl_enabled:
+            return
+        try:
+            line = json.dumps(dict(obj, t=round(time.time(), 3)))
+        except (TypeError, ValueError):
+            return  # non-serializable telemetry is dropped, never fatal
+        with self._lock:
+            if self._jsonl_fh is None:
+                os.makedirs(self.results_folder, exist_ok=True)
+                self._jsonl_fh = open(
+                    jsonl_path(self.results_folder), "a")
+            self._jsonl_fh.write(line + "\n")
+            self._jsonl_fh.flush()
+
+    def span_record(self, rec: dict) -> None:
+        """JSONL row for one tracer span record: {"kind":"span", name,
+        dur_s, ...attrs} — what summarize_bench's percentile section
+        reads. Wire as Tracer(on_complete=bus.span_record)."""
+        self.jsonl_row({"kind": "span", "name": rec["name"],
+                        "dur_s": round(rec["dur"], 6),
+                        "thread": rec.get("thread", ""),
+                        **{k: v for k, v in rec.get("attrs", {}).items()
+                           if isinstance(v, (int, float, str, bool))}})
+
+    def gauge_record(self, name: str, value: float, **labels) -> None:
+        self.jsonl_row({"kind": "gauge", "name": name,
+                        "value": value, "labels": labels})
+
+    def close(self) -> None:
+        """Release the open handles. NOT sticky: a later write reopens
+        (append) — a Trainer whose train() ran twice keeps logging."""
+        with self._lock:
+            if self._metrics is not None:
+                self._metrics.close()
+                self._metrics = None
+            if self._jsonl_fh is not None:
+                self._jsonl_fh.close()
+                self._jsonl_fh = None

@@ -1,0 +1,275 @@
+"""Metrics registry: counters / gauges / histograms with Prometheus text
+exposition.
+
+One process-wide registry (``get_registry()``) collects every telemetry
+number the run produces — step counters, throughput and utilization
+gauges (device memory, MFU, imgs/sec), and per-phase span histograms fed
+by ``obs.trace.Tracer``. The registry renders the Prometheus text format
+(version 0.0.4) that ``obs.server`` serves at ``/metrics``; no external
+client library is involved (stdlib only, nothing to install on a TPU VM).
+
+Metric families follow Prometheus conventions: a family has one name,
+help string, and type; children are addressed by label keyword arguments
+at the call site (``gauge.set(v, device="0")``). Histograms keep the
+cumulative bucket/sum/count triple the exposition format requires PLUS a
+bounded sliding window of raw observations so percentile summaries
+(`bench.py` snapshots, `tools/summarize_bench.py`) don't need a second
+collection path.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Default histogram ladder, in seconds: spans range from sub-ms host work
+# to multi-minute compiles; roughly-2.5x spacing keeps the bucket count
+# (18) small enough to scrape cheaply while resolving both ends.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+                   600.0)
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    for k in labels:
+        if not _LABEL_RE.match(k):
+            raise ValueError(f"invalid Prometheus label name {k!r}")
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...],
+                extra: Tuple[Tuple[str, str], ...] = ()) -> str:
+    items = list(key) + list(extra)
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in items) + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class _Family:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid Prometheus metric name {name!r}")
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[Tuple[str, str], ...], object] = {}
+
+    def header_lines(self) -> list:
+        return [f"# HELP {self.name} {_escape(self.help)}",
+                f"# TYPE {self.name} {self.kind}"]
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        key = _label_key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._children.get(_label_key(labels), 0.0))
+
+    def render(self) -> list:
+        with self._lock:
+            children = dict(self._children) or {(): 0.0}
+        return [f"{self.name}{_fmt_labels(k)} {_fmt_value(v)}"
+                for k, v in sorted(children.items())]
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._children[_label_key(labels)] = float(v)
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            got = self._children.get(_label_key(labels))
+        return None if got is None else float(got)
+
+    def max_value(self) -> Optional[float]:
+        """Largest child value (e.g. peak HBM across devices)."""
+        with self._lock:
+            return max(self._children.values(), default=None)
+
+    def render(self) -> list:
+        with self._lock:
+            children = dict(self._children)
+        return [f"{self.name}{_fmt_labels(k)} {_fmt_value(v)}"
+                for k, v in sorted(children.items())]
+
+
+class _HistChild:
+    __slots__ = ("bucket_counts", "total", "count", "window")
+
+    def __init__(self, n_buckets: int, window: int):
+        import collections
+
+        self.bucket_counts = [0] * n_buckets
+        self.total = 0.0
+        self.count = 0
+        self.window = collections.deque(maxlen=window)
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 window: int = 4096):
+        super().__init__(name, help_)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._window = max(1, window)
+
+    def observe(self, v: float, **labels) -> None:
+        v = float(v)
+        key = _label_key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = _HistChild(
+                    len(self.buckets), self._window)
+            for i, bound in enumerate(self.buckets):
+                if v <= bound:
+                    child.bucket_counts[i] += 1
+                    break
+            child.total += v
+            child.count += 1
+            child.window.append(v)
+
+    def percentiles(self, **labels) -> dict:
+        """{count, mean_s, p50_s, p90_s, p99_s} over the sliding window
+        (count is total-ever, matching ServiceStats semantics)."""
+        import numpy as np
+
+        with self._lock:
+            child = self._children.get(_label_key(labels))
+            vals = list(child.window) if child else []
+            count = child.count if child else 0
+        if not vals:
+            return {}
+        arr = np.asarray(vals)
+        return {
+            "count": count,
+            "mean_s": float(arr.mean()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p90_s": float(np.percentile(arr, 90)),
+            "p99_s": float(np.percentile(arr, 99)),
+        }
+
+    def label_sets(self) -> list:
+        with self._lock:
+            return [dict(k) for k in self._children]
+
+    def render(self) -> list:
+        with self._lock:
+            children = {k: (list(c.bucket_counts), c.total, c.count)
+                        for k, c in self._children.items()}
+        lines = []
+        for key, (counts, total, count) in sorted(children.items()):
+            cum = 0
+            for bound, n in zip(self.buckets, counts):
+                cum += n
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(key, (('le', _fmt_value(bound)),))}"
+                    f" {cum}")
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels(key, (('le', '+Inf'),))}"
+                f" {count}")
+            lines.append(f"{self.name}_sum{_fmt_labels(key)} "
+                         f"{_fmt_value(total)}")
+            lines.append(f"{self.name}_count{_fmt_labels(key)} {count}")
+        return lines
+
+
+class MetricsRegistry:
+    """Name-keyed family registry; family constructors are idempotent
+    (same name + same kind returns the existing family, so independent
+    modules can declare the metrics they touch without coordination)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _get(self, cls, name: str, help_: str, **kw):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}, not {cls.kind}")
+                return fam
+            fam = self._families[name] = cls(name, help_, **kw)
+            return fam
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  window: int = 4096) -> Histogram:
+        return self._get(Histogram, name, help_, buckets=buckets,
+                         window=window)
+
+    def families(self) -> Iterable[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    def render_prometheus(self) -> str:
+        lines = []
+        for fam in sorted(self.families(), key=lambda f: f.name):
+            lines.extend(fam.header_lines())
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"
+
+
+# -- process-wide default registry ------------------------------------
+_default_lock = threading.Lock()
+_default_registry: Optional[MetricsRegistry] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process's shared registry: the /metrics endpoint scrapes what
+    every component (trainer, service, device monitor) writes here."""
+    global _default_registry
+    with _default_lock:
+        if _default_registry is None:
+            _default_registry = MetricsRegistry()
+        return _default_registry
+
+
+def reset_registry() -> MetricsRegistry:
+    """Fresh default registry (tests: isolate counter state per case)."""
+    global _default_registry
+    with _default_lock:
+        _default_registry = MetricsRegistry()
+        return _default_registry

@@ -1,0 +1,84 @@
+"""Prometheus text-exposition HTTP endpoint (`/metrics` + `/healthz`).
+
+Stdlib ``http.server`` only — nothing to install on a TPU VM. OFF by
+default: the server starts only when ``obs.metrics_port`` is set, and it
+binds 127.0.0.1 unless ``obs.metrics_host`` says otherwise (a training
+host should not expose an unauthenticated scrape target to the network;
+reach it remotely over an SSH tunnel — docs/TPU_VM_SETUP.md).
+
+``/metrics`` renders the shared registry in Prometheus format 0.0.4;
+``/healthz`` answers ``ok`` (livenesss for the supervisor or an external
+prober: the HTTP thread answering proves the process is not wedged at
+the interpreter level, though a stuck device dispatch needs the run
+watchdog's deeper diagnosis).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from novel_view_synthesis_3d_tpu.obs.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Background /metrics endpoint over one registry; `close()` to stop.
+
+    `port=0` binds an ephemeral port (tests); the actual port is on
+    `.port` either way."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self.registry = registry if registry is not None else get_registry()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] == "/metrics":
+                    body = outer.registry.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.split("?")[0] == "/healthz":
+                    body = b"ok\n"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):
+                pass  # scrapes every few seconds must not spam the run log
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="obs-metrics-http")
+        self._thread.start()
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def start_metrics_server(registry: Optional[MetricsRegistry] = None,
+                         port: int = 0,
+                         host: str = "127.0.0.1") -> MetricsServer:
+    return MetricsServer(registry, port, host)

@@ -1,0 +1,299 @@
+"""Hierarchical span tracing with Chrome-trace/Perfetto export.
+
+One `Tracer` instance records one run's spans: the trainer's loop phases
+(data_fetch → h2d → train_step → d2h → checkpoint_save / eval), the
+serving pipeline (queue_wait → batch_form → compile → device → respond),
+and anything else that wraps itself in `tracer.span(...)`. Spans nest via
+a thread-local stack, are thread-safe across producer threads (the device
+prefetcher, the serving worker), and are BOUNDED — a million-step run
+keeps the most recent `max_events` spans and counts the rest as dropped
+instead of growing host memory.
+
+The export is Chrome trace-event JSON (`trace.json`), loadable directly
+in Perfetto (ui.perfetto.dev) or chrome://tracing: complete events
+(`ph: "X"`) on one timeline row per thread, with run_id / host_id /
+process_index attribution in the file metadata and per-span args. Span
+durations also stream to attached sinks as they complete — the metrics
+registry's per-phase histogram (`nvs3d_span_seconds{phase=...}`) and the
+EventBus JSONL sink — so the /metrics endpoint and telemetry.jsonl see
+exactly the spans the trace file does.
+
+`XProfWindow` arms an on-demand `jax.profiler` trace over a configured
+step range (`obs.xprof_steps`): span timestamps and the XProf capture
+then cover the same steps, so "where did step time go" can be answered
+at both the phase level (this module) and the HLO level (XProf).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import collections
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+
+def _process_index() -> int:
+    """jax.process_index() without importing jax at module load (the
+    supervisor process deliberately holds no JAX state)."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def default_run_id() -> str:
+    """Sortable, collision-resistant id for one run of one process."""
+    return f"{time.strftime('%Y%m%d-%H%M%S')}-p{os.getpid()}"
+
+
+class Span:
+    """Handle yielded by `Tracer.span`; `set(**attrs)` attaches attributes
+    that are only known inside the block (e.g. the step count a dispatch
+    advanced to)."""
+
+    __slots__ = ("name", "attrs", "t0")
+
+    def __init__(self, name: str, attrs: dict, t0: float):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = t0
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+
+class Tracer:
+    """Thread-safe bounded span recorder with Chrome-trace export."""
+
+    def __init__(self, *, enabled: bool = True, max_events: int = 200_000,
+                 run_id: Optional[str] = None,
+                 registry=None, histogram: str = "nvs3d_span_seconds",
+                 on_complete: Optional[Callable[[dict], None]] = None):
+        self.enabled = enabled
+        self.run_id = run_id or default_run_id()
+        self.host_id = socket.gethostname()
+        self.process_index = _process_index()
+        self._lock = threading.Lock()
+        self._events: "collections.deque" = collections.deque(
+            maxlen=max(1, max_events))
+        self.dropped = 0
+        self._local = threading.local()  # per-thread open-span stack
+        # Wall-clock anchor: spans are timed on the monotonic perf counter
+        # (immune to NTP steps); the anchor maps them back to wall time for
+        # cross-host alignment and the JSONL sink.
+        self._mono0 = time.perf_counter()
+        self._wall0 = time.time()
+        self._on_complete = on_complete
+        self._hist = None
+        if registry is not None:
+            self._hist = registry.histogram(
+                histogram, "span duration per phase (seconds)")
+
+    # -- recording -----------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def now(self) -> float:
+        return time.perf_counter()
+
+    def wall(self, mono: float) -> float:
+        return self._wall0 + (mono - self._mono0)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        """Time a block as one span; nests under any enclosing span on the
+        same thread. Cheap enough to leave on in production (one perf
+        counter read + deque append per side)."""
+        if not self.enabled:
+            yield Span(name, attrs, 0.0)
+            return
+        sp = Span(name, attrs, time.perf_counter())
+        stack = self._stack()
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            stack.pop()
+            self._record(sp.name, sp.t0, time.perf_counter() - sp.t0,
+                         sp.attrs, depth=len(stack))
+
+    def add_span(self, name: str, dur_s: float, *,
+                 end: Optional[float] = None, **attrs) -> None:
+        """Record a span retrospectively from a measured duration (e.g.
+        a request's queue wait, known only at dispatch time). `end` is a
+        `tracer.now()` stamp; defaults to the present."""
+        if not self.enabled:
+            return
+        end = self.now() if end is None else end
+        self._record(name, end - dur_s, dur_s, attrs, depth=0)
+
+    def _record(self, name: str, t0: float, dur: float, attrs: dict,
+                depth: int) -> None:
+        rec = {
+            "name": name,
+            "ts": t0,
+            "dur": max(0.0, dur),
+            "tid": threading.get_ident(),
+            "thread": threading.current_thread().name,
+            "depth": depth,
+            "attrs": attrs,
+        }
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(rec)
+        if self._hist is not None:
+            self._hist.observe(rec["dur"], phase=name)
+        if self._on_complete is not None:
+            try:
+                self._on_complete(rec)
+            except Exception:
+                pass  # a sink fault must never become the run's fault
+
+    # -- summaries -----------------------------------------------------
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def summary(self) -> Dict[str, dict]:
+        """Per-phase {count, mean_s, p50_s, p90_s, p99_s} over the
+        retained window — the bench's embedded telemetry snapshot."""
+        import numpy as np
+
+        by_name: Dict[str, list] = {}
+        for rec in self.events():
+            by_name.setdefault(rec["name"], []).append(rec["dur"])
+        out = {}
+        for name, durs in sorted(by_name.items()):
+            arr = np.asarray(durs)
+            out[name] = {
+                "count": int(arr.size),
+                "mean_s": float(arr.mean()),
+                "p50_s": float(np.percentile(arr, 50)),
+                "p90_s": float(np.percentile(arr, 90)),
+                "p99_s": float(np.percentile(arr, 99)),
+            }
+        return out
+
+    # -- export --------------------------------------------------------
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the retained spans as Chrome trace-event JSON (Perfetto/
+        chrome://tracing loadable). Timestamps are microseconds from the
+        tracer's start; `otherData` carries the run/host attribution and
+        the wall-clock anchor for cross-run alignment."""
+        events = self.events()
+        pid = self.process_index
+        trace_events: List[dict] = [{
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": f"nvs3d[{self.run_id}]"},
+        }]
+        named_threads = set()
+        for rec in events:
+            if rec["tid"] not in named_threads:
+                named_threads.add(rec["tid"])
+                trace_events.append({
+                    "ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": rec["tid"], "args": {"name": rec["thread"]}})
+            args = {k: v for k, v in rec["attrs"].items()}
+            trace_events.append({
+                "ph": "X", "name": rec["name"], "pid": pid,
+                "tid": rec["tid"],
+                "ts": (rec["ts"] - self._mono0) * 1e6,
+                "dur": rec["dur"] * 1e6,
+                "args": args,
+            })
+        doc = {
+            "traceEvents": trace_events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "run_id": self.run_id,
+                "host_id": self.host_id,
+                "process_index": pid,
+                "wall_time_origin_unix_s": self.wall(self._mono0),
+                "dropped_spans": self.dropped,
+            },
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        return path
+
+
+class NullTracer:
+    """Disabled tracer with the same surface (obs.enabled=False keeps call
+    sites free of None checks)."""
+
+    enabled = False
+    dropped = 0
+    run_id = ""
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        yield Span(name, attrs, 0.0)
+
+    def add_span(self, name: str, dur_s: float, **kw) -> None:
+        pass
+
+    def now(self) -> float:
+        return time.perf_counter()
+
+    def events(self) -> List[dict]:
+        return []
+
+    def summary(self) -> Dict[str, dict]:
+        return {}
+
+    def export_chrome_trace(self, path: str) -> str:
+        return path
+
+
+class XProfWindow:
+    """On-demand jax.profiler window over a step range.
+
+    `on_step(step)` is called at each loop iteration with the CURRENT
+    step count; the window opens when the step enters [start, end) and
+    closes at the first step past it — range checks (not equality) so
+    resumed runs that land inside or beyond the window behave sanely.
+    The capture lands in `log_dir` (TensorBoard/XProf readable) and its
+    wall-clock lines up with the tracer's span timestamps.
+    """
+
+    def __init__(self, log_dir: str, steps: Tuple[int, int]):
+        self.log_dir = log_dir
+        self.start, self.end = int(steps[0]), int(steps[1])
+        self.active = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.end > self.start
+
+    def on_step(self, step: int) -> None:
+        if not self.enabled:
+            return
+        import jax
+
+        if self.active and step >= self.end:
+            jax.profiler.stop_trace()
+            self.active = False
+        elif not self.active and self.start <= step < self.end:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self.active = True
+
+    def close(self) -> None:
+        if self.active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self.active = False

@@ -41,8 +41,6 @@ Architecture (docs/DESIGN.md "Serving"):
 from __future__ import annotations
 
 import collections
-import csv
-import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -50,6 +48,7 @@ from typing import Callable, Dict, List, Optional
 import jax
 import numpy as np
 
+from novel_view_synthesis_3d_tpu import obs
 from novel_view_synthesis_3d_tpu.config import DiffusionConfig, ServeConfig
 from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
 from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
@@ -211,12 +210,25 @@ class SamplingService:
     def __init__(self, model, params, diffusion: DiffusionConfig,
                  serve: Optional[ServeConfig] = None, *,
                  mesh=None, results_folder: Optional[str] = None,
-                 start: bool = True):
+                 start: bool = True, tracer=None):
         self.model = model
         self.diffusion = diffusion
         self.serve = serve or ServeConfig()
         self.mesh = mesh
         self.stats = ServiceStats()
+        # Unified telemetry (obs/): the serving pipeline's spans
+        # (queue_wait → batch_form → compile/device → respond) flow into
+        # the shared registry's per-phase histogram — the same
+        # /metrics surface the trainer feeds. `nvs3d serve` passes its
+        # own tracer so trace.json lands next to the request PNGs;
+        # embedded/test use gets a default one.
+        self.tracer = tracer if tracer is not None else obs.Tracer(
+            registry=obs.get_registry())
+        self._requests_total = obs.get_registry().counter(
+            "nvs3d_requests_total", "requests served (resolved tickets)")
+        self._rejects_total = obs.get_registry().counter(
+            "nvs3d_rejects_total",
+            "requests refused (backpressure, deadline)")
         self._results_folder = results_folder or self.serve.results_folder
         self._events_lock = threading.Lock()
         # Params placement: replicated over the mesh when serving
@@ -335,19 +347,15 @@ class SamplingService:
         return dict(self.stats.summary(), **self.compile_counters())
 
     def _log_event(self, request_id: int, kind: str, detail: str) -> None:
-        """events.csv append, schema-compatible with the trainer's
-        MetricsLogger.log_event (step,event,detail — request id in the
-        step column). Rare by construction (rejections and expiries)."""
-        path = os.path.join(self._results_folder, "events.csv")
+        """Event-log append via the obs bus, schema-compatible with the
+        trainer's MetricsLogger.log_event (step,event,detail — request id
+        in the step column). Rare by construction (rejections and
+        expiries)."""
+        self._rejects_total.inc(kind=kind)
         try:
             with self._events_lock:
-                os.makedirs(self._results_folder, exist_ok=True)
-                new = not os.path.exists(path) or os.path.getsize(path) == 0
-                with open(path, "a", newline="") as fh:
-                    w = csv.writer(fh)
-                    if new:
-                        w.writerow(["step", "event", "detail"])
-                    w.writerow([request_id, kind, detail])
+                obs.append_event(self._results_folder, request_id, kind,
+                                 detail)
         except OSError:
             pass  # the event log must never be the serving fault
 
@@ -443,30 +451,32 @@ class SamplingService:
         # sample RNG streams make rows independent); their outputs are
         # dropped below. Pad keys are zeros: never read by real rows.
         pad = bucket - n
-        cond = {
-            k: np.stack([r.cond[k] for r in group]
-                        + [group[-1].cond[k]] * pad)
-            for k in COND_KEYS
-        }
-        keys = np.stack([r.key for r in group]
-                        + [np.zeros_like(group[-1].key)] * pad)
-        if mesh_lib.divides_data_axis(self.mesh, bucket):
-            cond_dev = mesh_lib.shard_batch(self.mesh, cond)
-            keys_dev = mesh_lib.shard_batch(self.mesh, keys)
-        elif self.mesh is not None:
-            # Ragged bucket (doesn't divide the 'data' axis): replicate the
-            # batch over the mesh. Params are committed to the mesh's device
-            # set, so a single-device put here would make jit reject the
-            # mixed placement; replicated compute is merely wasteful.
-            rep = mesh_lib.replicated(self.mesh)
-            cond_dev = jax.device_put(cond, rep)
-            keys_dev = jax.device_put(keys, rep)
-        else:
-            dev = jax.devices()[0]
-            cond_dev = jax.device_put(cond, dev)
-            keys_dev = jax.device_put(keys, dev)
-        entry = self._programs.get(
-            self._cache_key(bucket, H, W, steps, w), steps, w)
+        with self.tracer.span("batch_form", bucket=bucket, batch_n=n):
+            cond = {
+                k: np.stack([r.cond[k] for r in group]
+                            + [group[-1].cond[k]] * pad)
+                for k in COND_KEYS
+            }
+            keys = np.stack([r.key for r in group]
+                            + [np.zeros_like(group[-1].key)] * pad)
+            if mesh_lib.divides_data_axis(self.mesh, bucket):
+                cond_dev = mesh_lib.shard_batch(self.mesh, cond)
+                keys_dev = mesh_lib.shard_batch(self.mesh, keys)
+            elif self.mesh is not None:
+                # Ragged bucket (doesn't divide the 'data' axis):
+                # replicate the batch over the mesh. Params are committed
+                # to the mesh's device set, so a single-device put here
+                # would make jit reject the mixed placement; replicated
+                # compute is merely wasteful.
+                rep = mesh_lib.replicated(self.mesh)
+                cond_dev = jax.device_put(cond, rep)
+                keys_dev = jax.device_put(keys, rep)
+            else:
+                dev = jax.devices()[0]
+                cond_dev = jax.device_put(cond, dev)
+                keys_dev = jax.device_put(keys, dev)
+            entry = self._programs.get(
+                self._cache_key(bucket, H, W, steps, w), steps, w)
         cold = not entry["warm"]
         t_disp = time.monotonic()
         t0 = time.perf_counter()
@@ -475,17 +485,24 @@ class SamplingService:
         elapsed = time.perf_counter() - t0
         entry["warm"] = True
         span = "compile" if cold else "device"
-        for i, r in enumerate(group):
-            timing = {
-                "queue_wait_s": max(0.0, t_disp - r.t_submit),
-                f"{span}_s": elapsed,
-                "bucket": bucket,
-                "batch_n": n,
-            }
-            self.stats.record_span("queue_wait", timing["queue_wait_s"])
-            self.stats.record_span(span, elapsed)
-            r.ticket._resolve(imgs[i], timing)
+        self.tracer.add_span(span, elapsed, bucket=bucket, batch_n=n)
+        with self.tracer.span("respond", batch_n=n):
+            for i, r in enumerate(group):
+                timing = {
+                    "queue_wait_s": max(0.0, t_disp - r.t_submit),
+                    f"{span}_s": elapsed,
+                    "bucket": bucket,
+                    "batch_n": n,
+                }
+                self.stats.record_span("queue_wait",
+                                       timing["queue_wait_s"])
+                self.stats.record_span(span, elapsed)
+                self.tracer.add_span(
+                    "queue_wait", timing["queue_wait_s"],
+                    request_id=r.ticket.request_id)
+                r.ticket._resolve(imgs[i], timing)
         self.stats.count_requests(n)
+        self._requests_total.inc(n)
 
 
 def request_cond_from_batch(batch: Dict[str, np.ndarray],

@@ -3,16 +3,23 @@
 The reference's observability is a per-step print (train.py:157) and a dead
 tensorboard pin (SURVEY.md §5.5). These are the BASELINE metrics
 (imgs/sec/chip) so they are first-class here.
+
+All file writes route through the obs.EventBus (obs/bus.py) — the single
+write path for the run's CSV/JSONL telemetry, so this module carries the
+schema and the derived-metric math, not file handling.
 """
 
 from __future__ import annotations
 
 import csv
+import math
 import os
 import time
 from typing import Optional
 
 import jax
+
+from novel_view_synthesis_3d_tpu.obs.bus import EventBus
 
 
 class MetricsLogger:
@@ -21,23 +28,23 @@ class MetricsLogger:
     # train/guard.py + trainer + train/supervisor.py) — in the main CSV,
     # not a side channel, so a recovered-from fault is visible in the same
     # place the loss curve is (no silent recovery).
+    # device_mem_gb/mfu: utilization gauges (obs/devmon.py) — peak device
+    # memory high-water and model-FLOPs-utilization, so "is HBM creeping"
+    # and "how fed is the MXU" sit next to the loss curve too. NaN when
+    # the backend reports no stats / the chip's peak is unknown.
     HEADER = ["step", "loss", "grad_norm", "lr", "steps_per_sec",
-              "imgs_per_sec_per_chip", "anomalies", "rollbacks", "restarts"]
+              "imgs_per_sec_per_chip", "anomalies", "rollbacks", "restarts",
+              "device_mem_gb", "mfu"]
 
-    def __init__(self, results_folder: str, use_tensorboard: bool = False):
+    def __init__(self, results_folder: str, use_tensorboard: bool = False,
+                 bus: Optional[EventBus] = None):
         os.makedirs(results_folder, exist_ok=True)
-        self.csv_path = os.path.join(results_folder, "metrics.csv")
-        # Resumed run with a DIFFERENT schema (older build): rotate the old
-        # file aside rather than appending misaligned rows under its header.
-        if os.path.exists(self.csv_path) and os.path.getsize(self.csv_path):
-            with open(self.csv_path) as fh:
-                old_header = fh.readline().strip().split(",")
-            if old_header != self.HEADER:
-                os.replace(self.csv_path, self.csv_path + ".old")
-        self._csv_file = open(self.csv_path, "a", newline="")
-        self._csv = csv.writer(self._csv_file)
-        if self._csv_file.tell() == 0:
-            self._csv.writerow(self.HEADER)
+        self.results_folder = results_folder
+        # Standalone use (tests, tools) builds its own bus; the Trainer
+        # hands in the run's shared one so every sink has one policy.
+        self.bus = bus if bus is not None else EventBus(results_folder,
+                                                        jsonl=False)
+        self._owns_bus = bus is None
         self._tb = None
         if use_tensorboard:
             try:
@@ -66,11 +73,15 @@ class MetricsLogger:
         anomalies = int(metrics.get("anomalies", 0))
         rollbacks = int(metrics.get("rollbacks", 0))
         restarts = int(metrics.get("restarts", 0))
-        self._csv.writerow([step, loss, gnorm, f"{lr:.3e}",
-                            f"{steps_per_sec:.3f}",
-                            f"{imgs_per_sec_per_chip:.3f}",
-                            anomalies, rollbacks, restarts])
-        self._csv_file.flush()
+        device_mem_gb = float(metrics.get("device_mem_gb", float("nan")))
+        mfu = float(metrics.get("mfu", float("nan")))
+        self.bus.metrics_row(self.HEADER, [
+            step, loss, gnorm, f"{lr:.3e}",
+            f"{steps_per_sec:.3f}",
+            f"{imgs_per_sec_per_chip:.3f}",
+            anomalies, rollbacks, restarts,
+            "" if math.isnan(device_mem_gb) else f"{device_mem_gb:.3f}",
+            "" if math.isnan(mfu) else f"{mfu:.4f}"])
         if self._tb is not None:
             import tensorflow as tf
 
@@ -89,7 +100,7 @@ class MetricsLogger:
 
     def log_eval(self, step: int, metrics: dict) -> None:
         """Append eval-quality metrics (PSNR/SSIM/…) to eval.csv + TB."""
-        path = os.path.join(os.path.dirname(self.csv_path), "eval.csv")
+        path = os.path.join(self.results_folder, "eval.csv")
         header = ["step"] + sorted(metrics)
         new = not os.path.exists(path) or os.path.getsize(path) == 0
         if not new:
@@ -115,17 +126,10 @@ class MetricsLogger:
 
     def log_event(self, step: int, kind: str, detail: str = "") -> None:
         """Append a fault-tolerance event (anomaly, rollback, restore
-        fallback, save failure) to events.csv and echo it to the run log.
-        Rare by construction — opened per call, no handle to leak."""
-        path = os.path.join(os.path.dirname(self.csv_path), "events.csv")
-        new = not os.path.exists(path) or os.path.getsize(path) == 0
-        with open(path, "a", newline="") as fh:
-            w = csv.writer(fh)
-            if new:
-                w.writerow(["step", "event", "detail"])
-            w.writerow([step, kind, detail])
-        print(f"[fault] step {step}: {kind}"
-              + (f" ({detail})" if detail else ""), flush=True)
+        fallback, save failure) to the events log and echo it to the run
+        log. Rare by construction."""
+        self.bus.event(step, kind, detail, echo="[fault]")
 
     def close(self) -> None:
-        self._csv_file.close()
+        if self._owns_bus:
+            self.bus.close()

@@ -20,7 +20,6 @@ told its restart generation via NVS3D_SUPERVISED_RESTARTS so the
 
 from __future__ import annotations
 
-import csv
 import os
 import signal
 import subprocess
@@ -28,21 +27,17 @@ import sys
 import time
 from typing import List, Optional, Sequence
 
+from novel_view_synthesis_3d_tpu.obs import bus as obs_bus
+
 RESTART_ENV = "NVS3D_SUPERVISED_RESTARTS"
 
 
 def log_event(results_folder: str, kind: str, detail: str = "") -> None:
-    """events.csv append, schema-compatible with MetricsLogger.log_event
-    but standalone — the supervisor must not construct a MetricsLogger
-    (its __init__ opens/rotates metrics.csv, the child's file)."""
-    os.makedirs(results_folder, exist_ok=True)
-    path = os.path.join(results_folder, "events.csv")
-    new = not os.path.exists(path) or os.path.getsize(path) == 0
-    with open(path, "a", newline="") as fh:
-        w = csv.writer(fh)
-        if new:
-            w.writerow(["step", "event", "detail"])
-        w.writerow([-1, kind, detail])
+    """Event-log append via the obs bus (step -1 = "outside the step
+    loop"), standalone — the supervisor must not construct a
+    MetricsLogger (the child owns the metrics table), and obs.bus
+    imports no jax (this process deliberately holds no JAX state)."""
+    obs_bus.append_event(results_folder, -1, kind, detail)
     print(f"[supervisor] {kind}" + (f" ({detail})" if detail else ""),
           flush=True)
 

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from novel_view_synthesis_3d_tpu import obs
 from novel_view_synthesis_3d_tpu.config import Config
 from novel_view_synthesis_3d_tpu.data.pipeline import (
     cycle,
@@ -306,6 +307,28 @@ class Trainer:
                 lambda p: np.zeros(p.shape, np.float32), self.state.params)
             self._host_ema_pending = True
 
+        # --- telemetry (obs/: spans + registry + sinks + gauges) ---
+        # Created BEFORE the MetricsLogger so both share one EventBus —
+        # the single write path for metrics.csv/events.csv/telemetry.jsonl.
+        # The /metrics endpoint starts here iff obs.metrics_port is set.
+        self.telemetry = obs.RunTelemetry.create(
+            config.obs, tcfg.results_folder)
+        self.tracer = self.telemetry.tracer
+        reg = self.telemetry.registry
+        self._steps_total = reg.counter(
+            "nvs3d_steps_total", "optimizer steps completed this process")
+        self._gauge_steps_per_sec = reg.gauge(
+            "nvs3d_steps_per_sec", "training steps per second")
+        self._gauge_imgs_per_sec = reg.gauge(
+            "nvs3d_imgs_per_sec_per_chip",
+            "training images per second per chip")
+        self._gauge_mfu = reg.gauge(
+            "nvs3d_mfu", "model-FLOPs utilization of the train step")
+        self._gauge_loss = reg.gauge("nvs3d_loss", "last logged train loss")
+        # One-time FLOPs estimate for MFU (obs.cost_analysis): filled at
+        # the first dispatch via train_step.lower(...).cost_analysis().
+        self._flops_per_step: Optional[float] = None
+
         # --- checkpointing / metrics ---
         self.ckpt = CheckpointManager(tcfg.checkpoint_dir)
         # Fault-tolerance bookkeeping (docs/DESIGN.md "Fault tolerance"):
@@ -326,7 +349,8 @@ class Trainer:
                             else "")
                 print(f"resumed from checkpoint at step "
                       f"{int(self.state.step)}{fallback}")
-        self.metrics = MetricsLogger(tcfg.results_folder)
+        self.metrics = MetricsLogger(tcfg.results_folder,
+                                     bus=self.telemetry.bus)
         prov = self.ckpt.last_restore or {}
         for bad_step, reason in prov.get("rejected", []):
             self.metrics.log_event(
@@ -522,7 +546,8 @@ class Trainer:
                 "likely a systematic fault (bad data shard, lr blow-up), "
                 "not a transient.")
         self.ckpt.wait()
-        restored = self.ckpt.restore(self._ckpt_state())
+        with self.tracer.span("checkpoint_restore", step=step_now):
+            restored = self.ckpt.restore(self._ckpt_state())
         if restored is None:
             raise RuntimeError(
                 f"anomaly guard: rollback requested at step {step_now} but "
@@ -613,13 +638,20 @@ class Trainer:
             return {k: v for k, v in b.items() if k != "noise"}
 
         faultinject.maybe_stall("data", self._fetches)
+        fetch = self._fetches
         self._fetches += 1
-        if spd <= 1:
-            host = clean(self._next_batch())
-            return mesh_lib.shard_batch(self.mesh, host)
-        host = [clean(self._next_batch()) for _ in range(spd)]
-        stacked = jax.tree.map(lambda *xs: np.stack(xs), *host)
-        return mesh_lib.shard_batch(self.mesh, stacked, stacked=True)
+        # Two spans per staged batch: data_fetch is the HOST half (loader
+        # wait + decode), h2d the device upload — on the trace timeline
+        # these sit on the prefetcher thread's row, overlapping train_step
+        # spans on the main thread when the pipeline is healthy.
+        with self.tracer.span("data_fetch", fetch=fetch):
+            if spd <= 1:
+                host = clean(self._next_batch())
+            else:
+                hosts = [clean(self._next_batch()) for _ in range(spd)]
+                host = jax.tree.map(lambda *xs: np.stack(xs), *hosts)
+        with self.tracer.span("h2d", fetch=fetch):
+            return mesh_lib.shard_batch(self.mesh, host, stacked=spd > 1)
 
     def _staged_batch(self):
         """The next device batch, blocking under the armed data_fetch
@@ -648,6 +680,10 @@ class Trainer:
             self._prefetcher.stop()
             self._prefetcher = None
             self.watchdog.stop()
+            # Export trace.json, stop the device monitor, close the bus
+            # and endpoint. Idempotent; a crashed run still gets its
+            # trace up to the fault.
+            self.telemetry.finalize()
 
     def _train_loop(self, tcfg, last_metrics, profiling) -> None:
         # The first dispatch of the jitted train step runs under the
@@ -670,6 +706,11 @@ class Trainer:
             # Device batches come from the background prefetcher (up to
             # data.prefetch staged uploads in flight); a StopIteration is
             # only fatal when a step actually needs the missing batch.
+            if self.telemetry.xprof is not None:
+                # Sync-free step estimate: the xprof window tolerates a
+                # ±1-dispatch skew; a device_get here would add a sync to
+                # EVERY iteration just to arm a rarely-used capture.
+                self.telemetry.xprof.on_step(self._step_host)
             if self._device_batch is None:
                 try:
                     self._device_batch = self._staged_batch()
@@ -682,8 +723,14 @@ class Trainer:
                         "steps_per_dispatch batches; with "
                         "steps_per_dispatch>1 a partial trailing group "
                         "cannot be dispatched.") from None
-            with self.timer.measure(), self.watchdog.phase(
-                    "compile" if first_dispatch else "train_step"):
+            if first_dispatch:
+                # One-time FLOPs estimate for the MFU gauge, BEFORE the
+                # donating dispatch deletes the state's buffers. lower()
+                # only traces — no XLA compile, no device time.
+                self._maybe_cost_analysis(self._device_batch)
+            phase = "compile" if first_dispatch else "train_step"
+            with self.timer.measure(), self.watchdog.phase(phase), \
+                    self.tracer.span(phase) as sp:
                 first_dispatch = False
                 self.state, step_metrics = self.train_step(
                     self.state, self._device_batch)
@@ -695,10 +742,16 @@ class Trainer:
                 # the prefetcher thread.)
                 step_now = self.step
                 self._step_host = step_now
+                sp.set(step=step_now)
                 # Deterministic hang drill: the injected sleep sits inside
                 # the armed train_step phase, exactly where a wedged
                 # dispatch would stall.
                 faultinject.maybe_stall("step", step_now)
+            # Counter semantics: steps EXECUTED — each dispatch runs
+            # steps_per_dispatch optimizer steps; a rolled-back window
+            # that re-runs counts again (a Prometheus counter is monotone,
+            # the step column in metrics.csv carries the logical step).
+            self._steps_total.inc(self.config.train.steps_per_dispatch)
 
             if self._check_guard(step_now, step_metrics):
                 continue  # rolled back: restart the loop from the restore
@@ -709,12 +762,16 @@ class Trainer:
             # multi-step dispatch (both only at a fresh, non-resumed start).
             if (step_now % tcfg.log_every == 0
                     or step_now == tcfg.steps_per_dispatch):
+                with self.tracer.span("d2h", step=step_now):
+                    host_metrics = jax.device_get(step_metrics)
+                util = self._utilization_metrics()
                 logged = self.metrics.log(
                     step_now,
-                    dict(jax.device_get(step_metrics),
+                    dict(host_metrics,
                          rollbacks=self._rollbacks,
-                         restarts=self._restarts),
+                         restarts=self._restarts, **util),
                     tcfg.batch_size)
+                self._update_gauges(logged, util)
                 print(f"{step_now}: loss={logged['loss']:.5f} "
                       f"imgs/s/chip={logged['imgs_per_sec_per_chip']:.2f}")
                 last_metrics = logged
@@ -724,7 +781,8 @@ class Trainer:
                 # Orbax gathers per-shard across hosts; device_get would
                 # crash on non-fully-addressable arrays in multi-host runs.
                 self._maybe_update_host_ema(step_now, force=True)
-                with self.watchdog.phase("checkpoint_save"):
+                with self.watchdog.phase("checkpoint_save"), \
+                        self.tracer.span("checkpoint_save", step=step_now):
                     faultinject.maybe_stall("save", step_now)
                     self.ckpt.save(step_now, self._ckpt_state())
 
@@ -737,7 +795,8 @@ class Trainer:
                 # replication collective and get None back. Gathered ONCE
                 # even when both probes fire (on a pod each gather is a
                 # full cross-host all-gather of the param tree).
-                with self.watchdog.phase("eval"):
+                with self.watchdog.phase("eval"), \
+                        self.tracer.span("eval", step=step_now):
                     probe_params = self._probe_host_params()
                     try:
                         if sample_due:
@@ -773,7 +832,8 @@ class Trainer:
         # of this Trainer (sampling/eval on large configs wants the room).
         self._device_batch = None
         self._maybe_update_host_ema(self.step, force=True)
-        with self.watchdog.phase("checkpoint_save"):
+        with self.watchdog.phase("checkpoint_save"), \
+                self.tracer.span("checkpoint_save", step=self.step):
             self.ckpt.save(self.step, self._ckpt_state(), force=True)
             self.ckpt.wait()
         print("training completed" if not self._stalled else
@@ -784,6 +844,56 @@ class Trainer:
         timing = self.timer.summary()
         if timing:
             print(f"step timing: {timing}")
+
+    # -- telemetry helpers (obs/) --------------------------------------
+    def _maybe_cost_analysis(self, device_batch) -> None:
+        """One-time FLOPs estimate of the train step for the MFU gauge
+        (obs.cost_analysis): jit(...).lower(...).cost_analysis() on the
+        unoptimized HLO — a trace, not an XLA compile, so it neither
+        touches the jit cache nor adds steady-state dispatches."""
+        if not self.config.obs.cost_analysis \
+                or self._flops_per_step is not None:
+            return
+        try:
+            with self.tracer.span("cost_analysis"):
+                ca = self.train_step.lower(
+                    self.state, device_batch).cost_analysis()
+            flops = (float(ca.get("flops", 0.0))
+                     if isinstance(ca, dict) else 0.0)
+        except Exception as e:  # bonus context, never fatal
+            print(f"note: obs cost analysis unavailable ({e})")
+            flops = 0.0
+        # 0.0 = tried and unavailable (don't retry every dispatch). The
+        # fused multi-step program's FLOPs cover steps_per_dispatch steps.
+        self._flops_per_step = flops / max(
+            1, self.config.train.steps_per_dispatch)
+        if self._flops_per_step:
+            self.telemetry.registry.gauge(
+                "nvs3d_flops_per_step",
+                "XLA cost-model FLOPs per optimizer step").set(
+                    self._flops_per_step)
+
+    def _utilization_metrics(self) -> dict:
+        """device_mem_gb / mfu for the metrics.csv row (NaN = unknown)."""
+        out = {}
+        devmon = self.telemetry.devmon
+        if devmon is not None and devmon.peak_bytes:
+            out["device_mem_gb"] = devmon.peak_bytes / 1e9
+        step_s = self.timer.last_s
+        if self._flops_per_step and step_s:
+            from novel_view_synthesis_3d_tpu.obs import devmon as obs_devmon
+
+            m = obs_devmon.mfu(self._flops_per_step, 1.0 / step_s)
+            if m is not None:
+                out["mfu"] = m
+        return out
+
+    def _update_gauges(self, logged: dict, util: dict) -> None:
+        self._gauge_steps_per_sec.set(logged["steps_per_sec"])
+        self._gauge_imgs_per_sec.set(logged["imgs_per_sec_per_chip"])
+        self._gauge_loss.set(logged["loss"])
+        if "mfu" in util:
+            self._gauge_mfu.set(util["mfu"])
 
     def _probe_host_params(self):
         """Sampling params for the in-loop probes, pod-safe.

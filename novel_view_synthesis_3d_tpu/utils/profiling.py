@@ -48,10 +48,18 @@ class StepTimer:
     many steps (fused multi-step dispatch): recorded times are normalized
     to per-step so summaries stay comparable across dispatch widths
     (within-window per-step variation is unobservable, so each window
-    contributes its mean)."""
+    contributes its mean).
 
-    def __init__(self, units_per_measure: int = 1):
-        self._times: list = []
+    Retains only the most recent `window` measurements (the same
+    deque(maxlen) pattern and count-vs-window semantics as ServiceStats:
+    a million-step run must not grow host memory per step). `summary()`
+    percentiles reflect the sliding window; `steps` is the total ever
+    measured."""
+
+    def __init__(self, units_per_measure: int = 1, window: int = 4096):
+        self._times: "collections.deque" = collections.deque(
+            maxlen=max(1, window))
+        self._count = 0  # measures ever taken (window-independent)
         self._t0: Optional[float] = None
         self._units = max(1, units_per_measure)
 
@@ -62,6 +70,7 @@ class StepTimer:
         assert self._t0 is not None, "start() not called"
         dt = (time.perf_counter() - self._t0) / self._units
         self._times.append(dt)
+        self._count += 1
         self._t0 = None
         return dt
 
@@ -73,12 +82,18 @@ class StepTimer:
         finally:
             self.stop()
 
+    @property
+    def last_s(self) -> Optional[float]:
+        """Most recent per-step seconds (None before the first stop) —
+        the live step-rate estimate the MFU gauge divides by."""
+        return self._times[-1] if self._times else None
+
     def summary(self) -> dict:
         if not self._times:
             return {}
         arr = np.asarray(self._times)
         return {
-            "steps": int(arr.size) * self._units,
+            "steps": self._count * self._units,
             "mean_s": float(arr.mean()),
             "p50_s": float(np.percentile(arr, 50)),
             "p90_s": float(np.percentile(arr, 90)),
@@ -169,6 +184,17 @@ def log_once(key, msg: str) -> bool:
     _logged_once.add(key)
     print(msg, file=sys.stderr, flush=True)
     return True
+
+
+def reset_log_once(key=None) -> None:
+    """Forget `key` (or, with no argument, every key) so the next
+    log_once fires again. For tests: the once-per-process set otherwise
+    leaks one-shot state across cases — an assertion that a message WAS
+    logged passes or fails depending on which test ran first."""
+    if key is None:
+        _logged_once.clear()
+    else:
+        _logged_once.discard(key)
 
 
 def enable_nan_checks(enabled: bool = True) -> None:

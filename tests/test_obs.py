@@ -1,0 +1,425 @@
+"""Unified telemetry layer (novel_view_synthesis_3d_tpu/obs/): Prometheus
+exposition format, Chrome-trace validity + span nesting, EventBus
+byte-compatibility with the pre-existing events.csv schema, the
+endpoint-off-by-default guard, the single-write-path conformance grep,
+and the end-to-end train+serve acceptance run."""
+
+import ast
+import csv
+import json
+import os
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu import obs
+from novel_view_synthesis_3d_tpu.config import ObsConfig
+
+pytestmark = pytest.mark.smoke
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+def _parse_exposition(text):
+    """{name: (type, {sample_line_without_value: float})} + format checks."""
+    types = {}
+    samples = {}
+    current = None
+    for line in text.splitlines():
+        assert line == line.strip(), f"stray whitespace: {line!r}"
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+            current = name
+        elif line.startswith("# HELP "):
+            continue
+        elif line:
+            key, val = line.rsplit(" ", 1)
+            float(val)  # must parse
+            samples[key] = float(val)
+            assert current is not None, f"sample before TYPE: {line!r}"
+    return types, samples
+
+
+def test_prometheus_exposition_golden():
+    reg = obs.MetricsRegistry()
+    reg.counter("nvs3d_steps_total", "steps completed").inc(7)
+    g = reg.gauge("nvs3d_device_bytes_in_use", "per-device bytes")
+    g.set(1024, device="0")
+    g.set(2048, device="1")
+    h = reg.histogram("nvs3d_span_seconds", "span durations",
+                      buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v, phase="train_step")
+    text = reg.render_prometheus()
+    types, samples = _parse_exposition(text)
+
+    assert types["nvs3d_steps_total"] == "counter"
+    assert types["nvs3d_device_bytes_in_use"] == "gauge"
+    assert types["nvs3d_span_seconds"] == "histogram"
+    assert samples["nvs3d_steps_total"] == 7
+    assert samples['nvs3d_device_bytes_in_use{device="0"}'] == 1024
+    assert samples['nvs3d_device_bytes_in_use{device="1"}'] == 2048
+    # Histogram: cumulative buckets, +Inf == count, sum matches.
+    b = 'nvs3d_span_seconds_bucket{phase="train_step",le="%s"}'
+    assert samples[b % "0.01"] == 1
+    assert samples[b % "0.1"] == 3
+    assert samples[b % "1"] == 4
+    assert samples[b % "+Inf"] == 5
+    assert samples['nvs3d_span_seconds_count{phase="train_step"}'] == 5
+    assert samples['nvs3d_span_seconds_sum{phase="train_step"}'] == \
+        pytest.approx(5.605)
+    # Percentile summaries ride the same histogram (window semantics).
+    p = h.percentiles(phase="train_step")
+    assert p["count"] == 5 and p["p50_s"] == pytest.approx(0.05)
+
+
+def test_prometheus_label_escaping_and_bad_names():
+    reg = obs.MetricsRegistry()
+    g = reg.gauge("nvs3d_test_gauge", "x")
+    g.set(1, path='a"b\\c\nd')
+    text = reg.render_prometheus()
+    assert '{path="a\\"b\\\\c\\nd"}' in text
+    with pytest.raises(ValueError):
+        reg.counter("0bad-name", "x")
+    with pytest.raises(ValueError):
+        reg.counter("nvs3d_test_gauge", "x")  # kind mismatch on re-register
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace / Perfetto
+# ---------------------------------------------------------------------------
+def test_chrome_trace_valid_and_nested(tmp_path):
+    tr = obs.Tracer()
+    with tr.span("train_step", step=3):
+        with tr.span("h2d"):
+            pass
+    tr.add_span("queue_wait", 0.125, request_id=9)
+    path = tr.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as fh:
+        doc = json.load(fh)  # valid JSON — Perfetto's first requirement
+    evs = doc["traceEvents"]
+    complete = [e for e in evs if e["ph"] == "X"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert meta, "process/thread metadata events missing"
+    by_name = {e["name"]: e for e in complete}
+    assert set(by_name) == {"train_step", "h2d", "queue_wait"}
+    for e in complete:
+        assert set(e) >= {"ph", "name", "pid", "tid", "ts", "dur", "args"}
+        assert e["dur"] >= 0
+    # Nesting: the inner span lies within the outer on the same thread.
+    outer, inner = by_name["train_step"], by_name["h2d"]
+    assert inner["tid"] == outer["tid"]
+    assert inner["ts"] >= outer["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"]["step"] == 3
+    assert by_name["queue_wait"]["args"]["request_id"] == 9
+    assert by_name["queue_wait"]["dur"] == pytest.approx(0.125e6, rel=1e-3)
+    # Attribution rides in the file metadata.
+    other = doc["otherData"]
+    assert other["run_id"] and other["host_id"]
+    assert "process_index" in other and "dropped_spans" in other
+
+
+def test_tracer_bounded_and_thread_safe():
+    tr = obs.Tracer(max_events=10)
+    import threading
+
+    def worker():
+        for i in range(50):
+            with tr.span("w"):
+                pass
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(tr.events()) == 10
+    assert tr.dropped == 190
+    s = tr.summary()
+    assert s["w"]["count"] == 10 and s["w"]["p99_s"] >= s["w"]["p50_s"]
+
+
+# ---------------------------------------------------------------------------
+# EventBus: byte-compatibility with the PR-1/2/3 events.csv schema
+# ---------------------------------------------------------------------------
+def test_eventbus_events_csv_byte_compatible(tmp_path):
+    folder = str(tmp_path)
+    bus = obs.EventBus(folder, jsonl=False)
+    bus.event(120, "anomaly", "non-finite loss (strikes=1)", echo=None)
+    obs.append_event(folder, -1, "supervised_restart",
+                     "crash rc=1; restart 1/3")
+    bus.close()
+    # Byte-identical to what the three pre-obs writers produced: header
+    # then plain csv rows, no quoting beyond csv defaults.
+    import io
+
+    want = io.StringIO()
+    w = csv.writer(want)
+    w.writerow(["step", "event", "detail"])
+    w.writerow([120, "anomaly", "non-finite loss (strikes=1)"])
+    w.writerow([-1, "supervised_restart", "crash rc=1; restart 1/3"])
+    got = open(os.path.join(folder, "events.csv"), newline="").read()
+    assert got == want.getvalue()
+    # And the schema the consumers parse:
+    rows = list(csv.DictReader(open(os.path.join(folder, "events.csv"))))
+    assert [r["event"] for r in rows] == ["anomaly", "supervised_restart"]
+
+
+def test_metricslogger_routes_through_bus(tmp_path):
+    """MetricsLogger writes via the EventBus; header rotation preserved;
+    the new utilization columns are present (blank when unknown)."""
+    from novel_view_synthesis_3d_tpu.train.metrics import MetricsLogger
+
+    folder = str(tmp_path)
+    logger = MetricsLogger(folder)
+    logger.log(10, {"loss": 0.5, "grad_norm": 1.0, "lr": 1e-4}, 8)
+    logger.log(20, {"loss": 0.4, "grad_norm": 1.0, "lr": 1e-4,
+                    "device_mem_gb": 1.5, "mfu": 0.42}, 8)
+    logger.log_event(10, "anomaly", "drill")
+    logger.close()
+    with open(os.path.join(folder, "metrics.csv")) as fh:
+        rows = list(csv.DictReader(fh))
+    assert rows[0]["device_mem_gb"] == "" and rows[0]["mfu"] == ""
+    assert rows[1]["device_mem_gb"] == "1.500" and rows[1]["mfu"] == "0.4200"
+    ev = list(csv.DictReader(open(os.path.join(folder, "events.csv"))))
+    assert ev[0]["event"] == "anomaly"
+
+
+# ---------------------------------------------------------------------------
+# Endpoint guard: off unless obs.metrics_port is set
+# ---------------------------------------------------------------------------
+def test_endpoint_off_by_default(tmp_path):
+    telem = obs.RunTelemetry.create(ObsConfig(device_poll_s=0),
+                                    str(tmp_path))
+    assert telem.server is None  # metrics_port=0 -> no socket ever opened
+    telem.finalize()
+    telem2 = obs.RunTelemetry.create(
+        ObsConfig(device_poll_s=0, metrics_port=_free_port()),
+        str(tmp_path))
+    try:
+        assert telem2.server is not None
+        url = telem2.server.url("/healthz")
+        body = urllib.request.urlopen(url, timeout=5).read()
+        assert body.strip() == b"ok"
+    finally:
+        telem2.finalize()
+    assert telem2.server is None  # finalize closed + released the socket
+    with pytest.raises(Exception):
+        urllib.request.urlopen(url, timeout=1)
+
+
+def test_disabled_obs_is_inert(tmp_path):
+    telem = obs.RunTelemetry.create(ObsConfig(enabled=False),
+                                    str(tmp_path))
+    assert isinstance(telem.tracer, obs.NullTracer)
+    assert telem.server is None and telem.devmon is None
+    with telem.tracer.span("x") as sp:
+        sp.set(step=1)
+    telem.bus.jsonl_row({"kind": "span"})  # jsonl off -> no file
+    telem.finalize()
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "telemetry.jsonl"))
+    assert not os.path.exists(os.path.join(str(tmp_path), "trace.json"))
+
+
+# ---------------------------------------------------------------------------
+# Conformance: the bus is the ONLY writer of events.csv / metrics.csv
+# ---------------------------------------------------------------------------
+def test_no_direct_csv_writers_outside_obs():
+    """Grep (well: ast-walk) the package: the literal file names
+    'events.csv'/'metrics.csv' may appear as code string constants only
+    inside obs/ — any other module naming them is building its own path
+    around the bus, the exact fragmentation this layer removed."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        obs.__file__)))  # .../novel_view_synthesis_3d_tpu
+    offenders = []
+    for root, _, files in os.walk(pkg_root):
+        if os.path.basename(root) == "obs":
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as fh:
+                tree = ast.parse(fh.read(), filename=path)
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in ("events.csv", "metrics.csv")):
+                    offenders.append(
+                        f"{os.path.relpath(path, pkg_root)}:{node.lineno}"
+                        f" -> {node.value!r}")
+    assert not offenders, (
+        "modules outside obs/ name the telemetry CSVs directly (route "
+        "writes through obs.bus):\n  " + "\n  ".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# Device monitor / MFU
+# ---------------------------------------------------------------------------
+def test_device_monitor_gauges_and_snapshot():
+    from novel_view_synthesis_3d_tpu.obs.devmon import (
+        DeviceMonitor, device_peak_flops, mfu)
+
+    reg = obs.MetricsRegistry()
+    rows = []
+    mon = DeviceMonitor(reg, poll_s=0,
+                        jsonl_cb=lambda name, value, **lb: rows.append(
+                            (name, value, lb)))
+    snap = mon.snapshot()
+    # CPU backend reports no device stats -> host-RSS fallback keeps the
+    # gauge family (and the run-peak) alive, loudly labeled.
+    assert snap["peak_bytes"] > 0
+    assert snap["host_rss_bytes"] > 0
+    text = reg.render_prometheus()
+    assert "nvs3d_device_bytes_in_use" in text
+    assert 'source="host_rss"' in text
+    assert "nvs3d_host_rss_bytes" in text
+    assert rows and rows[-1][0] == "nvs3d_device_peak_bytes"
+    # MFU: unknown chip (CPU) -> None, never a silently wrong number.
+    assert device_peak_flops() is None
+    assert mfu(1e12, 10.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: one train+serve CPU smoke run, all three pillars live
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def tiny_trainer(tmp_path):
+    from novel_view_synthesis_3d_tpu.config import (
+        Config, DiffusionConfig, ModelConfig, TrainConfig)
+    from novel_view_synthesis_3d_tpu.data.pipeline import iter_batches
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+    from novel_view_synthesis_3d_tpu.data.synthetic import write_synthetic_srn
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+    port = _free_port()
+    cfg = Config(
+        model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
+                          attn_resolutions=()),
+        diffusion=DiffusionConfig(timesteps=10, sample_timesteps=10),
+        train=TrainConfig(batch_size=8, num_steps=4, save_every=2,
+                          log_every=2,
+                          checkpoint_dir=str(tmp_path / "ckpt"),
+                          results_folder=str(tmp_path / "results")),
+        obs=ObsConfig(metrics_port=port, device_poll_s=1.0))
+    root = str(tmp_path / "srn")
+    write_synthetic_srn(root, num_instances=2, views_per_instance=4,
+                        image_size=16)
+    ds = SRNDataset(root, img_sidelength=16)
+    return Trainer(config=cfg, data_iter=iter_batches(ds, 8, seed=0)), port
+
+
+def test_train_telemetry_acceptance(tiny_trainer, tmp_path):
+    trainer, port = tiny_trainer
+    trainer.metrics.log_event(0, "drill", "acceptance event")  # events.csv
+    # Scrape DURING the run (the endpoint serves live training gauges).
+    import threading
+
+    scrapes = {}
+
+    def scrape_late():
+        try:
+            scrapes["body"] = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+        except Exception as e:  # pragma: no cover - diagnostic
+            scrapes["err"] = repr(e)
+
+    t = threading.Timer(0.5, scrape_late)
+    t.start()
+    trainer.train()
+    t.join()
+    res = tmp_path / "results"
+
+    # Pillar 1: Perfetto-loadable trace.json with the trainer phase spans.
+    doc = json.load(open(res / "trace.json"))
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"data_fetch", "h2d", "train_step", "d2h",
+            "checkpoint_save", "compile"} <= names
+    assert doc["otherData"]["run_id"]
+
+    # Pillar 2: events.csv schema identical to the PR-1/2/3 writers'.
+    with open(res / "events.csv") as fh:
+        assert fh.readline().strip() == "step,event,detail"
+    # metrics.csv carries the utilization columns.
+    with open(res / "metrics.csv") as fh:
+        header = fh.readline().strip().split(",")
+    assert "device_mem_gb" in header and "mfu" in header
+
+    # Pillar 3: the live scrape exposed counter + histograms + gauges.
+    body = scrapes.get("body", "")
+    assert body, f"mid-run scrape failed: {scrapes.get('err')}"
+    assert "nvs3d_steps_total" in body
+    assert "nvs3d_span_seconds_bucket" in body
+    assert "nvs3d_device_bytes_in_use" in body
+
+    # JSONL sink fed from the same bus.
+    kinds = {json.loads(line)["kind"]
+             for line in open(res / "telemetry.jsonl")}
+    assert {"span", "gauge", "event"} <= kinds
+
+    # Endpoint is gone once the run finalizes.
+    with pytest.raises(Exception):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                               timeout=1)
+
+
+def test_serve_telemetry_spans(tmp_path):
+    """Serving pipeline spans (queue_wait → batch_form → compile/device →
+    respond) land in the tracer + the shared histogram."""
+    import jax
+    import jax.numpy as jnp
+
+    from novel_view_synthesis_3d_tpu.config import (
+        DiffusionConfig, ModelConfig, ServeConfig)
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.sample.service import (
+        SamplingService, request_cond_from_batch)
+
+    tiny = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                       attn_resolutions=(8,), dropout=0.0)
+    dcfg = DiffusionConfig(timesteps=2, sample_timesteps=2)
+    model = XUNet(tiny)
+    batch = make_example_batch(batch_size=2, sidelength=16, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((2,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((2,)), train=False)["params"]
+    reg = obs.MetricsRegistry()
+    tracer = obs.Tracer(registry=reg)
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(max_batch=2, flush_timeout_ms=5.0),
+        results_folder=str(tmp_path), tracer=tracer)
+    try:
+        ticket = svc.submit(request_cond_from_batch(mb, 0), seed=1)
+        img = ticket.result(timeout=120.0)
+        assert np.isfinite(img).all()
+    finally:
+        svc.stop()
+    names = {e["name"] for e in tracer.events()}
+    assert {"batch_form", "compile", "respond", "queue_wait"} <= names
+    text = reg.render_prometheus()
+    assert 'nvs3d_span_seconds_count{phase="queue_wait"}' in text
+    # trace.json from the serving run is Perfetto-valid too.
+    path = tracer.export_chrome_trace(str(tmp_path / "trace.json"))
+    json.load(open(path))

@@ -43,6 +43,42 @@ def test_step_timer_summary():
     assert s["mean_s"] >= 0.0 and s["p99_s"] >= s["p50_s"]
 
 
+def test_step_timer_window_bounded():
+    """A million-step run must not grow host memory: only the most recent
+    `window` measurements are retained (ServiceStats semantics — `steps`
+    stays total-ever, percentiles reflect the window)."""
+    t = StepTimer(window=8)
+    for _ in range(100):
+        with t.measure():
+            pass
+    assert len(t._times) == 8
+    s = t.summary()
+    assert s["steps"] == 100
+    assert t.last_s is not None and t.last_s >= 0.0
+
+
+def test_step_timer_window_normalizes_units():
+    t = StepTimer(units_per_measure=4, window=8)
+    for _ in range(3):
+        with t.measure():
+            pass
+    assert t.summary()["steps"] == 12
+
+
+def test_reset_log_once():
+    from novel_view_synthesis_3d_tpu.utils.profiling import (
+        log_once, reset_log_once)
+
+    key = ("test_reset_log_once", id(object()))
+    assert log_once(key, "first") is True
+    assert log_once(key, "again") is False
+    reset_log_once(key)  # targeted reset
+    assert log_once(key, "after reset") is True
+    reset_log_once()  # full reset (test teardown usage)
+    assert log_once(key, "after clear") is True
+    reset_log_once(key)
+
+
 def test_check_finite_raises_with_path():
     good = {"a": jnp.ones((4,)), "b": {"c": jnp.zeros((2, 2))}}
     check_finite(good)  # no raise

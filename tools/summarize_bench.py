@@ -75,6 +75,58 @@ def recovery_rows(search_dirs):
     return rows
 
 
+def _pctl(sorted_vals, q):
+    """Nearest-rank percentile over a pre-sorted list (stdlib-only)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def telemetry_rows(search_dirs):
+    """Per telemetry.jsonl (the obs/ JSONL sink): span p50/p90/p99 per
+    phase plus the peak device-memory gauge — the same numbers the live
+    /metrics endpoint exposes, recovered after the fact from the run's
+    results folder."""
+    import glob
+
+    rows = []
+    seen = set()
+    for d in search_dirs:
+        for path in sorted(glob.glob(
+                os.path.join(d, "**", "telemetry.jsonl"), recursive=True)):
+            if path in seen:
+                continue
+            seen.add(path)
+            spans = {}
+            peak_bytes = 0.0
+            try:
+                with open(path) as fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue  # torn tail line of a crashed run
+                        if rec.get("kind") == "span":
+                            spans.setdefault(rec.get("name", "?"),
+                                             []).append(
+                                float(rec.get("dur_s", 0.0)))
+                        elif (rec.get("kind") == "gauge"
+                              and "bytes" in rec.get("name", "")):
+                            peak_bytes = max(peak_bytes,
+                                             float(rec.get("value", 0.0)))
+            except OSError:
+                continue
+            phases = {}
+            for name, durs in sorted(spans.items()):
+                durs.sort()
+                phases[name] = (len(durs), _pctl(durs, 0.5),
+                                _pctl(durs, 0.9), _pctl(durs, 0.99))
+            if phases or peak_bytes:
+                rows.append((path, phases, peak_bytes))
+    return rows
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     out_dir = args[0] if args else os.path.join("results", "tpu_r04")
@@ -117,6 +169,22 @@ def main() -> int:
         for path, anomalies, rollbacks, restarts in recov:
             lines.append(f"- `{path}`: anomalies={anomalies} "
                          f"rollbacks={rollbacks} restarts={restarts}")
+    else:
+        lines.append("- none recorded")
+    # Telemetry: span percentiles + peak device memory from each run's
+    # JSONL sink (obs/bus.py) — where did step time go, and did HBM creep.
+    telem = telemetry_rows([out_dir] + quality_dirs)
+    lines += ["", "## Telemetry (span percentiles / peak device memory, "
+                  "from telemetry.jsonl)", ""]
+    if telem:
+        for path, phases, peak_bytes in telem:
+            peak = (f" peak_device_bytes={peak_bytes / 1e9:.2f}G"
+                    if peak_bytes else "")
+            lines.append(f"- `{path}`:{peak}")
+            for name, (n, p50, p90, p99) in phases.items():
+                lines.append(
+                    f"  - {name}: n={n} p50={p50 * 1e3:.1f}ms "
+                    f"p90={p90 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms")
     else:
         lines.append("- none recorded")
     text = "\n".join(lines) + "\n"
