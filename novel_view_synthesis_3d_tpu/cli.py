@@ -339,9 +339,31 @@ def cmd_serve(args, overrides: List[str]) -> int:
         "R2": pose0[None, :3, :3], "t2": pose0[None, :3, 3],
         "K": inst0.K[None],
     })
-    params, step = _restore_params(cfg, model, sample_batch, args.step,
-                                   reference_ckpt=args.reference_ckpt)
-    print(f"restored checkpoint at step {step}")
+    # Weights: either a checkpoint (the pre-registry path) or a registry
+    # channel subscription — the service then HOT-RELOADS whenever the
+    # channel pointer moves (registry/watcher.py), with zero downtime.
+    store = watcher = None
+    if args.registry:
+        from novel_view_synthesis_3d_tpu.registry import RegistryStore
+
+        store = RegistryStore(args.registry)
+        channel = args.channel or cfg.registry.channel
+        vid = store.read_channel(channel)
+        if vid is None:
+            raise SystemExit(
+                f"registry {args.registry!r} channel {channel!r} points at "
+                "no version — publish and promote first (nvs3d registry "
+                "publish/promote)")
+        manifest = store.verify(vid)
+        params, step = store.load_params(vid, verify=False), manifest.step
+        model_version = vid
+        print(f"serving registry version {vid} (step {step}, channel "
+              f"{channel})")
+    else:
+        params, step = _restore_params(cfg, model, sample_batch, args.step,
+                                       reference_ckpt=args.reference_ckpt)
+        model_version = f"ckpt:{step}"
+        print(f"restored checkpoint at step {step}")
 
     # Multi-chip: one coalesced batch serves data-parallel through the
     # mesh (buckets that divide the data axis shard via shard_batch).
@@ -380,7 +402,18 @@ def cmd_serve(args, overrides: List[str]) -> int:
     telemetry = obs.RunTelemetry.create(cfg.obs, args.out)
     service = SamplingService(model, params, cfg.diffusion, cfg.serve,
                               mesh=mesh, results_folder=args.out,
-                              tracer=telemetry.tracer)
+                              tracer=telemetry.tracer,
+                              model_version=model_version)
+    if store is not None:
+        from novel_view_synthesis_3d_tpu.registry import RegistryWatcher
+
+        bus = telemetry.bus
+        watcher = RegistryWatcher(
+            service, store, args.channel or cfg.registry.channel,
+            poll_s=cfg.registry.poll_s,
+            event_cb=lambda s, kind, detail, version: bus.event(
+                s, kind, detail, model_version=version,
+                echo="[registry]"))
     try:
         tickets = []
         for i, spec in enumerate(specs):
@@ -407,6 +440,8 @@ def cmd_serve(args, overrides: List[str]) -> int:
             save_image(img, os.path.join(args.out, f"request_{i:04d}.png"))
             served += 1
     finally:
+        if watcher is not None:
+            watcher.stop()
         service.stop()
         telemetry.finalize()  # trace.json + gauges flushed into --out
     print(json.dumps(dict(service.summary(), served=served,
@@ -537,6 +572,13 @@ def cmd_export(args, overrides: List[str]) -> int:
     restore path (sampling.py:104-114) can consume — bare param dict,
     3-D (1,3,3) conv kernels, reference module naming. EMA params are
     exported when present (they are what you sample with).
+
+    Default-step selection rides the checkpoint integrity walk-back
+    (train/checkpoint.restore with step=None): after a torn save the
+    export picks the newest VERIFIED checkpoint, never blindly the
+    latest step. With --registry the converted snapshot is also
+    published as a registry version (fmt='reference' in the manifest —
+    inspectable and gc-able, but never servable by mistake).
     """
     import jax
     import numpy as np
@@ -566,7 +608,213 @@ def cmd_export(args, overrides: List[str]) -> int:
             for leaf in jax.tree.leaves(ref_tree))
     print(f"exported step-{step} params ({n:,} values) to {args.out} "
           "(reference flax msgpack layout)")
+    if args.registry:
+        from novel_view_synthesis_3d_tpu.registry import RegistryStore
+        from novel_view_synthesis_3d_tpu.registry.manifest import (
+            config_digest)
+
+        with open(args.out, "rb") as fh:
+            payload = fh.read()
+        m = RegistryStore(args.registry).publish_bytes(
+            payload, step=step, ema=cfg.train.ema_decay > 0,
+            fmt="reference", config_digest=config_digest(cfg),
+            notes=f"nvs3d export of {args.out}",
+            channel=args.channel)
+        print(f"published as registry version {m.version} "
+              f"(fmt=reference, channel {args.channel})")
     return 0
+
+
+# ---------------------------------------------------------------------------
+# registry (model lifecycle: publish / promote / rollback / gc)
+# ---------------------------------------------------------------------------
+def _registry_event_cb(registry_dir: str):
+    """EventBus-routed audit log in the registry root: every lifecycle
+    decision (publish, gate verdicts, promote, rollback, gc) is a row in
+    <dir>/events.csv + telemetry.jsonl — same single write path as the
+    trainer and the service."""
+    from novel_view_synthesis_3d_tpu import obs
+
+    bus = obs.EventBus(registry_dir, jsonl=True)
+    return lambda step, kind, detail, version="": bus.event(
+        step, kind, detail, model_version=version, echo="[registry]")
+
+
+def _gate_probe_batch(cfg, folder: Optional[str]) -> dict:
+    """Fixed-seed conditioning batch for the promotion gate: real SRN
+    views when a dataset is reachable (the honest probe), else the
+    synthetic harness (still a valid candidate-vs-incumbent comparator —
+    both versions see identical conditioning and noise)."""
+    rcfg = cfg.registry
+    root = folder or cfg.data.root_dir
+    if root and os.path.isdir(root):
+        try:
+            from novel_view_synthesis_3d_tpu.data.pipeline import (
+                iter_batches, make_dataset)
+
+            import dataclasses
+
+            ds = make_dataset(dataclasses.replace(cfg.data, root_dir=root))
+            if len(ds) > 0:
+                bs = min(rcfg.gate_batch, len(ds))
+                return next(iter_batches(ds, bs, seed=rcfg.gate_seed,
+                                         num_cond=cfg.model.num_cond_frames))
+        except Exception as e:
+            print(f"note: gate falling back to synthetic probe data ({e})")
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+
+    return make_example_batch(batch_size=rcfg.gate_batch,
+                              sidelength=cfg.data.img_sidelength,
+                              seed=rcfg.gate_seed)
+
+
+def cmd_registry(args, overrides: List[str]) -> int:
+    """Model lifecycle verbs over a registry directory.
+
+    publish: newest VERIFIED checkpoint (integrity walk-back) → a
+    content-hashed version on the `latest` channel. promote: fixed-seed
+    PSNR gate vs the incumbent, then advance the stable channel —
+    auto-reject (rc=1, pointer untouched) on regression beyond
+    registry.gate_margin_db. rollback: previous stable version (a
+    subscribed service hot-reloads it on the next poll). gc: keep the
+    newest registry.keep versions; channel-pinned versions survive.
+    """
+    from novel_view_synthesis_3d_tpu.registry import (
+        RegistryError, RegistryStore)
+
+    store = RegistryStore(args.dir)
+    sub = args.registry_command
+
+    if sub == "list":
+        versions = store.list_versions()
+        channels = store.channels()
+        if args.json:
+            import dataclasses
+
+            print(json.dumps({
+                "versions": [dataclasses.asdict(m) for m in versions],
+                "channels": channels}))
+            return 0
+        if not versions:
+            print(f"(empty registry at {store.root})")
+        by_version = {}
+        for name, vid in channels.items():
+            by_version.setdefault(vid, []).append(name)
+        for m in versions:
+            tags = ",".join(sorted(by_version.get(m.version, []))) or "-"
+            print(f"{m.version}  step={m.step:<8d} ema={int(m.ema)} "
+                  f"fmt={m.fmt:<9s} channels={tags}")
+        for name, vid in sorted(channels.items()):
+            print(f"channel {name} -> {vid}")
+        return 0
+
+    event_cb = _registry_event_cb(args.dir)
+
+    if sub == "publish":
+        from novel_view_synthesis_3d_tpu.data.synthetic import (
+            make_example_batch)
+        from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+        from novel_view_synthesis_3d_tpu.registry.manifest import (
+            config_digest)
+        from novel_view_synthesis_3d_tpu.train.trainer import (
+            _sample_model_batch)
+
+        cfg = build_config(args, overrides)
+        model = XUNet(cfg.model)
+        sample_batch = _sample_model_batch(make_example_batch(
+            batch_size=1, sidelength=cfg.data.img_sidelength))
+        # step=None rides the checkpoint integrity walk-back: a torn
+        # newest save publishes the newest VERIFIED step instead.
+        params, step = _restore_params(cfg, model, sample_batch, args.step)
+        m = store.publish_params(
+            params, step=step, ema=cfg.train.ema_decay > 0,
+            config_digest=config_digest(cfg), channel=args.channel,
+            notes=args.notes)
+        event_cb(step, "model_publish",
+                 f"channel {args.channel} <- {m.version} (cli)", m.version)
+        print(f"published {m.version} (step {step}, "
+              f"channel {args.channel})")
+        return 0
+
+    if sub == "promote":
+        from novel_view_synthesis_3d_tpu.registry import (
+            promote, run_gate)
+
+        cfg = build_config(args, overrides)
+        channel = args.channel or cfg.registry.channel
+        vid = args.version or store.read_channel(args.from_channel)
+        if vid is None:
+            raise SystemExit(
+                f"nothing to promote: channel {args.from_channel!r} is "
+                "empty and no --version was given")
+        gate_result = None
+        if not args.force:
+            from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+            from novel_view_synthesis_3d_tpu.registry import (
+                make_psnr_probe)
+
+            probe = make_psnr_probe(
+                XUNet(cfg.model), cfg.diffusion,
+                _gate_probe_batch(cfg, args.folder),
+                sample_steps=cfg.registry.gate_sample_steps,
+                seed=cfg.registry.gate_seed)
+            try:
+                gate_result = run_gate(
+                    store, vid, channel=channel, probe_fn=probe,
+                    margin_db=cfg.registry.gate_margin_db,
+                    event_cb=event_cb)
+            except RegistryError as e:
+                raise SystemExit(f"gate error: {e}")
+            print(json.dumps({
+                "candidate": gate_result.candidate,
+                "incumbent": gate_result.incumbent,
+                "candidate_psnr": round(gate_result.candidate_psnr, 3),
+                "incumbent_psnr": (
+                    None if gate_result.incumbent_psnr is None
+                    else round(gate_result.incumbent_psnr, 3)),
+                "margin_db": gate_result.margin_db,
+                "passed": gate_result.passed,
+                "reason": gate_result.reason}))
+            if not gate_result.passed:
+                print(f"promotion REFUSED: {gate_result.reason} "
+                      f"(channel {channel} still -> "
+                      f"{store.read_channel(channel)})")
+                return 1
+        try:
+            promote(store, vid, channel=channel, gate=gate_result,
+                    event_cb=event_cb)
+        except RegistryError as e:
+            raise SystemExit(str(e))
+        print(f"promoted {vid} -> channel {channel}")
+        return 0
+
+    if sub == "rollback":
+        from novel_view_synthesis_3d_tpu.registry import rollback
+
+        try:
+            restored = rollback(store, channel=args.channel,
+                                event_cb=event_cb)
+        except RegistryError as e:
+            raise SystemExit(str(e))
+        print(f"channel {args.channel} rolled back to {restored}")
+        return 0
+
+    if sub == "gc":
+        from novel_view_synthesis_3d_tpu.config import RegistryConfig
+
+        keep = args.keep if args.keep is not None else RegistryConfig().keep
+        try:
+            deleted = store.gc(keep)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        for vid in deleted:
+            event_cb(0, "gc", f"deleted version {vid} (keep={keep})", vid)
+        print(json.dumps({"deleted": deleted, "keep": keep,
+                          "kept": [m.version
+                                   for m in store.list_versions()]}))
+        return 0
+
+    raise SystemExit(f"unknown registry command {sub!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -665,6 +913,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "(queue wait + compile + device); a wedged "
                         "dispatch reports TimeoutError per request "
                         "instead of hanging the CLI forever")
+    p.add_argument("--registry", default=None, metavar="DIR",
+                   help="serve from a model registry instead of a "
+                        "checkpoint: load the subscribed channel's "
+                        "version and HOT-RELOAD (zero downtime) whenever "
+                        "the pointer moves")
+    p.add_argument("--channel", default=None,
+                   help="registry channel to subscribe "
+                        "(default: registry.channel, i.e. 'stable')")
 
     p = sub.add_parser("eval", help="PSNR/SSIM/FID over held-out views")
     _add_common(p)
@@ -729,7 +985,61 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--out", required=True,
                    help="output path (e.g. checkpoints_ref/model50000)")
     p.add_argument("--step", type=int, default=None,
-                   help="checkpoint step (default: latest)")
+                   help="checkpoint step (default: newest VERIFIED step — "
+                        "the checkpoint integrity walk-back skips torn "
+                        "saves)")
+    p.add_argument("--registry", default=None, metavar="DIR",
+                   help="also publish the converted snapshot as a "
+                        "registry version (manifest fmt=reference)")
+    p.add_argument("--channel", default="latest",
+                   help="registry channel for --registry (default latest)")
+
+    p = sub.add_parser(
+        "registry",
+        help="model lifecycle: versioned publish, quality-gated promote, "
+             "rollback, gc over a registry directory")
+    reg_sub = p.add_subparsers(dest="registry_command", required=True)
+    q = reg_sub.add_parser("list", help="versions + channel pointers")
+    q.add_argument("--dir", required=True, help="registry root directory")
+    q.add_argument("--json", action="store_true")
+    q = reg_sub.add_parser(
+        "publish", help="newest verified checkpoint -> a registry version")
+    _add_common(q)
+    q.add_argument("--dir", required=True)
+    q.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: newest VERIFIED step)")
+    q.add_argument("--channel", default="latest")
+    q.add_argument("--notes", default="")
+    q = reg_sub.add_parser(
+        "promote",
+        help="run the PSNR gate vs the incumbent, then advance the "
+             "stable channel (auto-reject on regression)")
+    _add_common(q)
+    q.add_argument("--dir", required=True)
+    q.add_argument("--version", default=None,
+                   help="candidate version id (default: the latest "
+                        "channel's pointer)")
+    q.add_argument("--from-channel", default="latest",
+                   help="channel supplying the candidate when no "
+                        "--version is given")
+    q.add_argument("--channel", default=None,
+                   help="destination channel (default registry.channel)")
+    q.add_argument("--folder", default=None,
+                   help="SRN tree for the gate probe (default "
+                        "data.root_dir, synthetic fallback)")
+    q.add_argument("--force", action="store_true",
+                   help="skip the gate (operator override; the promote "
+                        "event still lands in the audit log)")
+    q = reg_sub.add_parser(
+        "rollback", help="point the channel back at its previous version")
+    q.add_argument("--dir", required=True)
+    q.add_argument("--channel", default="stable")
+    q = reg_sub.add_parser(
+        "gc", help="delete all but the newest K versions "
+                   "(channel-pinned versions always survive)")
+    q.add_argument("--dir", required=True)
+    q.add_argument("--keep", type=int, default=None,
+                   help="versions to keep (default registry.keep)")
 
     return parser
 
@@ -742,6 +1052,7 @@ _COMMANDS = {
     "prep": cmd_prep,
     "config": cmd_config,
     "export": cmd_export,
+    "registry": cmd_registry,
 }
 
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Any, Optional, Sequence, Tuple
 
 
@@ -349,6 +350,48 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RegistryConfig:
+    """Model lifecycle registry (novel_view_synthesis_3d_tpu/registry/;
+    docs/DESIGN.md "Model lifecycle").
+
+    A content-hashed, versioned store of publishable model snapshots with
+    channel pointers (`latest` = newest published, `stable` = quality-
+    gated): the trainer PUBLISHES to `latest` every `publish_every` steps,
+    `nvs3d registry promote` runs the PSNR gate and advances `stable`, and
+    a serving process subscribed to a channel HOT-RELOADS the new params
+    under live traffic (sample/service.py swap path)."""
+
+    # Registry root directory (one dir per version under <dir>/versions).
+    dir: str = "./registry"
+    # Trainer hook cadence: every N steps the EMA snapshot (params when
+    # EMA is off) is published to the `latest` channel without blocking
+    # the step loop. 0 = trainer never publishes.
+    publish_every: int = 0
+    # Publish the EMA tree when the run trains one (it is what you sample
+    # with); False forces raw params.
+    publish_ema: bool = True
+    # Channel a serving process subscribes to (`nvs3d serve --registry`);
+    # production serves `stable`, canaries can ride `latest`.
+    channel: str = "stable"
+    # Reload-watcher poll period (seconds) for the serving subscription.
+    poll_s: float = 2.0
+    # Quality gate: a candidate may regress the fixed-seed PSNR probe vs
+    # the incumbent by at most this many dB before promotion is refused
+    # (gate_fail event + non-zero exit; the stable pointer never moves).
+    gate_margin_db: float = 0.5
+    # Respaced reverse-process steps for the gate's PSNR probe (small on
+    # purpose: the gate is a regression tripwire, not a benchmark).
+    gate_sample_steps: int = 8
+    # Probe batch rows scored by the gate.
+    gate_batch: int = 4
+    # Fixed probe seed: candidate and incumbent see identical noise.
+    gate_seed: int = 0
+    # `registry gc` retention: keep the newest K versions (channel-pinned
+    # versions are always kept).
+    keep: int = 5
+
+
+@dataclasses.dataclass(frozen=True)
 class ObsConfig:
     """Unified telemetry layer (novel_view_synthesis_3d_tpu/obs/;
     docs/DESIGN.md "Observability"): span tracing with Perfetto export,
@@ -413,6 +456,8 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    registry: RegistryConfig = dataclasses.field(
+        default_factory=RegistryConfig)
 
     # ------------------------------------------------------------------
     # Validation
@@ -627,6 +672,48 @@ class Config:
                 f"serve.sample_steps={sv.sample_steps} must be in "
                 f"[0, diffusion.timesteps={self.diffusion.timesteps}] "
                 "(0 = diffusion.sample_timesteps)")
+        rg = self.registry
+        if rg.publish_every < 0:
+            errors.append(
+                f"registry.publish_every={rg.publish_every} must be >= 0 "
+                "(0 = the trainer never publishes)")
+        if rg.publish_every > 0 and not rg.dir:
+            errors.append(
+                "registry.publish_every is set but registry.dir is empty — "
+                "there is nowhere to publish to")
+        if not rg.channel or "/" in rg.channel or os.sep in rg.channel:
+            errors.append(
+                f"registry.channel={rg.channel!r} must be a non-empty name "
+                "with no path separators (it becomes a pointer file under "
+                "<registry.dir>/channels/)")
+        if rg.poll_s <= 0:
+            errors.append(
+                f"registry.poll_s={rg.poll_s} must be > 0 (the serving "
+                "reload watcher polls the subscribed channel)")
+        if rg.gate_margin_db < 0:
+            errors.append(
+                f"registry.gate_margin_db={rg.gate_margin_db} must be >= 0")
+        if rg.gate_sample_steps < 1:
+            errors.append(
+                f"registry.gate_sample_steps={rg.gate_sample_steps} must "
+                "be >= 1")
+        elif (rg.publish_every > 0
+                and rg.gate_sample_steps > self.diffusion.timesteps):
+            # Only enforced when the registry lane is armed: the default
+            # gate ladder must not invalidate tiny-timesteps configs that
+            # never touch the registry (sampling_schedule still errors
+            # clearly if a CLI promote exceeds the ladder).
+            errors.append(
+                f"registry.gate_sample_steps={rg.gate_sample_steps} must "
+                f"be <= diffusion.timesteps={self.diffusion.timesteps} "
+                "when registry.publish_every is set")
+        if rg.gate_batch < 1:
+            errors.append(
+                f"registry.gate_batch={rg.gate_batch} must be >= 1")
+        if rg.keep < 1:
+            errors.append(
+                f"registry.keep={rg.keep} must be >= 1 (gc must retain at "
+                "least the newest version)")
         ob = self.obs
         if not 0 <= ob.metrics_port <= 65535:
             errors.append(
@@ -692,6 +779,7 @@ class Config:
             mesh=build(MeshConfig, d.get("mesh", {})),
             serve=build(ServeConfig, d.get("serve", {})),
             obs=build(ObsConfig, d.get("obs", {})),
+            registry=build(RegistryConfig, d.get("registry", {})),
         )
 
     @classmethod

@@ -40,7 +40,12 @@ import threading
 import time
 from typing import IO, Optional, Sequence
 
-EVENTS_HEADER = ("step", "event", "detail")
+# model_version (PR 5): which registry version was live when the event
+# fired — "" for events outside a versioned-serving context. Consumers
+# parse by column NAME (csv.DictReader), so the added column is
+# backward-compatible; files written under the old 3-column header are
+# rotated aside on first append, same policy as _CsvTable.
+EVENTS_HEADER = ("step", "event", "detail", "model_version")
 _METRICS_FILE = "metrics.csv"
 _EVENTS_FILE = "events.csv"
 _JSONL_FILE = "telemetry.jsonl"
@@ -89,10 +94,12 @@ class _CsvTable:
 
 
 def append_event(results_folder: str, step: int, kind: str,
-                 detail: str = "", *, echo: Optional[str] = None) -> None:
+                 detail: str = "", *, model_version: str = "",
+                 echo: Optional[str] = None) -> None:
     """One events.csv row, opened per call (events are rare by
     construction — no handle to leak across the supervisor's child
-    generations or the service's lifetime). Schema: step,event,detail.
+    generations or the service's lifetime). Schema:
+    step,event,detail,model_version.
 
     `echo`: optional prefix for a human-readable stdout line (e.g.
     "[fault]", "[supervisor]"); None stays silent.
@@ -100,11 +107,19 @@ def append_event(results_folder: str, step: int, kind: str,
     os.makedirs(results_folder, exist_ok=True)
     path = events_csv_path(results_folder)
     new = not os.path.exists(path) or os.path.getsize(path) == 0
+    if not new:
+        # A pre-model_version file (3-column header) rotates aside rather
+        # than taking misaligned 4-column rows under the stale header.
+        with open(path) as fh:
+            old_header = fh.readline().strip().split(",")
+        if old_header != list(EVENTS_HEADER):
+            os.replace(path, path + ".old")
+            new = True
     with open(path, "a", newline="") as fh:
         w = csv.writer(fh)
         if new:
             w.writerow(EVENTS_HEADER)
-        w.writerow([step, kind, detail])
+        w.writerow([step, kind, detail, model_version])
         fh.flush()
     if echo is not None:
         print(f"{echo} step {step}: {kind}"
@@ -139,11 +154,16 @@ class EventBus:
 
     # -- events.csv ----------------------------------------------------
     def event(self, step: int, kind: str, detail: str = "", *,
+              model_version: str = "",
               echo: Optional[str] = "[fault]") -> None:
         """events.csv row + JSONL mirror + optional stdout echo."""
-        append_event(self.results_folder, step, kind, detail, echo=echo)
-        self.jsonl_row({"kind": "event", "step": step, "event": kind,
-                        "detail": detail})
+        append_event(self.results_folder, step, kind, detail,
+                     model_version=model_version, echo=echo)
+        row = {"kind": "event", "step": step, "event": kind,
+               "detail": detail}
+        if model_version:
+            row["model_version"] = model_version
+        self.jsonl_row(row)
 
     # -- telemetry.jsonl -----------------------------------------------
     def jsonl_row(self, obj: dict) -> None:

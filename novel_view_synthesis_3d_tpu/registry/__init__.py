@@ -1,0 +1,40 @@
+"""Model lifecycle registry (docs/DESIGN.md "Model lifecycle").
+
+Closes the trainer → production loop: the trainer PUBLISHES versioned,
+content-hashed EMA snapshots (publisher.py → store.py) to the `latest`
+channel; the quality GATE (gate.py) decides whether a candidate may
+advance to `stable`; a serving process subscribed to a channel
+(watcher.py) HOT-RELOADS the new weights with zero downtime
+(sample/service.py swap path). `nvs3d registry
+{list,publish,promote,rollback,gc}` are the operator verbs.
+
+Event logging routes through novel_view_synthesis_3d_tpu.obs (the
+EventBus is the single events.csv write path); this package never touches
+the telemetry files itself.
+"""
+
+from novel_view_synthesis_3d_tpu.registry.gate import (  # noqa: F401
+    GateResult,
+    decide,
+    make_psnr_probe,
+    promote,
+    rollback,
+    run_gate,
+)
+from novel_view_synthesis_3d_tpu.registry.manifest import (  # noqa: F401
+    PARAMS_FILE,
+    VersionManifest,
+    config_digest,
+    version_id,
+)
+from novel_view_synthesis_3d_tpu.registry.publisher import (  # noqa: F401
+    RegistryPublisher,
+)
+from novel_view_synthesis_3d_tpu.registry.store import (  # noqa: F401
+    IntegrityError,
+    RegistryError,
+    RegistryStore,
+)
+from novel_view_synthesis_3d_tpu.registry.watcher import (  # noqa: F401
+    RegistryWatcher,
+)

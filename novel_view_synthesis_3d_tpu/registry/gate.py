@@ -1,0 +1,155 @@
+"""Quality gate: a version must not regress PSNR to reach `stable`.
+
+The `latest` channel tracks training; `stable` is what production serves.
+Between the two sits this gate: a FIXED-SEED PSNR probe (eval/metrics.py
+math, a small respaced sampler) scored for the candidate AND the
+incumbent stable version on the same conditioning batch and the same
+noise, so the comparison isolates the weights. A candidate that regresses
+beyond `registry.gate_margin_db` is refused — the stable pointer never
+moves, a `gate_fail` row lands in the event log, and the operator's
+rollback path (`nvs3d registry rollback`) stays one command away for
+regressions the probe missed.
+
+The probe is a tripwire, not a benchmark: a handful of rows at a few
+reverse steps, sized to catch "the new checkpoint is broken" (NaN-poisoned
+EMA, truncated payload, wrong lineage), not half-dB quality drift — the
+full `eval` CLI remains the measurement instrument.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from novel_view_synthesis_3d_tpu.registry.store import (
+    RegistryError,
+    RegistryStore,
+)
+
+# event_cb(step, kind, detail, model_version) — the EventBus-routed hook
+# (novel_view_synthesis_3d_tpu.obs) callers wire in; None = silent.
+EventCb = Callable[[int, str, str, str], None]
+
+
+@dataclasses.dataclass(frozen=True)
+class GateResult:
+    passed: bool
+    candidate: str
+    incumbent: Optional[str]
+    candidate_psnr: float
+    incumbent_psnr: Optional[float]
+    margin_db: float
+    reason: str
+
+    @property
+    def delta_db(self) -> Optional[float]:
+        if self.incumbent_psnr is None:
+            return None
+        return self.candidate_psnr - self.incumbent_psnr
+
+
+def decide(candidate_psnr: float, incumbent_psnr: Optional[float],
+           margin_db: float) -> tuple:
+    """(passed, reason) for a candidate-vs-incumbent PSNR pair.
+
+    No incumbent = pass (first promotion bootstraps the channel). A
+    non-finite candidate PSNR always fails — that is the broken-payload
+    signature the gate exists for."""
+    if candidate_psnr != candidate_psnr:  # NaN
+        return False, "candidate probe PSNR is non-finite"
+    if incumbent_psnr is None:
+        return True, "no incumbent: bootstrap promotion"
+    delta = candidate_psnr - incumbent_psnr
+    if delta >= -margin_db:
+        return True, (f"probe delta {delta:+.2f} dB within margin "
+                      f"{margin_db:.2f} dB")
+    return False, (f"probe regression {delta:+.2f} dB exceeds margin "
+                   f"{margin_db:.2f} dB")
+
+
+def make_psnr_probe(model, diffusion, batch: dict, *,
+                    sample_steps: int, seed: int = 0):
+    """probe(params) -> mean PSNR (dB) of sampled vs ground-truth targets.
+
+    One jitted sampler closure serves both the candidate and the
+    incumbent (params are an argument, so scoring two versions costs zero
+    extra compiles — the same property the serving hot-swap leans on),
+    and the fixed key means both see bit-identical noise."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        sampling_schedule)
+    from novel_view_synthesis_3d_tpu.eval.metrics import psnr
+    from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
+
+    sampler = make_sampler(model, sampling_schedule(diffusion, sample_steps),
+                           diffusion)
+    cond = {k: jnp.asarray(batch[k])
+            for k in ("x", "R1", "t1", "R2", "t2", "K")}
+    truth = np.asarray(batch["target"])
+    key = jax.random.PRNGKey(seed)
+
+    def probe(params) -> float:
+        imgs = np.asarray(jax.device_get(sampler(params, key, cond)))
+        return float(np.mean(np.asarray(psnr(imgs, truth))))
+
+    return probe
+
+
+def run_gate(store: RegistryStore, candidate_vid: str, *, channel: str,
+             probe_fn: Callable, margin_db: float,
+             event_cb: Optional[EventCb] = None) -> GateResult:
+    """Score candidate vs the channel's incumbent; never moves pointers.
+
+    The candidate payload is hash-verified on load, so a tampered or torn
+    version fails here (IntegrityError) before any PSNR is computed."""
+    incumbent_vid = store.read_channel(channel)
+    cand_manifest = store.verify(candidate_vid)
+    candidate_params = store.load_params(candidate_vid, verify=False)
+    candidate_psnr = probe_fn(candidate_params)
+    incumbent_psnr = None
+    if incumbent_vid and incumbent_vid != candidate_vid:
+        incumbent_psnr = probe_fn(store.load_params(incumbent_vid))
+    elif incumbent_vid == candidate_vid:
+        incumbent_vid = None  # re-promoting the incumbent: bootstrap rule
+    passed, reason = decide(candidate_psnr, incumbent_psnr, margin_db)
+    result = GateResult(
+        passed=passed, candidate=candidate_vid, incumbent=incumbent_vid,
+        candidate_psnr=candidate_psnr, incumbent_psnr=incumbent_psnr,
+        margin_db=margin_db, reason=reason)
+    if event_cb is not None:
+        inc = (f" vs incumbent {incumbent_vid} "
+               f"{incumbent_psnr:.2f} dB" if incumbent_psnr is not None
+               else "")
+        event_cb(cand_manifest.step,
+                 "gate_pass" if passed else "gate_fail",
+                 f"channel {channel}: candidate {candidate_psnr:.2f} dB"
+                 f"{inc}; {reason}", candidate_vid)
+    return result
+
+
+def promote(store: RegistryStore, vid: str, *, channel: str = "stable",
+            gate: Optional[GateResult] = None,
+            event_cb: Optional[EventCb] = None) -> None:
+    """Advance `channel` to `vid`. With a GateResult attached, a failed
+    gate refuses the move (RegistryError) — auto-reject, pointer intact."""
+    if gate is not None and not gate.passed:
+        raise RegistryError(
+            f"refusing to promote {vid} to {channel!r}: {gate.reason}")
+    step = store.manifest(vid).step
+    store.set_channel(channel, vid)
+    if event_cb is not None:
+        event_cb(step, "promote", f"channel {channel} -> {vid}", vid)
+
+
+def rollback(store: RegistryStore, *, channel: str = "stable",
+             event_cb: Optional[EventCb] = None) -> str:
+    """Move `channel` back to its previous distinct version (the serving
+    watcher picks the old weights up on its next poll)."""
+    restored = store.rollback(channel)
+    if event_cb is not None:
+        event_cb(store.manifest(restored).step, "rollback",
+                 f"channel {channel} rolled back to {restored}", restored)
+    return restored

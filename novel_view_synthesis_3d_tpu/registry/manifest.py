@@ -1,0 +1,96 @@
+"""Version manifest: the integrity contract of one published model.
+
+Every registry version directory holds exactly one `manifest.json`
+describing the artifact next to it: the training step it came from,
+whether it is the EMA tree, a digest of the weight-shaping config
+sections (model + diffusion — the parts that decide whether a serving
+process can load it), and a sha256 per payload file. The version id is
+CONTENT-ADDRESSED — `<step>-<sha256 prefix of the params payload>` — so
+re-publishing identical bytes lands on the same version (idempotent) and
+two different trees can never collide under one id.
+
+Pure stdlib + json: the supervisor-side tooling (`registry list/gc`) must
+be able to inspect a registry without touching jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Dict, Optional
+
+MANIFEST_FILE = "manifest.json"
+PARAMS_FILE = "params.msgpack"
+
+# Payload layouts a manifest can describe: 'native' = this repo's flax
+# param dict (what the service loads), 'reference' = the reference
+# codebase's msgpack layout (`nvs3d export --registry`).
+FORMATS = ("native", "reference")
+
+
+def digest_bytes(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+def file_sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def config_digest(cfg) -> str:
+    """Digest of the weight-shaping config sections (model + diffusion).
+
+    Two checkpoints are registry-compatible iff these sections match —
+    train-loop knobs (lr, batch) and serving knobs deliberately don't
+    participate, so a re-tuned run publishes into the same lineage."""
+    d = cfg.to_dict()
+    payload = json.dumps({"model": d.get("model", {}),
+                          "diffusion": d.get("diffusion", {})},
+                         sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def version_id(step: int, payload_digest: str) -> str:
+    """`<step:08d>-<digest[:12]>`: lexical order == step order (ls-able),
+    content hash makes the id collision-free across trees."""
+    return f"{int(step):08d}-{payload_digest[:12]}"
+
+
+@dataclasses.dataclass(frozen=True)
+class VersionManifest:
+    version: str
+    step: int
+    ema: bool
+    # name -> {"sha256": hex, "bytes": int} for every payload file in the
+    # version directory (manifest.json itself excluded).
+    files: Dict[str, Dict[str, Any]]
+    fmt: str = "native"
+    config_digest: str = ""
+    created: float = 0.0  # unix seconds at publish
+    notes: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2,
+                          sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "VersionManifest":
+        d = json.loads(s)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - fields
+        if unknown:
+            raise ValueError(
+                f"manifest holds unknown fields {sorted(unknown)} — "
+                "written by a newer build? refusing to guess")
+        return cls(**d)
+
+    def payload_digest(self, name: str = PARAMS_FILE) -> Optional[str]:
+        entry = self.files.get(name)
+        return None if entry is None else entry.get("sha256")

@@ -1,0 +1,138 @@
+"""Trainer-side publisher: EMA snapshots → registry, off the step loop.
+
+The trainer calls `publish_async(step, host_tree)` every
+`registry.publish_every` steps with an already-host-resident numpy param
+tree (the host-EMA buffer when the run keeps one — zero extra transfer).
+Everything slow — integrity verification, msgpack serialization, sha256,
+fsync'd write, atomic rename — happens on ONE worker thread; the step
+loop's cost is handing over a reference.
+
+Backpressure is coalescing, not blocking: if a publish is still writing
+when the next cadence fires, the pending snapshot is REPLACED (newest
+wins) and the superseded step is logged as `publish_skip`. A slow or
+wedged filesystem can therefore delay publications but can never stall
+training — the same degrade-don't-block policy the checkpoint save path
+uses.
+
+Integrity reuses the checkpoint layer's verification primitive
+(`train/checkpoint.nonfinite_leaf_count`): a NaN-poisoned snapshot is
+refused at the publisher (`publish_reject` event) instead of reaching the
+`latest` channel where a canary would load it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from novel_view_synthesis_3d_tpu.registry.gate import EventCb
+from novel_view_synthesis_3d_tpu.registry.store import RegistryStore
+
+
+class RegistryPublisher:
+    def __init__(self, store: RegistryStore, *, ema: bool,
+                 config_digest: str = "", channel: str = "latest",
+                 event_cb: Optional[EventCb] = None):
+        self.store = store
+        self.ema = ema
+        self.config_digest = config_digest
+        self.channel = channel
+        self.event_cb = event_cb
+        self.published: List[str] = []  # version ids, publish order
+        self.rejected = 0  # non-finite snapshots refused
+        self.skipped = 0   # snapshots superseded before writing
+        self.failures = 0  # store/filesystem errors (logged, non-fatal)
+        self._pending: Optional[tuple] = None  # (step, tree)
+        self._cv = threading.Condition()
+        self._busy = False
+        self._stop = False
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="registry-publisher")
+        self._worker.start()
+
+    # -- trainer-facing ------------------------------------------------
+    def publish_async(self, step: int, host_tree) -> None:
+        """Hand one snapshot to the worker; returns immediately. A still-
+        pending older snapshot is superseded (newest wins)."""
+        with self._cv:
+            if self._pending is not None:
+                self.skipped += 1
+                self._event(self._pending[0], "publish_skip",
+                            f"superseded by step {step} before writing")
+            self._pending = (int(step), host_tree)
+            self._cv.notify_all()
+
+    def publish(self, step: int, host_tree) -> Optional[str]:
+        """Synchronous publish (CLI/tests); returns the version id or
+        None when the snapshot was rejected."""
+        return self._publish(int(step), host_tree)
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Wait until no snapshot is pending or in flight."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: self._pending is None and not self._busy,
+                timeout=timeout)
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        if drain:
+            self.drain(timeout)
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._worker.join(timeout=10.0)
+
+    # -- worker --------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._stop or self._pending is not None)
+                if self._stop:
+                    return
+                step, tree = self._pending
+                self._pending = None
+                self._busy = True
+            try:
+                self._publish(step, tree)
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _publish(self, step: int, tree) -> Optional[str]:
+        from novel_view_synthesis_3d_tpu.registry.store import RegistryError
+        from novel_view_synthesis_3d_tpu.train.checkpoint import (
+            nonfinite_leaf_count)
+
+        bad = nonfinite_leaf_count(tree)
+        if bad:
+            self.rejected += 1
+            self._event(step, "publish_reject",
+                        f"snapshot holds {bad} non-finite leaves — not "
+                        "published")
+            return None
+        try:
+            m = self.store.publish_params(
+                tree, step=step, ema=self.ema,
+                config_digest=self.config_digest, channel=self.channel)
+        except (RegistryError, OSError) as exc:
+            # Degrade loudly: the registry is a convenience lane next to
+            # the checkpoint (the durable record); a full disk here must
+            # not kill a multi-day run.
+            self.failures += 1
+            self._event(step, "publish_fail", f"{exc!r}")
+            return None
+        self.published.append(m.version)
+        self._event(step, "model_publish",
+                    f"channel {self.channel} <- {m.version} "
+                    f"(ema={m.ema})", m.version)
+        return m.version
+
+    def _event(self, step: int, kind: str, detail: str,
+               version: str = "") -> None:
+        if self.event_cb is not None:
+            try:
+                self.event_cb(step, kind, detail, version)
+            except OSError:
+                pass  # event logging must never be the publishing fault

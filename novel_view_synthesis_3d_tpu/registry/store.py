@@ -1,0 +1,312 @@
+"""On-disk versioned model registry with atomic publish + channel pointers.
+
+Layout (one registry root, any filesystem):
+
+    <root>/versions/<version>/manifest.json   # integrity contract
+    <root>/versions/<version>/params.msgpack  # the weights payload
+    <root>/channels/<name>                    # pointer file: one version id
+    <root>/channels/<name>.history            # append-only promote log
+    <root>/.staging/ , <root>/.trash/         # never read by consumers
+
+Concurrency contract — the part that makes zero-downtime reload safe:
+
+  - PUBLISH is write-to-temp + per-file fsync + one atomic directory
+    rename: a reader either sees no version or a complete one, never a
+    torn one (same discipline as the checkpoint layer's torn-write
+    defense, at the filesystem level instead of Orbax's).
+  - CHANNEL moves are write-temp + `os.replace` of a one-line pointer
+    file: a poller reads the old or the new version id, never a partial
+    write.
+  - GC renames a version into `.trash/` first (atomic disappearance),
+    then deletes at leisure — a concurrent reader that already resolved
+    the id may lose the race and must treat a missing version as "gone",
+    not corrupt.
+
+Verification re-hashes every payload file against the manifest — a
+flipped byte (bad disk, truncated copy, manual tampering) is an
+`IntegrityError`, not garbage weights on the mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from novel_view_synthesis_3d_tpu.registry.manifest import (
+    MANIFEST_FILE,
+    PARAMS_FILE,
+    VersionManifest,
+    digest_bytes,
+    file_sha256,
+    version_id,
+)
+
+
+class RegistryError(RuntimeError):
+    """Base class for registry failures."""
+
+
+class IntegrityError(RegistryError):
+    """A version's payload does not match its manifest hashes."""
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds: rename is still atomic
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_file_synced(path: str, payload: bytes) -> None:
+    with open(path, "wb") as fh:
+        fh.write(payload)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+class RegistryStore:
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.versions_dir = os.path.join(self.root, "versions")
+        self.channels_dir = os.path.join(self.root, "channels")
+        os.makedirs(self.versions_dir, exist_ok=True)
+        os.makedirs(self.channels_dir, exist_ok=True)
+
+    # -- publish -------------------------------------------------------
+    def publish_bytes(self, payload: bytes, *, step: int, ema: bool,
+                      fmt: str = "native", config_digest: str = "",
+                      notes: str = "",
+                      channel: Optional[str] = "latest",
+                      extra_files: Optional[Dict[str, bytes]] = None
+                      ) -> VersionManifest:
+        """Publish one params payload as a new version; returns its
+        manifest. Idempotent: identical (step, bytes) re-publishes resolve
+        to the already-published version. `channel` (default `latest`)
+        is pointed at the new version afterwards; None skips the pointer.
+        """
+        digest = digest_bytes(payload)
+        vid = version_id(step, digest)
+        final = os.path.join(self.versions_dir, vid)
+        if os.path.isdir(final):
+            existing = self.verify(vid)
+            if channel:
+                self.set_channel(channel, vid)
+            return existing
+        files = {PARAMS_FILE: {"sha256": digest, "bytes": len(payload)}}
+        extra_files = extra_files or {}
+        for name, blob in extra_files.items():
+            files[name] = {"sha256": digest_bytes(blob), "bytes": len(blob)}
+        manifest = VersionManifest(
+            version=vid, step=int(step), ema=bool(ema), files=files,
+            fmt=fmt, config_digest=config_digest, created=time.time(),
+            notes=notes)
+        staging_root = os.path.join(self.root, ".staging")
+        os.makedirs(staging_root, exist_ok=True)
+        tmp = os.path.join(staging_root, f"{vid}.{os.getpid()}."
+                                         f"{uuid.uuid4().hex[:8]}")
+        os.makedirs(tmp)
+        try:
+            _write_file_synced(os.path.join(tmp, PARAMS_FILE), payload)
+            for name, blob in extra_files.items():
+                _write_file_synced(os.path.join(tmp, name), blob)
+            _write_file_synced(os.path.join(tmp, MANIFEST_FILE),
+                               manifest.to_json().encode())
+            _fsync_dir(tmp)
+            try:
+                os.rename(tmp, final)  # the atomic appearance
+            except OSError:
+                if os.path.isdir(final):
+                    # Concurrent publisher of the same content won the
+                    # rename; its version is byte-identical by content
+                    # addressing — adopt it.
+                    shutil.rmtree(tmp, ignore_errors=True)
+                else:
+                    raise
+            _fsync_dir(self.versions_dir)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        if channel:
+            self.set_channel(channel, vid)
+        return manifest
+
+    def publish_params(self, params_tree, **kw) -> VersionManifest:
+        """Publish a flax param pytree (device or host leaves)."""
+        import jax
+        import numpy as np
+        from flax import serialization
+
+        payload = serialization.msgpack_serialize(
+            jax.tree.map(np.asarray, params_tree))
+        return self.publish_bytes(payload, **kw)
+
+    # -- read ----------------------------------------------------------
+    def list_versions(self) -> List[VersionManifest]:
+        """Readable manifests, oldest step first. Versions that vanish
+        mid-listing (a concurrent gc) or hold an unreadable manifest are
+        skipped — listing must never crash on someone else's race."""
+        out = []
+        try:
+            entries = sorted(os.listdir(self.versions_dir))
+        except OSError:
+            return []
+        for vid in entries:
+            try:
+                out.append(self.manifest(vid))
+            except (RegistryError, OSError, ValueError):
+                continue
+        out.sort(key=lambda m: (m.step, m.created, m.version))
+        return out
+
+    def manifest(self, vid: str) -> VersionManifest:
+        path = os.path.join(self.versions_dir, vid, MANIFEST_FILE)
+        try:
+            with open(path) as fh:
+                m = VersionManifest.from_json(fh.read())
+        except FileNotFoundError:
+            raise RegistryError(
+                f"version {vid!r} not found under {self.versions_dir}")
+        if m.version != vid:
+            raise IntegrityError(
+                f"manifest under {vid!r} names version {m.version!r} — "
+                "directory was renamed or copied by hand")
+        return m
+
+    def verify(self, vid: str) -> VersionManifest:
+        """Re-hash every payload file against the manifest; raises
+        IntegrityError on any mismatch (tamper/torn-copy detection)."""
+        m = self.manifest(vid)
+        vdir = os.path.join(self.versions_dir, vid)
+        for name, entry in m.files.items():
+            path = os.path.join(vdir, name)
+            if not os.path.exists(path):
+                raise IntegrityError(
+                    f"version {vid}: payload file {name!r} is missing")
+            size = os.path.getsize(path)
+            if size != int(entry.get("bytes", size)):
+                raise IntegrityError(
+                    f"version {vid}: {name} is {size} bytes, manifest "
+                    f"says {entry['bytes']}")
+            got = file_sha256(path)
+            if got != entry["sha256"]:
+                raise IntegrityError(
+                    f"version {vid}: {name} sha256 {got[:12]}… does not "
+                    f"match manifest {entry['sha256'][:12]}… — the "
+                    "payload was modified after publish")
+        return m
+
+    def load_params(self, vid: str, verify: bool = True):
+        """The version's params pytree (numpy leaves). `verify` (default)
+        re-hashes first so tampered bytes never reach the mesh."""
+        from flax import serialization
+
+        m = self.verify(vid) if verify else self.manifest(vid)
+        if m.fmt != "native":
+            raise RegistryError(
+                f"version {vid} holds a {m.fmt!r}-format payload — only "
+                "'native' versions are servable (reference exports are "
+                "for the reference codebase's restore path)")
+        with open(os.path.join(self.versions_dir, vid, PARAMS_FILE),
+                  "rb") as fh:
+            return serialization.msgpack_restore(fh.read())
+
+    # -- channels ------------------------------------------------------
+    def read_channel(self, name: str) -> Optional[str]:
+        try:
+            with open(os.path.join(self.channels_dir, name)) as fh:
+                vid = fh.read().strip()
+        except FileNotFoundError:
+            return None
+        return vid or None
+
+    def channels(self) -> Dict[str, str]:
+        out = {}
+        try:
+            names = os.listdir(self.channels_dir)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if name.startswith(".") or name.endswith(".history"):
+                continue
+            vid = self.read_channel(name)
+            if vid:
+                out[name] = vid
+        return out
+
+    def set_channel(self, name: str, vid: str, *,
+                    require_exists: bool = True) -> None:
+        if require_exists and not os.path.isdir(
+                os.path.join(self.versions_dir, vid)):
+            raise RegistryError(
+                f"cannot point channel {name!r} at unknown version {vid!r}")
+        tmp = os.path.join(self.channels_dir,
+                           f".tmp.{name}.{uuid.uuid4().hex[:8]}")
+        _write_file_synced(tmp, (vid + "\n").encode())
+        os.replace(tmp, os.path.join(self.channels_dir, name))
+        _fsync_dir(self.channels_dir)
+        with open(os.path.join(self.channels_dir, name + ".history"),
+                  "a") as fh:
+            fh.write(f"{time.time():.3f} {vid}\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def channel_history(self, name: str) -> List[str]:
+        """Version ids the channel has pointed at, oldest first."""
+        try:
+            with open(os.path.join(self.channels_dir,
+                                   name + ".history")) as fh:
+                lines = fh.read().splitlines()
+        except FileNotFoundError:
+            return []
+        out = []
+        for ln in lines:
+            parts = ln.split()
+            if len(parts) == 2:
+                out.append(parts[1])
+        return out
+
+    def rollback(self, name: str) -> str:
+        """Move the channel back to the version it pointed at before the
+        current one; returns the restored version id."""
+        current = self.read_channel(name)
+        history = self.channel_history(name)
+        for vid in reversed(history):
+            if vid != current and os.path.isdir(
+                    os.path.join(self.versions_dir, vid)):
+                self.set_channel(name, vid)
+                return vid
+        raise RegistryError(
+            f"channel {name!r} has no previous distinct version to roll "
+            f"back to (current: {current!r})")
+
+    # -- gc ------------------------------------------------------------
+    def gc(self, keep: int) -> List[str]:
+        """Delete all but the newest `keep` versions; versions any channel
+        points at are always kept. Returns the deleted version ids."""
+        if keep < 1:
+            raise ValueError(f"gc keep={keep} must be >= 1")
+        manifests = self.list_versions()
+        pinned = set(self.channels().values())
+        victims = [m.version for m in manifests[:-keep]
+                   if m.version not in pinned]
+        trash_root = os.path.join(self.root, ".trash")
+        deleted = []
+        for vid in victims:
+            dst = os.path.join(trash_root,
+                               f"{vid}.{uuid.uuid4().hex[:8]}")
+            os.makedirs(trash_root, exist_ok=True)
+            try:
+                # Atomic disappearance first, slow rmtree second: readers
+                # never observe a half-deleted version directory.
+                os.rename(os.path.join(self.versions_dir, vid), dst)
+            except OSError:
+                continue  # concurrent gc won the race
+            shutil.rmtree(dst, ignore_errors=True)
+            deleted.append(vid)
+        return deleted

@@ -1,0 +1,93 @@
+"""Reload watcher: channel pointer → zero-downtime service swap.
+
+A serving process subscribes to one registry channel (`stable` in
+production). This thread polls the pointer every `registry.poll_s`
+seconds; when it moves, the new version is hash-VERIFIED, loaded to host,
+and handed to `SamplingService.swap_params`, which stages the tree on the
+mesh alongside the live one and flips between dispatches — requests in
+flight finish on the version they started on, warm sampler programs
+survive (the program cache is keyed on shapes, not params), and the old
+tree is freed after the flip.
+
+Failure policy: a version that fails verification or staging is logged
+(`swap_fail` event) and BLACKLISTED until the pointer moves again — the
+service keeps serving the old weights, and the poller doesn't retry-storm
+a known-bad artifact. Rolling the channel back is therefore always safe:
+the watcher treats the restored pointer like any other move.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from novel_view_synthesis_3d_tpu.registry.gate import EventCb
+from novel_view_synthesis_3d_tpu.registry.store import (
+    RegistryError,
+    RegistryStore,
+)
+
+
+class RegistryWatcher:
+    def __init__(self, service, store: RegistryStore, channel: str, *,
+                 poll_s: float = 2.0, event_cb: Optional[EventCb] = None,
+                 start: bool = True):
+        self.service = service
+        self.store = store
+        self.channel = channel
+        self.poll_s = max(0.01, float(poll_s))
+        self.event_cb = event_cb
+        self.swaps = 0
+        self.failures = 0
+        self._failed_vid: Optional[str] = None
+        self._stop = threading.Event()
+        self._poked = threading.Event()  # test hook: poll NOW
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="registry-watcher")
+        if start:
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.poll_once()
+            self._poked.wait(timeout=self.poll_s)
+            self._poked.clear()
+
+    def poke(self) -> None:
+        """Skip the remaining poll sleep (tests, admin endpoints)."""
+        self._poked.set()
+
+    def poll_once(self) -> Optional[str]:
+        """One poll: swap if the channel moved; returns the version
+        swapped to, else None."""
+        try:
+            vid = self.store.read_channel(self.channel)
+        except OSError:
+            return None
+        if (not vid or vid == self.service.model_version
+                or vid == self._failed_vid):
+            return None
+        try:
+            manifest = self.store.verify(vid)
+            params = self.store.load_params(vid, verify=False)
+            self.service.swap_params(params, vid, step=manifest.step,
+                                     timeout=600.0)
+        except Exception as exc:  # IntegrityError, torn IO, staging error
+            self.failures += 1
+            self._failed_vid = vid  # no retry-storm on a bad artifact
+            if self.event_cb is not None:
+                self.event_cb(0, "swap_fail",
+                              f"channel {self.channel} -> {vid}: {exc!r}; "
+                              "still serving "
+                              f"{self.service.model_version or '<initial>'}",
+                              vid)
+            return None
+        self.swaps += 1
+        self._failed_vid = None
+        return vid
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._poked.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)

@@ -35,7 +35,19 @@ Architecture (docs/DESIGN.md "Serving"):
     is the placement-compatible fallback — wasteful, never wrong);
   - instrumentation via `utils/profiling.ServiceStats`: per-request
     queue-wait / compile / device spans and a requests-per-second
-    counter (tools/serve_bench.py reads these).
+    counter (tools/serve_bench.py reads these);
+  - ZERO-DOWNTIME HOT RELOAD (docs/DESIGN.md "Model lifecycle"):
+    `swap_params` stages a new param tree on the same placement (mesh
+    replication or default device) ALONGSIDE the live one, and the
+    worker thread flips the (params, model_version) reference BETWEEN
+    dispatches — a dispatch in flight finishes on the version it
+    started on, queued requests ride the new one. The sampler-program
+    cache is keyed on shapes/config, not params, so every warm program
+    survives the swap (zero recompiles — asserted by
+    tools/serve_bench.py --hot-swap and tests/test_registry.py); the
+    old tree's service-owned device buffers are freed after the flip.
+    Every response and event row carries `model_version`; the
+    registry's RegistryWatcher drives this from a channel pointer.
 """
 
 from __future__ import annotations
@@ -90,6 +102,9 @@ class Ticket:
     def __init__(self, request_id: int):
         self.request_id = request_id
         self.timing: dict = {}
+        # Registry version the request was served on ("" pre-resolution
+        # or for services constructed without one).
+        self.model_version: str = ""
         self._done = threading.Event()
         self._image: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
@@ -210,7 +225,8 @@ class SamplingService:
     def __init__(self, model, params, diffusion: DiffusionConfig,
                  serve: Optional[ServeConfig] = None, *,
                  mesh=None, results_folder: Optional[str] = None,
-                 start: bool = True, tracer=None):
+                 start: bool = True, tracer=None,
+                 model_version: str = ""):
         self.model = model
         self.diffusion = diffusion
         self.serve = serve or ServeConfig()
@@ -229,15 +245,25 @@ class SamplingService:
         self._rejects_total = obs.get_registry().counter(
             "nvs3d_rejects_total",
             "requests refused (backpressure, deadline)")
+        self._model_swaps_total = obs.get_registry().counter(
+            "nvs3d_model_swaps_total",
+            "zero-downtime param swaps applied by the sampling service")
+        self._model_version_gauge = obs.get_registry().gauge(
+            "nvs3d_model_version",
+            "live model version (label) and its training step (value)")
         self._results_folder = results_folder or self.serve.results_folder
         self._events_lock = threading.Lock()
-        # Params placement: replicated over the mesh when serving
-        # data-parallel, else committed to the default device (host-side
-        # numpy params would re-upload per dispatch).
-        if mesh is not None:
-            self.params = mesh_lib.replicate(mesh, params)
-        else:
-            self.params = jax.device_put(params, jax.devices()[0])
+        # Live (params, model_version) pair — ONE attribute so readers
+        # (the dispatch loop, _log_event) always see a consistent pair;
+        # swaps stage a replacement and the worker flips it between
+        # dispatches (_apply_pending_swap).
+        staged, owned = self._stage_params(params)
+        self._live = (staged, model_version)
+        self._owned_ids = owned
+        self._pending_swap: Optional[dict] = None
+        self._swaps = 0
+        if model_version:
+            self._model_version_gauge.set(0.0, version=model_version)
         # Bucket ladder: powers of two up to max_batch; with a mesh, only
         # buckets the 'data' axis divides evenly are shard-dispatchable —
         # the others still serve, on the default device.
@@ -275,6 +301,9 @@ class SamplingService:
         if self._worker is not None:
             self._worker.join(timeout=10.0)
             self._worker = None
+        # A swap staged but not yet applied must not leave its waiter
+        # hanging: apply it inline (no dispatch can be in flight now).
+        self._apply_pending_swap()
         with self._lock:
             leftovers = list(self._queue)
             self._queue.clear()
@@ -286,6 +315,108 @@ class SamplingService:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # -- params lifecycle (zero-downtime hot reload) -------------------
+    @property
+    def params(self):
+        return self._live[0]
+
+    @property
+    def model_version(self) -> str:
+        return self._live[1]
+
+    def _stage_params(self, params):
+        """Place a param tree where dispatch needs it (mesh-replicated or
+        default device). Returns (staged_tree, owned_leaf_ids): only
+        buffers UPLOADED HERE from host (numpy) leaves count as service-
+        owned — the ones a later swap may free. A device-array input may
+        come back from device_put as a NEW wrapper over the SAME buffer,
+        so deleting by object identity would kill the caller's tree;
+        those leaves are left to garbage collection instead."""
+        if self.mesh is not None:
+            staged = mesh_lib.replicate(self.mesh, params)
+        else:
+            staged = jax.device_put(params, jax.devices()[0])
+        owned = set()
+        for inp, out in zip(jax.tree.leaves(params),
+                            jax.tree.leaves(staged)):
+            if not isinstance(inp, jax.Array) and out is not inp \
+                    and hasattr(out, "delete"):
+                owned.add(id(out))
+        return staged, owned
+
+    def _free_tree(self, tree, owned_ids, keep_ids=frozenset()) -> None:
+        for leaf in jax.tree.leaves(tree):
+            if (id(leaf) in owned_ids and id(leaf) not in keep_ids
+                    and hasattr(leaf, "delete")):
+                try:
+                    leaf.delete()
+                except Exception:
+                    pass  # already deleted / non-owning view
+
+    def swap_params(self, params, version: str, *,
+                    step: Optional[int] = None,
+                    timeout: Optional[float] = None) -> threading.Event:
+        """Stage `params` alongside the live set and request a swap.
+
+        The upload happens HERE (and is waited on), so the flip itself —
+        applied by the worker between dispatches — is a reference
+        assignment: no request ever blocks on a host→device transfer of
+        the new weights. Requests in flight finish on the version they
+        started on; every later dispatch serves `version`. Warm sampler
+        programs survive (the cache key has no params in it).
+
+        Returns the 'applied' event; `timeout` (seconds) waits for it —
+        with an idle or stopped worker the swap is applied inline.
+        """
+        staged, owned = self._stage_params(params)
+        jax.block_until_ready(staged)
+        applied = threading.Event()
+        pend = {"params": staged, "owned": owned, "version": version,
+                "step": step, "applied": applied}
+        with self._queue_cv:
+            prev, self._pending_swap = self._pending_swap, pend
+            self._queue_cv.notify_all()
+        if prev is not None:
+            # Superseded before it ever served: free its staging copy and
+            # release anyone waiting on it (last writer wins).
+            self._free_tree(prev["params"], prev["owned"],
+                            keep_ids={id(l) for l in
+                                      jax.tree.leaves(staged)})
+            prev["applied"].set()
+        if self._worker is None or not self._worker.is_alive():
+            self._apply_pending_swap()
+        if timeout is not None:
+            applied.wait(timeout)
+        return applied
+
+    def _apply_pending_swap(self) -> None:
+        """Flip to a staged param set; runs on the worker thread between
+        dispatches (or inline when no worker is running), so no dispatch
+        holds the old tree when its buffers are freed."""
+        with self._queue_cv:
+            pend, self._pending_swap = self._pending_swap, None
+        if pend is None:
+            return
+        old, old_version = self._live
+        with self.tracer.span("model_swap", version=pend["version"],
+                              prev=old_version or "<initial>"):
+            self._live = (pend["params"], pend["version"])
+            self._free_tree(
+                old, self._owned_ids,
+                keep_ids={id(l) for l in jax.tree.leaves(pend["params"])})
+            self._owned_ids = pend["owned"]
+        self._swaps += 1
+        self._model_swaps_total.inc()
+        self._model_version_gauge.set(
+            float(pend["step"]) if pend["step"] is not None
+            else float(self._swaps), version=pend["version"])
+        self._append_event(
+            pend["step"] or 0, "model_swap",
+            f"{old_version or '<initial>'} -> {pend['version']} "
+            f"(swap {self._swaps}, {len(self._programs)} warm programs "
+            "kept)", model_version=pend["version"])
+        pend["applied"].set()
 
     # -- submission ----------------------------------------------------
     def submit(self, cond: Dict[str, np.ndarray], *, seed: int = 0,
@@ -344,24 +475,33 @@ class SamplingService:
         return self._programs.counters()
 
     def summary(self) -> dict:
-        return dict(self.stats.summary(), **self.compile_counters())
+        return dict(self.stats.summary(), **self.compile_counters(),
+                    model_version=self.model_version,
+                    model_swaps=self._swaps)
 
     def _log_event(self, request_id: int, kind: str, detail: str) -> None:
         """Event-log append via the obs bus, schema-compatible with the
-        trainer's MetricsLogger.log_event (step,event,detail — request id
-        in the step column). Rare by construction (rejections and
-        expiries)."""
+        trainer's MetricsLogger.log_event (request id in the step
+        column). Rare by construction (rejections and expiries)."""
         self._rejects_total.inc(kind=kind)
+        self._append_event(request_id, kind, detail,
+                           model_version=self.model_version)
+
+    def _append_event(self, step: int, kind: str, detail: str, *,
+                      model_version: str = "") -> None:
         try:
             with self._events_lock:
-                obs.append_event(self._results_folder, request_id, kind,
-                                 detail)
+                obs.append_event(self._results_folder, step, kind,
+                                 detail, model_version=model_version)
         except OSError:
             pass  # the event log must never be the serving fault
 
     # -- batching worker -----------------------------------------------
     def _run(self) -> None:
         while not self._stop.is_set():
+            # Swaps apply HERE — between dispatches, never under one, so
+            # freeing the old tree can't race an in-flight program.
+            self._apply_pending_swap()
             group = self._collect_group()
             if not group:
                 continue
@@ -377,10 +517,13 @@ class SamplingService:
         held open for flush_timeout_ms or until max_batch riders."""
         flush_s = self.serve.flush_timeout_ms / 1000.0
         with self._queue_cv:
-            while not self._queue and not self._stop.is_set():
+            while (not self._queue and not self._stop.is_set()
+                   and self._pending_swap is None):
                 self._queue_cv.wait(timeout=0.1)
             if self._stop.is_set():
                 return []
+            if not self._queue:
+                return []  # woken by a pending swap: let _run apply it
             first = self._queue[0]
             key = first.program_key
             deadline = first.t_submit + flush_s
@@ -447,6 +590,10 @@ class SamplingService:
         n = len(group)
         bucket = bucket_for(n, self.serve.max_batch)
         H, W, steps, w = group[0].program_key
+        # One consistent (params, version) pair for the WHOLE dispatch:
+        # a swap landing mid-flight flips _live but this batch finishes —
+        # and is attributed — on the version it started with.
+        params, version = self._live
         # Pad rows repeat the LAST request (any valid row works — per-
         # sample RNG streams make rows independent); their outputs are
         # dropped below. Pad keys are zeros: never read by real rows.
@@ -481,19 +628,23 @@ class SamplingService:
         t_disp = time.monotonic()
         t0 = time.perf_counter()
         imgs = np.asarray(jax.device_get(
-            entry["fn"](self.params, keys_dev, cond_dev)))
+            entry["fn"](params, keys_dev, cond_dev)))
         elapsed = time.perf_counter() - t0
         entry["warm"] = True
         span = "compile" if cold else "device"
-        self.tracer.add_span(span, elapsed, bucket=bucket, batch_n=n)
-        with self.tracer.span("respond", batch_n=n):
+        self.tracer.add_span(span, elapsed, bucket=bucket, batch_n=n,
+                             model_version=version)
+        with self.tracer.span("respond", batch_n=n,
+                              model_version=version):
             for i, r in enumerate(group):
                 timing = {
                     "queue_wait_s": max(0.0, t_disp - r.t_submit),
                     f"{span}_s": elapsed,
                     "bucket": bucket,
                     "batch_n": n,
+                    "model_version": version,
                 }
+                r.ticket.model_version = version
                 self.stats.record_span("queue_wait",
                                        timing["queue_wait_s"])
                 self.stats.record_span(span, elapsed)

@@ -358,6 +358,31 @@ class Trainer:
                 f"step {bad_step} rejected: {reason.splitlines()[0][:160]}")
         self.results_folder = tcfg.results_folder
         os.makedirs(self.results_folder, exist_ok=True)
+
+        # --- registry publisher (registry.publish_every; docs/DESIGN.md
+        # "Model lifecycle") ---
+        # Every publish_every steps the EMA snapshot is published to the
+        # registry's `latest` channel as a content-hashed version. The
+        # hand-off is a reference; serialization/hashing/fsync run on the
+        # publisher's worker thread, so the step loop never blocks on
+        # registry IO. Process 0 only — the snapshot gather below is the
+        # collective part every host joins.
+        self._publisher = None
+        rcfg = config.registry
+        if rcfg.publish_every > 0 and jax.process_index() == 0:
+            from novel_view_synthesis_3d_tpu.registry import (
+                RegistryPublisher, RegistryStore)
+            from novel_view_synthesis_3d_tpu.registry.manifest import (
+                config_digest)
+
+            bus = self.telemetry.bus
+            self._publisher = RegistryPublisher(
+                RegistryStore(rcfg.dir),
+                ema=rcfg.publish_ema and tcfg.ema_decay > 0,
+                config_digest=config_digest(config),
+                event_cb=lambda step, kind, detail, version="": bus.event(
+                    step, kind, detail, model_version=version,
+                    echo="[registry]"))
         # units_per_measure: each measured region covers one dispatch, i.e.
         # steps_per_dispatch training steps — normalize so the end-of-run
         # summary reports true per-step times at any dispatch width.
@@ -680,6 +705,10 @@ class Trainer:
             self._prefetcher.stop()
             self._prefetcher = None
             self.watchdog.stop()
+            if self._publisher is not None:
+                # Drain, don't drop: the final snapshot is usually the
+                # one an operator wants to promote.
+                self._publisher.stop(drain=True)
             # Export trace.json, stop the device monitor, close the bus
             # and endpoint. Idempotent; a crashed run still gets its
             # trace up to the fault.
@@ -785,6 +814,17 @@ class Trainer:
                         self.tracer.span("checkpoint_save", step=step_now):
                     faultinject.maybe_stall("save", step_now)
                     self.ckpt.save(step_now, self._ckpt_state())
+
+            rcfg = self.config.registry
+            if rcfg.publish_every and step_now % rcfg.publish_every == 0:
+                # Collective on pods (every host joins the snapshot
+                # gather); only process 0 holds a publisher. The slow
+                # half (serialize + hash + fsync + rename) runs on the
+                # publisher's worker thread.
+                with self.tracer.span("registry_publish", step=step_now):
+                    snap = self._registry_snapshot(step_now)
+                    if self._publisher is not None and snap is not None:
+                        self._publisher.publish_async(step_now, snap)
 
             sample_due = (tcfg.sample_every
                           and step_now % tcfg.sample_every == 0)
@@ -894,6 +934,33 @@ class Trainer:
         self._gauge_loss.set(logged["loss"])
         if "mfu" in util:
             self._gauge_mfu.set(util["mfu"])
+
+    def _registry_snapshot(self, step_now: int):
+        """Host numpy copy of the publishable tree: the EMA when the run
+        trains one (and registry.publish_ema), else live params.
+
+        Collective on pods — EVERY host must call at the same step (the
+        replicate below rides ICI/DCN); non-reporting hosts get None.
+        Returns a tree the publisher worker may hold past this step: the
+        host-EMA fold REPLACES its tree (never mutates in place), and
+        device_get materializes fresh host arrays, so the snapshot can't
+        be overwritten under the async publish."""
+        use_ema = (self.config.registry.publish_ema
+                   and self.config.train.ema_decay > 0)
+        if use_ema and self._host_ema is not None:
+            self._maybe_update_host_ema(step_now, force=True)
+            if jax.process_index() != 0:
+                return None
+            return self._host_ema
+        tree = (self.state.ema_params
+                if use_ema and self.state.ema_params is not None
+                else self.state.params)
+        if jax.process_count() > 1:
+            tree = mesh_lib.replicate(self.mesh, tree)
+            jax.block_until_ready(tree)
+            if jax.process_index() != 0:
+                return None
+        return jax.tree.map(np.asarray, jax.device_get(tree))
 
     def _probe_host_params(self):
         """Sampling params for the in-loop probes, pod-safe.

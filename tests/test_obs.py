@@ -159,21 +159,41 @@ def test_eventbus_events_csv_byte_compatible(tmp_path):
     bus.event(120, "anomaly", "non-finite loss (strikes=1)", echo=None)
     obs.append_event(folder, -1, "supervised_restart",
                      "crash rc=1; restart 1/3")
+    bus.event(3, "model_swap", "a -> b", model_version="00000003-beef",
+              echo=None)
     bus.close()
-    # Byte-identical to what the three pre-obs writers produced: header
-    # then plain csv rows, no quoting beyond csv defaults.
+    # Byte-identical to the documented writer output: header then plain
+    # csv rows, no quoting beyond csv defaults. model_version (PR 5) is
+    # a trailing column — "" outside a versioned-serving context — so
+    # every name-keyed (DictReader) consumer keeps parsing.
     import io
 
     want = io.StringIO()
     w = csv.writer(want)
-    w.writerow(["step", "event", "detail"])
-    w.writerow([120, "anomaly", "non-finite loss (strikes=1)"])
-    w.writerow([-1, "supervised_restart", "crash rc=1; restart 1/3"])
+    w.writerow(["step", "event", "detail", "model_version"])
+    w.writerow([120, "anomaly", "non-finite loss (strikes=1)", ""])
+    w.writerow([-1, "supervised_restart", "crash rc=1; restart 1/3", ""])
+    w.writerow([3, "model_swap", "a -> b", "00000003-beef"])
     got = open(os.path.join(folder, "events.csv"), newline="").read()
     assert got == want.getvalue()
     # And the schema the consumers parse:
     rows = list(csv.DictReader(open(os.path.join(folder, "events.csv"))))
-    assert [r["event"] for r in rows] == ["anomaly", "supervised_restart"]
+    assert [r["event"] for r in rows] == \
+        ["anomaly", "supervised_restart", "model_swap"]
+    assert rows[2]["model_version"] == "00000003-beef"
+
+
+def test_events_csv_old_header_rotates(tmp_path):
+    """A pre-model_version events.csv (3-column header) rotates to .old
+    instead of taking misaligned 4-column rows."""
+    folder = str(tmp_path)
+    path = os.path.join(folder, "events.csv")
+    with open(path, "w", newline="") as fh:
+        fh.write("step,event,detail\r\n1,stall,old row\r\n")
+    obs.append_event(folder, 2, "anomaly", "new row")
+    rows = list(csv.DictReader(open(path)))
+    assert [r["event"] for r in rows] == ["anomaly"]
+    assert "stall" in open(path + ".old").read()
 
 
 def test_metricslogger_routes_through_bus(tmp_path):
@@ -256,13 +276,57 @@ def test_no_direct_csv_writers_outside_obs():
             for node in ast.walk(tree):
                 if (isinstance(node, ast.Constant)
                         and isinstance(node.value, str)
-                        and node.value in ("events.csv", "metrics.csv")):
+                        and node.value in ("events.csv", "metrics.csv",
+                                           "telemetry.jsonl")):
                     offenders.append(
                         f"{os.path.relpath(path, pkg_root)}:{node.lineno}"
                         f" -> {node.value!r}")
     assert not offenders, (
-        "modules outside obs/ name the telemetry CSVs directly (route "
+        "modules outside obs/ name the telemetry files directly (route "
         "writes through obs.bus):\n  " + "\n  ".join(offenders))
+
+
+def test_registry_event_writers_route_through_bus():
+    """The registry/gate lifecycle events (gate_pass/gate_fail/rollback/
+    model_publish/model_swap) must reach events.csv through the bus, not
+    a private CSV path: every registry module that names a lifecycle
+    event kind must hold no `import csv` and no direct telemetry-file
+    literal (the walk above already bans those), and the package routes
+    its event callbacks through novel_view_synthesis_3d_tpu.obs."""
+    import novel_view_synthesis_3d_tpu.registry as registry_pkg
+
+    reg_dir = os.path.dirname(os.path.abspath(registry_pkg.__file__))
+    kinds = {"gate_pass", "gate_fail", "rollback", "model_publish",
+             "model_swap", "publish_reject"}
+    found_kinds = set()
+    for fn in sorted(os.listdir(reg_dir)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(reg_dir, fn)) as fh:
+            tree = ast.parse(fh.read(), filename=fn)
+        names_events = False
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value in kinds):
+                found_kinds.add(node.value)
+                names_events = True
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod = getattr(node, "module", None) or ""
+                imported = [a.name for a in node.names]
+                assert "csv" not in imported and mod != "csv", (
+                    f"registry/{fn} imports csv — telemetry CSV writes "
+                    "belong to obs.bus only")
+        if names_events:
+            # Writers hand their rows to an EventCb the caller wires to
+            # obs (EventBus.event / append_event) — the module itself
+            # must not open telemetry files (banned literals above).
+            src = open(os.path.join(reg_dir, fn)).read()
+            assert "event_cb" in src or "EventCb" in src or "obs." in src, (
+                f"registry/{fn} names lifecycle events but has no "
+                "bus-routed event path")
+    # The kinds the DESIGN doc promises actually exist in the package.
+    assert {"gate_pass", "gate_fail", "model_publish"} <= found_kinds
 
 
 # ---------------------------------------------------------------------------
@@ -350,9 +414,10 @@ def test_train_telemetry_acceptance(tiny_trainer, tmp_path):
             "checkpoint_save", "compile"} <= names
     assert doc["otherData"]["run_id"]
 
-    # Pillar 2: events.csv schema identical to the PR-1/2/3 writers'.
+    # Pillar 2: events.csv schema — the PR-1/2/3 columns plus the PR-5
+    # model_version attribution column.
     with open(res / "events.csv") as fh:
-        assert fh.readline().strip() == "step,event,detail"
+        assert fh.readline().strip() == "step,event,detail,model_version"
     # metrics.csv carries the utilization columns.
     with open(res / "metrics.csv") as fh:
         header = fh.readline().strip().split(",")

@@ -28,9 +28,17 @@ exits rc=1.
 Usage:
   python tools/serve_bench.py [--preset tiny64] [--concurrency 8]
       [--requests 16] [--steps 4] [--sidelength 16] [--max-batch 4]
+      [--hot-swap]
 
 `--sidelength` downsizes the preset's image for bench runtime (the
 tiny64 model is resolution-free; 16 px keeps the CPU run under ~2 min).
+
+`--hot-swap` additionally exercises the model-lifecycle path
+(docs/DESIGN.md "Model lifecycle"): a second version is published to a
+throwaway registry MID-LOAD, the reload watcher swaps it in under live
+traffic, and the run ASSERTS zero rejected/failed requests and zero new
+sampler-program compilations across the swap (rc=1 on violation). The
+JSON gains a "hot_swap" section with p99 latency before/during/after.
 """
 
 from __future__ import annotations
@@ -187,6 +195,123 @@ def mixed_size_sweep(service, conds, buckets) -> dict:
     }
 
 
+def _p99(latencies) -> float:
+    if not latencies:
+        return 0.0
+    vals = sorted(latencies)
+    return vals[min(len(vals) - 1, int(round(0.99 * (len(vals) - 1))))]
+
+
+def hot_swap_bench(service, conds, params, concurrency: int,
+                   per_phase: int) -> dict:
+    """Publish a new version mid-load and measure the swap's cost.
+
+    Three phases of `per_phase` requests each at `concurrency` client
+    threads — before (v1), during (the publish + watcher swap lands in
+    the middle of this phase), after (v2) — with per-request wall-clock
+    latency collected per phase. Asserts (SystemExit) zero failed or
+    rejected requests and zero new sampler-program compilations across
+    the whole sequence, and that traffic actually moved to the new
+    version."""
+    import tempfile
+    import jax as _jax
+
+    from novel_view_synthesis_3d_tpu.registry import (
+        RegistryStore, RegistryWatcher)
+
+    reg_dir = tempfile.mkdtemp(prefix="nvs3d_serve_bench_reg_")
+    store = RegistryStore(reg_dir)
+    host = _jax.tree.map(np.asarray, _jax.device_get(params))
+    m1 = store.publish_params(host, step=1, ema=False, channel="stable")
+    # v2: same shapes (warm programs must survive), different values.
+    host2 = _jax.tree.map(lambda p: np.asarray(p) * 1.02, host)
+    service.swap_params(store.load_params(m1.version), m1.version,
+                        step=m1.step, timeout=600)
+    watcher = RegistryWatcher(service, store, "stable", poll_s=0.05)
+    compile_before = service.compile_counters()
+    errors = []
+    versions = []
+    vlock = threading.Lock()
+
+    def run_phase(seed0: int):
+        lat = []
+
+        def client(tid: int):
+            for j in range(max(1, per_phase // concurrency)):
+                t0 = time.perf_counter()
+                try:
+                    t = service.submit(
+                        conds[(tid + j) % len(conds)],
+                        seed=seed0 + tid * 1000 + j)
+                    t.result(timeout=600)
+                    with vlock:
+                        versions.append(t.model_version)
+                except Exception as e:
+                    errors.append(e)
+                    continue
+                lat.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(concurrency)]
+        for t in threads:
+            t.start()
+        return threads, lat
+
+    try:
+        th, lat_before = run_phase(70_000)
+        [t.join() for t in th]
+        th, lat_during = run_phase(80_000)
+        time.sleep(0.05)  # let the during-phase load build up
+        m2 = store.publish_params(host2, step=2, ema=False,
+                                  channel="stable")
+        [t.join() for t in th]
+        # The swap may land at the tail of the during phase; make sure it
+        # is applied before the after phase so "after" is all-v2.
+        deadline = time.monotonic() + 30
+        while (service.model_version != m2.version
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        th, lat_after = run_phase(90_000)
+        [t.join() for t in th]
+    finally:
+        watcher.stop()
+    compile_after = service.compile_counters()
+    built_delta = (compile_after["programs_built"]
+                   - compile_before["programs_built"])
+    jit_delta = (compile_after["jit_cache_entries"]
+                 - compile_before["jit_cache_entries"])
+    result = {
+        "registry": reg_dir,
+        "versions": [m1.version, m2.version],
+        "swaps": watcher.swaps,
+        "served_on": sorted(set(versions)),
+        "failed_requests": len(errors),
+        "p99_before_s": round(_p99(lat_before), 4),
+        "p99_during_s": round(_p99(lat_during), 4),
+        "p99_after_s": round(_p99(lat_after), 4),
+        "programs_built_delta": built_delta,
+        "jit_cache_entries_delta": jit_delta,
+    }
+    if errors:
+        raise SystemExit(
+            f"serve_bench --hot-swap: {len(errors)} request(s) failed/"
+            f"rejected across the swap; first: {errors[0]!r}")
+    if built_delta or jit_delta:
+        raise SystemExit(
+            "serve_bench --hot-swap: the swap triggered new sampler "
+            f"compilations ({result}) — the program cache must survive "
+            "a params swap (it is keyed on shapes, not params)")
+    if service.model_version != m2.version:
+        raise SystemExit(
+            f"serve_bench --hot-swap: watcher never swapped to "
+            f"{m2.version} (still {service.model_version})")
+    if m2.version not in set(versions):
+        raise SystemExit(
+            "serve_bench --hot-swap: no request was served on the new "
+            "version after the swap")
+    return result
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="tiny64")
@@ -197,6 +322,9 @@ def main() -> int:
     ap.add_argument("--sidelength", type=int, default=16)
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--flush-timeout-ms", type=float, default=25.0)
+    ap.add_argument("--hot-swap", action="store_true",
+                    help="publish a new version mid-bench and assert a "
+                         "zero-downtime, zero-recompile swap")
     args = ap.parse_args()
 
     from novel_view_synthesis_3d_tpu.config import ServeConfig
@@ -231,6 +359,11 @@ def main() -> int:
 
         rps = bench_service(service, conds, args.requests, args.concurrency)
         sweep = mixed_size_sweep(service, conds, buckets)
+        hot_swap = None
+        if args.hot_swap:
+            hot_swap = hot_swap_bench(service, conds, params,
+                                      args.concurrency,
+                                      per_phase=args.requests)
         base_rps = bench_baseline(cfg, model, params, conds,
                                   args.baseline_requests)
         stats = service.stats
@@ -256,6 +389,8 @@ def main() -> int:
             "compile_counters": service.compile_counters(),
             "platform": jax.default_backend(),
         }
+        if hot_swap is not None:
+            result["hot_swap"] = hot_swap
         print(json.dumps(result))
         if (sweep["programs_built_delta"] != 0
                 or sweep["jit_cache_entries_delta"] != 0):

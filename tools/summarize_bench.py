@@ -100,6 +100,8 @@ def telemetry_rows(search_dirs):
             seen.add(path)
             spans = {}
             peak_bytes = 0.0
+            versions = []  # ordered-unique model_version timeline
+            swaps = 0
             try:
                 with open(path) as fh:
                     for line in fh:
@@ -107,6 +109,12 @@ def telemetry_rows(search_dirs):
                             rec = json.loads(line)
                         except json.JSONDecodeError:
                             continue  # torn tail line of a crashed run
+                        mv = rec.get("model_version")
+                        if mv and (not versions or versions[-1] != mv):
+                            versions.append(mv)
+                        if (rec.get("kind") == "event"
+                                and rec.get("event") == "model_swap"):
+                            swaps += 1
                         if rec.get("kind") == "span":
                             spans.setdefault(rec.get("name", "?"),
                                              []).append(
@@ -122,8 +130,8 @@ def telemetry_rows(search_dirs):
                 durs.sort()
                 phases[name] = (len(durs), _pctl(durs, 0.5),
                                 _pctl(durs, 0.9), _pctl(durs, 0.99))
-            if phases or peak_bytes:
-                rows.append((path, phases, peak_bytes))
+            if phases or peak_bytes or versions:
+                rows.append((path, phases, peak_bytes, versions, swaps))
     return rows
 
 
@@ -177,7 +185,7 @@ def main() -> int:
     lines += ["", "## Telemetry (span percentiles / peak device memory, "
                   "from telemetry.jsonl)", ""]
     if telem:
-        for path, phases, peak_bytes in telem:
+        for path, phases, peak_bytes, versions, swaps in telem:
             peak = (f" peak_device_bytes={peak_bytes / 1e9:.2f}G"
                     if peak_bytes else "")
             lines.append(f"- `{path}`:{peak}")
@@ -185,6 +193,12 @@ def main() -> int:
                 lines.append(
                     f"  - {name}: n={n} p50={p50 * 1e3:.1f}ms "
                     f"p90={p90 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms")
+            if versions:
+                # Model lifecycle: which registry versions served this
+                # run, in order, and how many hot swaps landed.
+                lines.append(
+                    f"  - model versions: {' -> '.join(versions)} "
+                    f"(swaps={swaps})")
     else:
         lines.append("- none recorded")
     text = "\n".join(lines) + "\n"
