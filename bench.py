@@ -121,7 +121,8 @@ def _median(xs):
 
 
 def bench_framework(state, step, device_batch, steps: int,
-                    steps_per_dispatch: int = 1, tracer=None) -> float:
+                    steps_per_dispatch: int = 1, tracer=None,
+                    repeats: int = REPEATS) -> float:
     # Warmup/compile. Sync points use device_get (a real host fetch):
     # block_until_ready has been observed returning early through the
     # remote-accelerator tunnel, producing physically impossible timings.
@@ -141,7 +142,7 @@ def bench_framework(state, step, device_batch, steps: int,
         state, m = step(state, device_batch)
         float(jax.device_get(m["loss"]))
     reps = []
-    for _ in range(REPEATS):
+    for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(dispatches):
             with tracer.span("train_step"):
@@ -153,7 +154,7 @@ def bench_framework(state, step, device_batch, steps: int,
 
 
 def bench_reference_style(cfg, model, schedule, params, batch,
-                          steps: int) -> float:
+                          steps: int, repeats: int = REPEATS) -> float:
     """Reference-structure step: CPU float64 noising per batch + eager
     (jit-per-call overhead avoided, but no donation, host round-trips for
     the noised input) — the pmap-replicate pattern of train.py:132-155."""
@@ -209,7 +210,7 @@ def bench_reference_style(cfg, model, schedule, params, batch,
     params, opt_state, loss = one_step(params, opt_state)  # warmup/compile
     float(jax.device_get(loss))
     reps = []
-    for _ in range(REPEATS):
+    for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps):
             params, opt_state, loss = one_step(params, opt_state)
@@ -314,7 +315,7 @@ def bench_sample(preset_name: str, sample_steps: int = 256,
                                 "device; per-op round trips inflate "
                                 "vs_baseline_eager — judge by vs_baseline "
                                 "(jit-per-step)")
-    print(json.dumps(out))
+    _emit(out)
 
 
 def _sampling_setup(preset_name: str, sample_steps: int, overrides):
@@ -384,14 +385,14 @@ def bench_sample_ar(preset_name: str, num_views: int = 4,
     for i in range(reps):
         run(jax.random.PRNGKey(i + 1))
     sec_view = (time.perf_counter() - t0) / reps / num_views
-    print(json.dumps({
+    _emit({
         "metric": (f"ar_{sample_steps}step_{num_views}view_sample_"
                    f"sec_per_view_{preset_name}"),
         "value": round(sec_view, 3),
         "unit": "sec/view",
         "vs_baseline": None,  # the reference has no autoregressive sampler
         "platform": jax.default_backend(),
-    }))
+    })
 
 
 def _cost_numbers(compiled):
@@ -442,7 +443,7 @@ def bench_analyze(preset_name: str, overrides=()) -> None:
             v = getattr(mem, k, None)
             if v is not None:
                 result[k] = int(v)
-    print(json.dumps(result))
+    _emit(result)
 
 
 def bench_data(backend: str = "native", batches: int = 50,
@@ -539,52 +540,88 @@ def bench_profile(preset_name: str, steps: int, overrides=(),
         for _ in range(steps):
             state, m = step(state, device_batch)
         float(jax.device_get(m["loss"]))
-    print(json.dumps({"metric": f"profile_{preset_name}", "value": steps,
-                      "unit": "steps", "trace_dir": out_dir,
-                      "platform": jax.default_backend()}))
+    _emit({"metric": f"profile_{preset_name}", "value": steps,
+           "unit": "steps", "trace_dir": out_dir,
+           "platform": jax.default_backend()})
+
+
+# Benchmark lane: 'device' (accelerator reachable, the judged tier) or
+# 'cpu' (explicit JAX_PLATFORMS=cpu, or automatic fallback after a failed
+# device probe). The CPU lane is a SEPARATE trajectory: every emitted
+# JSON line carries lane/"baseline_file" so a CPU number can never be
+# mistaken for a device one (BENCH_r01 postmortem), and it compares only
+# against BASELINE_CPU.json. ROADMAP item 5a: BENCH_r03-r05 all exited
+# rc=3 with no parsed datapoint because the probe-failure path refused to
+# emit anything — now every round lands a labeled number.
+LANE = "device"
+LANE_REASON = ""
+
+
+def _emit(result: dict) -> None:
+    """Print ONE judged JSON line, lane-labeled (see LANE above)."""
+    result["lane"] = LANE
+    result["baseline_file"] = ("BASELINE_CPU.json" if LANE == "cpu"
+                               else "BASELINE.json")
+    if LANE == "cpu" and LANE_REASON:
+        result["lane_reason"] = LANE_REASON
+    print(json.dumps(result))
 
 
 def _require_live_backend() -> None:
-    """Bounded backend reachability gate; hard-fail (rc=3) if dead.
+    """Bounded backend reachability gate; on failure, drop to the
+    labeled CPU lane instead of refusing to emit anything.
 
     The probe/retry machinery lives in parallel/dist.require_backend
     (promoted there so cli train/sample/eval and the tools watcher share
     it — round 1/2 postmortem: the remote-accelerator tunnel can wedge
     such that jax.devices() blocks forever, and a single probe followed
-    by a silent CPU fallback produced either a meaningless CPU number
-    (BENCH_r01) or a driver timeout on the slow CPU path (BENCH_r02,
-    rc=124)). The bench keeps a LONGER default budget than the CLI
-    (NVS3D_PROBE_BUDGET_S, default 360 s) because the tunnel recovers in
-    bursts and a missing bench number costs a whole round; the exit is
-    still structured (dist.EXIT_BACKEND_UNREACHABLE + reason line), never
-    a silent hang. NVS3D_BENCH_ALLOW_CPU=1 restores the explicit CPU
-    fallback for debugging.
+    by a SILENT CPU fallback produced a meaningless CPU number labeled
+    as a device bench (BENCH_r01)). The bench keeps a longer default
+    budget than the CLI (NVS3D_PROBE_BUDGET_S, default 120 s) because
+    the tunnel recovers in bursts — but no longer the PR 2 360 s: a
+    failed probe now costs a lane downgrade, not the whole round, so
+    burning 6 of the driver's ~15 budget minutes probing left too
+    little for the CPU bench itself.
+
+    Probe outcome decides the LANE, not whether a number exists:
+      - reachable backend → device lane, unchanged from PR 2;
+      - probe failure → the bench RE-PINS to CPU and runs the CPU tier
+        (platform/lane='cpu' in the JSON, BASELINE_CPU.json trajectory,
+        reduced default steps so the slow host fits the driver budget —
+        the BENCH_r02 rc=124 fix). Four straight rc=3 rounds with no
+        parsed datapoint (BENCH_r03-r05) is what this replaces.
+    NVS3D_BENCH_REQUIRE_DEVICE=1 restores the hard rc=3 refusal for
+    rounds that must not produce a CPU number.
     """
+    global LANE, LANE_REASON
     if os.environ.get("JAX_PLATFORMS") == "cpu":
+        LANE = "cpu"
+        LANE_REASON = "JAX_PLATFORMS=cpu requested"
         return
     from novel_view_synthesis_3d_tpu.parallel import dist
 
     try:
-        dist.require_backend(default_budget_s=360.0)
+        dist.require_backend(default_budget_s=120.0)
     except SystemExit as e:
-        if os.environ.get("NVS3D_BENCH_ALLOW_CPU") == "1":
-            print("warning: backend unreachable; NVS3D_BENCH_ALLOW_CPU=1 — "
-                  "falling back to CPU (NOT a device benchmark)",
+        if os.environ.get("NVS3D_BENCH_REQUIRE_DEVICE") == "1":
+            print("error: refusing to emit a CPU number for a device "
+                  "benchmark (NVS3D_BENCH_REQUIRE_DEVICE=1).",
                   file=sys.stderr)
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            jax.config.update("jax_platforms", "cpu")
-            return
-        print("error: refusing to emit a CPU number for a device "
-              "benchmark. Set NVS3D_BENCH_ALLOW_CPU=1 to override.",
+            # Structured result even on failure: one machine-readable
+            # object says what and why instead of a bare "parsed": null.
+            print(json.dumps(_probe_failure_result(
+                int(e.code) if isinstance(e.code, int) else 3,
+                dist.LAST_FAILURE_REASON)))
+            raise
+        print("warning: device backend unreachable — falling back to the "
+              "CPU benchmark lane (lane='cpu' in the JSON; compared "
+              "against BASELINE_CPU.json, never the device baseline)",
               file=sys.stderr)
-        # Structured result even on failure: the probe path used to exit
-        # rc=3 with NO JSON line, so BENCH_r0*.json archives recorded
-        # "parsed": null with the reason buried in a .out file. One
-        # machine-readable object says what and why.
-        print(json.dumps(_probe_failure_result(
-            int(e.code) if isinstance(e.code, int) else 3,
-            dist.LAST_FAILURE_REASON)))
-        raise
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        LANE = "cpu"
+        LANE_REASON = (dist.LAST_FAILURE_REASON
+                       or "device backend unreachable")
 
 
 def _probe_failure_result(rc: int, reason) -> dict:
@@ -635,6 +672,27 @@ def main():
         return
     preset = args[0] if args else "tiny64"
     steps = int(args[1]) if len(args) > 1 else 30
+    repeats = REPEATS
+    if LANE == "cpu" and len(args) <= 1:
+        # CPU-lane default sizing: the 1-core tier must land its number
+        # inside the driver's budget (the BENCH_r02 rc=124 postmortem —
+        # a full-size 30-step × 5-rep run on the CPU fallback blew it;
+        # even 10 steps × 2 reps of the fused 10-step dispatch spent
+        # 20+ min between the big-scan compile and ~8 s/img hot steps).
+        # 4 steps × 1 rep of a SINGLE-step program at batch 2 lands in
+        # minutes warm-cache; it is a noisier median, but the lane is a
+        # trajectory of like-for-like rounds (sizing rides in the JSON),
+        # not a device-grade measurement. Explicit steps override.
+        steps = 4
+        repeats = 1
+        if not any(o.startswith("train.steps_per_dispatch")
+                   for o in overrides):
+            overrides = list(overrides) + ["train.steps_per_dispatch=1"]
+        if not any(o.startswith("train.batch_size") for o in overrides):
+            overrides = list(overrides) + ["train.batch_size=2"]
+        print(f"note: cpu lane: steps={steps}, repeats={repeats}, "
+              "steps_per_dispatch=1, batch_size=2 (pass an explicit "
+              "step count / overrides to re-size)", file=sys.stderr)
     if (preset == "tiny64"
             and not any(o.startswith("train.steps_per_dispatch")
                         for o in overrides)):
@@ -679,12 +737,13 @@ def main():
     tracer = obs.Tracer(registry=obs.get_registry())
     devmon = obs_devmon.DeviceMonitor(obs.get_registry(), poll_s=0)
 
-    sec_fw = bench_framework(state, step, device_batch, steps, spd, tracer)
+    sec_fw = bench_framework(state, step, device_batch, steps, spd,
+                             tracer, repeats=repeats)
     imgs_per_sec_chip = B / sec_fw / n_chips
     mem_snapshot = devmon.snapshot()  # right after the hot loop: peak HBM
 
     sec_ref = bench_reference_style(cfg, model, schedule, host_params, batch,
-                                    steps)
+                                    steps, repeats=repeats)
     ref_imgs_per_sec_chip = B / sec_ref / n_chips
 
     result = {
@@ -726,7 +785,7 @@ def main():
                for k, v in s.items()}
         for name, s in tracer.summary().items()}
     result["telemetry"] = {"spans": spans, "device_memory": mem_snapshot}
-    print(json.dumps(result))
+    _emit(result)
 
 
 if __name__ == "__main__":

@@ -626,6 +626,110 @@ def cmd_export(args, overrides: List[str]) -> int:
 
 
 # ---------------------------------------------------------------------------
+# distill (progressive distillation: teacher -> few-step student)
+# ---------------------------------------------------------------------------
+def cmd_distill(args, overrides: List[str]) -> int:
+    """Progressive distillation rounds against a registry teacher.
+
+    Reads the teacher from --teacher-version (or the --teacher-channel
+    pointer), runs config.distill step-halving rounds
+    (train/distill.run_distill), publishes each student generation as a
+    registry version on --channel, and — with --promote-channel — runs
+    the existing fixed-seed PSNR gate (registry/gate.py) on the FINAL
+    student and advances that channel on a pass. The gate probes at the
+    student's final step count: the comparison is "serving at N steps
+    with the candidate vs the incumbent", the few-step serving regime
+    the distillation exists for. Prints one JSON line per round and a
+    closing summary line.
+    """
+    from novel_view_synthesis_3d_tpu.parallel import dist
+
+    dist.require_backend()  # sub-60s structured failure on a dead tunnel
+    setup_compilation_cache()
+
+    import jax
+
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.registry import (
+        RegistryError, RegistryStore, make_psnr_probe, promote, run_gate)
+    from novel_view_synthesis_3d_tpu.train.distill import run_distill
+
+    cfg = build_config(args, overrides)
+    store = RegistryStore(args.registry)
+    vid = args.teacher_version or store.read_channel(args.teacher_channel)
+    if vid is None:
+        raise SystemExit(
+            f"registry {args.registry!r} channel "
+            f"{args.teacher_channel!r} points at no version — publish "
+            "and promote a teacher first (nvs3d registry publish)")
+    manifest = store.verify(vid)
+    teacher_params = store.load_params(vid, verify=False)
+    print(f"teacher: {vid} (step {manifest.step}, channel "
+          f"{args.teacher_channel})")
+    model = XUNet(cfg.model)
+    event_cb = _registry_event_cb(args.registry)
+
+    data_iter = None
+    root = args.folder or cfg.data.root_dir
+    if root and os.path.isdir(root):
+        try:
+            import dataclasses
+
+            from novel_view_synthesis_3d_tpu.data.pipeline import (
+                iter_batches, make_dataset)
+
+            ds = make_dataset(dataclasses.replace(cfg.data, root_dir=root))
+            if len(ds) > 0:
+                data_iter = iter_batches(ds, cfg.distill.batch_size,
+                                         seed=cfg.distill.seed)
+                print(f"distilling on {root} ({len(ds)} records)")
+        except Exception as e:
+            print(f"note: falling back to synthetic distill batches ({e})")
+    try:
+        results = run_distill(
+            cfg, model, teacher_params, data_iter=data_iter, store=store,
+            publish_channel=args.channel, base_step=manifest.step,
+            event_cb=event_cb)
+    except (ValueError, FloatingPointError) as e:
+        raise SystemExit(f"distill error: {e}")
+    for r in results:
+        print(json.dumps(dict(r.to_dict(), teacher=vid)))
+    final = results[-1]
+    if args.promote_channel:
+        probe = make_psnr_probe(
+            model, cfg.diffusion,
+            _gate_probe_batch(cfg, args.folder),
+            sample_steps=final.student_steps,
+            seed=cfg.registry.gate_seed)
+        try:
+            gate = run_gate(store, final.version,
+                            channel=args.promote_channel, probe_fn=probe,
+                            margin_db=cfg.registry.gate_margin_db,
+                            event_cb=event_cb)
+        except RegistryError as e:
+            raise SystemExit(f"gate error: {e}")
+        print(json.dumps({
+            "candidate": gate.candidate, "incumbent": gate.incumbent,
+            "candidate_psnr": round(gate.candidate_psnr, 3),
+            "incumbent_psnr": (None if gate.incumbent_psnr is None
+                               else round(gate.incumbent_psnr, 3)),
+            "gate_sample_steps": final.student_steps,
+            "passed": gate.passed, "reason": gate.reason}))
+        if not gate.passed:
+            print(f"promotion REFUSED: {gate.reason} (channel "
+                  f"{args.promote_channel} untouched)")
+            return 1
+        promote(store, final.version, channel=args.promote_channel,
+                gate=gate, event_cb=event_cb)
+        print(f"promoted {final.version} -> channel "
+              f"{args.promote_channel}")
+    print(f"distilled {cfg.distill.start_steps} -> "
+          f"{final.student_steps} steps over {len(results)} round(s); "
+          f"serve with sample_steps={final.student_steps}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # registry (model lifecycle: publish / promote / rollback / gc)
 # ---------------------------------------------------------------------------
 def _registry_event_cb(registry_dir: str):
@@ -995,6 +1099,31 @@ def make_parser() -> argparse.ArgumentParser:
                    help="registry channel for --registry (default latest)")
 
     p = sub.add_parser(
+        "distill",
+        help="progressive distillation: halve the teacher's sampling "
+             "steps per round (registry teacher -> published few-step "
+             "students, optional PSNR-gated promotion)")
+    _add_common(p)
+    p.add_argument("folder", nargs="?", default=None,
+                   help="SRN tree for distillation batches (default "
+                        "data.root_dir; synthetic fallback)")
+    p.add_argument("--registry", required=True, metavar="DIR",
+                   help="registry holding the teacher; students are "
+                        "published here")
+    p.add_argument("--teacher-channel", default="stable",
+                   help="channel supplying the teacher (default stable)")
+    p.add_argument("--teacher-version", default=None,
+                   help="explicit teacher version id (overrides "
+                        "--teacher-channel)")
+    p.add_argument("--channel", default="distill",
+                   help="channel each student generation is published to "
+                        "(default 'distill')")
+    p.add_argument("--promote-channel", default=None,
+                   help="after the final round, run the PSNR gate and "
+                        "advance this channel to the few-step student "
+                        "(rc=1 + pointer untouched on a gate fail)")
+
+    p = sub.add_parser(
         "registry",
         help="model lifecycle: versioned publish, quality-gated promote, "
              "rollback, gc over a registry directory")
@@ -1053,6 +1182,7 @@ _COMMANDS = {
     "config": cmd_config,
     "export": cmd_export,
     "registry": cmd_registry,
+    "distill": cmd_distill,
 }
 
 

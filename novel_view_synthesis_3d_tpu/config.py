@@ -325,6 +325,18 @@ class ServeConfig:
     cache of compiled sampler programs — warm traffic never recompiles
     (docs/DESIGN.md "Serving")."""
 
+    # Scheduler: 'step' (default) = persistent stepper with STEP-LEVEL
+    # continuous batching — one compiled denoise-step program per bucket
+    # shape runs over a ring of active request slots; new arrivals join
+    # the ring between steps and finished rows exit immediately, so a
+    # 4-step distilled request never waits behind a 256-step one, and
+    # requests with different step counts / guidance weights share one
+    # program (t and w are device arguments, not compile-time constants).
+    # 'request' = the PR 3 whole-request dispatcher (one lax.scan per
+    # coalesced group), kept as the serve_bench baseline and for exact
+    # dpm++ 2M serving — the stepper serves dpm++ with the first-order
+    # (history-free) update, same rule as the stochastic sampler.
+    scheduler: str = "step"
     # Largest coalesced batch (top of the power-of-two bucket ladder).
     max_batch: int = 8
     # Bounded request queue: a submit past this depth is REJECTED with a
@@ -347,6 +359,35 @@ class ServeConfig:
     # Where the service writes its events.csv (rejections, deadline
     # expiries) — same schema as the trainer's.
     results_folder: str = "./serve"
+
+
+@dataclasses.dataclass(frozen=True)
+class DistillConfig:
+    """Progressive distillation (train/distill.py; `nvs3d distill`).
+
+    Salimans & Ho 2022 (arXiv 2202.00512): each round trains a student —
+    initialized from the teacher — to match TWO teacher DDIM steps with
+    ONE of its own, halving the sampling-step count per round
+    (start_steps → start_steps/2 → … → target_steps). The registry is
+    the teacher/student store: the teacher is read from a channel, each
+    student generation is published as a version, and promotion runs the
+    existing fixed-seed PSNR gate (registry/gate.py)."""
+
+    # Step count of the first teacher (respaced from diffusion.timesteps).
+    start_steps: int = 256
+    # Stop once the student reaches this many sampling steps. Must divide
+    # start_steps by a power of two (one halving per round).
+    target_steps: int = 4
+    # Optimizer updates per halving round.
+    steps_per_round: int = 200
+    # Distillation batch size (host-assembled; single-device).
+    batch_size: int = 8
+    lr: float = 1e-4
+    # Truncated-SNR loss-weight cap: weight = clip(SNR, 1, snr_clip) on
+    # the x₀-space distillation loss (the paper's max(SNR, 1), bounded so
+    # near-clean timesteps cannot dominate a round).
+    snr_clip: float = 5.0
+    seed: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -458,6 +499,8 @@ class Config:
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     registry: RegistryConfig = dataclasses.field(
         default_factory=RegistryConfig)
+    distill: DistillConfig = dataclasses.field(
+        default_factory=DistillConfig)
 
     # ------------------------------------------------------------------
     # Validation
@@ -651,6 +694,11 @@ class Config:
                     f"train.watchdog.{nm}={getattr(wd, nm)} must be >= 0 "
                     "(0 disables that deadline)")
         sv = self.serve
+        if sv.scheduler not in ("step", "request"):
+            errors.append(
+                f"serve.scheduler={sv.scheduler!r} must be 'step' "
+                "(step-level continuous batching) or 'request' (whole-"
+                "request dispatch)")
         if sv.max_batch < 1 or (sv.max_batch & (sv.max_batch - 1)) != 0:
             errors.append(
                 f"serve.max_batch={sv.max_batch} must be a power of two "
@@ -714,6 +762,36 @@ class Config:
             errors.append(
                 f"registry.keep={rg.keep} must be >= 1 (gc must retain at "
                 "least the newest version)")
+        dl = self.distill
+        if dl.target_steps < 1:
+            errors.append(
+                f"distill.target_steps={dl.target_steps} must be >= 1")
+        elif dl.start_steps < dl.target_steps:
+            errors.append(
+                f"distill.start_steps={dl.start_steps} must be >= "
+                f"distill.target_steps={dl.target_steps}")
+        else:
+            ratio, rem = divmod(dl.start_steps, dl.target_steps)
+            if rem or (ratio & (ratio - 1)) != 0:
+                errors.append(
+                    f"distill.start_steps={dl.start_steps} must be "
+                    f"target_steps × a power of two (each round halves "
+                    f"the step count; got target_steps={dl.target_steps})")
+        # start_steps <= diffusion.timesteps is enforced at the point of
+        # use (train/distill.run_distill): the default ladder must not
+        # invalidate tiny-timesteps test configs that never distill.
+        if dl.steps_per_round < 1:
+            errors.append(
+                f"distill.steps_per_round={dl.steps_per_round} must be "
+                ">= 1")
+        if dl.batch_size < 1:
+            errors.append(f"distill.batch_size={dl.batch_size} must be >= 1")
+        if dl.lr <= 0:
+            errors.append(f"distill.lr={dl.lr} must be > 0")
+        if dl.snr_clip < 1.0:
+            errors.append(
+                f"distill.snr_clip={dl.snr_clip} must be >= 1 (the "
+                "truncated-SNR weight is clip(SNR, 1, snr_clip))")
         ob = self.obs
         if not 0 <= ob.metrics_port <= 65535:
             errors.append(
@@ -780,6 +858,7 @@ class Config:
             serve=build(ServeConfig, d.get("serve", {})),
             obs=build(ObsConfig, d.get("obs", {})),
             registry=build(RegistryConfig, d.get("registry", {})),
+            distill=build(DistillConfig, d.get("distill", {})),
         )
 
     @classmethod

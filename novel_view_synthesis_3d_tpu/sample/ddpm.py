@@ -311,6 +311,145 @@ def make_request_sampler(model, schedule: DiffusionSchedule,
     return sample
 
 
+# Per-row schedule coefficients the slot stepper feeds as ONE DEVICE
+# ARGUMENT — a (B, len(STEP_COEF_KEYS)) float32 matrix, column i holding
+# STEP_COEF_KEYS[i] — covering every table value the per-step update math
+# reads, so the compiled program depends on the bucket SHAPE only, never on
+# a row's step count, schedule position, or guidance weight. One packed
+# matrix instead of a dict of scalars keeps the per-step host→device
+# traffic to a single transfer (the stepper uploads fresh coefficients
+# EVERY step — this is its hottest host-side path). The bank that gathers
+# rows per request lives in sample/stepper.py.
+STEP_COEF_KEYS = (
+    "logsnr",             # network conditioning at the row's original t
+    "sqrt_recip_acp",     # √(1/ᾱ_t)   (eps→x0, and ddim's ε̂ inversion)
+    "sqrt_recipm1_acp",   # √(1/ᾱ_t−1)
+    "sqrt_acp",           # √ᾱ_t       (v→x0)
+    "sqrt_1macp",         # √(1−ᾱ_t)
+    "pm_coef1",           # ddpm posterior mean coefficients
+    "pm_coef2",
+    "post_log_var",       # ddpm clipped posterior log-variance
+    "acp",                # ᾱ_t, ᾱ_{t−1} (ddim update)
+    "acp_prev",
+    "nonzero",            # 1.0 while t > 0 (no noise at the final step)
+)
+
+
+def make_slot_step_fn(model, config: DiffusionConfig):
+    """ONE reverse-process step over a ring batch with per-row schedules.
+
+    The serving stepper's device program (sample/service.py,
+    docs/DESIGN.md "Continuous batching & distillation"):
+
+      step(params, z, keys, first, cond, coefs, w) -> (z_next, keys_next)
+
+    with z (B, H, W, 3), keys a (B, 2) per-row PRNG carry, `first` a (B,)
+    bool marking rows entering the ring THIS step, `coefs` a
+    (B, len(STEP_COEF_KEYS)) float32 matrix (every schedule table value
+    the update reads, gathered on host per row — one packed transfer per
+    step), and w the (B,) per-row guidance
+    weight. Rows are fully independent: row i's output depends on
+    (z_i, keys_i, cond_i, coefs_i, w_i) alone, so a request's image is
+    bit-identical whether it steps solo or interleaved with any co-riders
+    joining/leaving the ring — the ring-composition invariance the service
+    asserts (tests/test_stepper.py).
+
+    Rows with first=True draw their init noise HERE, reproducing
+    `make_request_sampler`'s pre-scan key split exactly: split(key) →
+    (carry, k_init), z₀ = N(0,1) from k_init; every row then splits its
+    carry into (next_carry, k_step) exactly like the scan body — so a
+    request stepped t times through this program sees the same RNG stream
+    (and the same per-step math) as the whole-request sampler.
+
+    The compiled program depends on the BUCKET SHAPE only: a mixed
+    4-step/256-step batch, or mixed guidance weights, runs one program —
+    t/steps_remaining/w are device arguments (the program-cache key
+    contract, docs/DESIGN.md). `sampler='dpm++'` runs its first-order
+    (history-free) update here — ring membership changes between steps,
+    so multistep history is invalid, the same rule `_make_update` applies
+    to stochastic conditioning; serve with serve.scheduler='request' for
+    exact 2M."""
+    phi = config.cfg_rescale
+    if not 0.0 <= phi <= 1.0:
+        raise ValueError(f"cfg_rescale must be in [0, 1], got {phi}")
+    clip_denoised = config.clip_denoised
+    objective = config.objective
+    if objective not in ("eps", "x0", "v"):
+        raise ValueError(f"unknown objective {objective!r}")
+    sampler = config.sampler
+    eta = config.ddim_eta if sampler == "ddim" else 0.0
+    if sampler == "dpm++":
+        sampler = "ddim"  # first-order fallback (see docstring)
+    if sampler not in ("ddpm", "ddim"):
+        raise ValueError(f"unknown sampler {config.sampler!r}")
+
+    logsnr_col = STEP_COEF_KEYS.index("logsnr")
+
+    def col(coefs, name, ndim):
+        c = coefs[:, STEP_COEF_KEYS.index(name)]
+        return c.reshape(c.shape + (1,) * (ndim - 1))
+
+    def to_x0(z, out, coefs):
+        if objective == "eps":
+            return (col(coefs, "sqrt_recip_acp", z.ndim) * z
+                    - col(coefs, "sqrt_recipm1_acp", z.ndim) * out)
+        if objective == "x0":
+            return out
+        return (col(coefs, "sqrt_acp", z.ndim) * z
+                - col(coefs, "sqrt_1macp", z.ndim) * out)
+
+    @jax.jit
+    def step(params, z, keys, first, cond, coefs, w):
+        B = z.shape[0]
+        # Rows entering the ring draw init noise from their own stream.
+        both = jax.vmap(jax.random.split)(keys)
+        k_carry, k_init = both[:, 0], both[:, 1]
+        z0 = jax.vmap(lambda k: jax.random.normal(k, z.shape[1:]))(k_init)
+        fmask = first.reshape((B,) + (1,) * (z.ndim - 1))
+        z = jnp.where(fmask, z0.astype(z.dtype), z)
+        keys = jnp.where(first[:, None], k_carry, keys)
+        # Per-step draw: identical split layout to the scan body.
+        both = jax.vmap(jax.random.split)(keys)
+        keys_next, k_step = both[:, 0], both[:, 1]
+
+        pose_embs = _doubled_pose_embs(model, params, cond)
+        batch = dict(cond, z=z, logsnr=coefs[:, logsnr_col])
+        w_bcast = w.reshape((B,) + (1,) * (z.ndim - 1))
+        guided, cond_out = _cfg_eps(model, params, batch, w_bcast,
+                                    pose_embs=pose_embs)
+        x0 = to_x0(z, guided, coefs)
+        if phi > 0.0:
+            x0_c = to_x0(z, cond_out, coefs)
+            axes = tuple(range(1, x0.ndim))
+            std_c = jnp.std(x0_c, axis=axes, keepdims=True)
+            std_g = jnp.std(x0, axis=axes, keepdims=True)
+            rescaled = x0 * (std_c / jnp.maximum(std_g, 1e-8))
+            x0 = phi * rescaled + (1.0 - phi) * x0
+        if clip_denoised:
+            x0 = jnp.clip(x0, -1.0, 1.0)
+        nonzero = col(coefs, "nonzero", z.ndim)
+        noise = _step_noise(k_step, z)
+        if sampler == "ddpm":
+            mean = (col(coefs, "pm_coef1", z.ndim) * x0
+                    + col(coefs, "pm_coef2", z.ndim) * z)
+            z_next = mean + nonzero * jnp.exp(
+                0.5 * col(coefs, "post_log_var", z.ndim)) * noise
+        else:  # ddim (and the dpm++ first-order fallback at eta=0)
+            acp = col(coefs, "acp", z.ndim)
+            acp_prev = col(coefs, "acp_prev", z.ndim)
+            eps_hat = (col(coefs, "sqrt_recip_acp", z.ndim) * z - x0) \
+                / col(coefs, "sqrt_recipm1_acp", z.ndim)
+            sigma = (eta * jnp.sqrt((1.0 - acp_prev) / (1.0 - acp))
+                     * jnp.sqrt(jnp.maximum(1.0 - acp / acp_prev, 0.0)))
+            dir_zt = jnp.sqrt(
+                jnp.maximum(1.0 - acp_prev - sigma ** 2, 0.0)) * eps_hat
+            z_next = (jnp.sqrt(acp_prev) * x0 + dir_zt
+                      + nonzero * sigma * noise)
+        return z_next, keys_next
+
+    return step
+
+
 def make_stochastic_sampler(model, schedule: DiffusionSchedule,
                             config: DiffusionConfig, max_pool: int,
                             precompute_pose: Optional[bool] = None):

@@ -1,4 +1,4 @@
-"""Sampling service: dynamic micro-batching over a bounded request queue.
+"""Sampling service: step-level continuous batching over a slot ring.
 
 The ROADMAP north star is "serve heavy traffic from millions of users",
 but until this module sampling was a one-shot CLI path: every request
@@ -9,7 +9,31 @@ latency is dominated by device compute — exactly the regime where
 micro-batching (torchgpipe, arXiv 2004.09910) and keeping the device fed
 from the host side (MinatoLoader, arXiv 2509.10712) pay off.
 
-Architecture (docs/DESIGN.md "Serving"):
+Two schedulers share the front-end (serve.scheduler):
+
+  - 'step' (default; docs/DESIGN.md "Continuous batching & distillation"):
+    a persistent STEPPER — the diffusion analogue of LLM continuous
+    batching. One compiled denoise-STEP program per bucket shape
+    (sample/ddpm.make_slot_step_fn) runs over a ring of active request
+    slots, each slot carrying its own (z, t, cond, keys, steps_remaining,
+    model_version). New arrivals join the ring BETWEEN steps (filling
+    padded slots), finished rows exit and respond immediately — a 4-step
+    distilled request never waits behind a 256-step one. Heterogeneous
+    per-row step counts and guidance weights ride in ONE batch: the
+    schedule position t and w are device arguments (host-gathered by
+    sample/stepper.ScheduleBank), never compile-time constants, so the
+    program cache is keyed on bucket/shape only and a mixed 4/256-step
+    warm sweep compiles nothing. Per-sample key threading makes each
+    row's image bit-identical whether it stepped solo or interleaved
+    with others joining/leaving mid-flight (ring-composition
+    invariance, tests/test_stepper.py). A pending hot swap DRAINS the
+    ring first: in-flight requests finish on their start version, queued
+    arrivals ride the new one.
+  - 'request': the PR 3 whole-request dispatcher (one lax.scan per
+    coalesced same-program group), kept as the serve_bench baseline and
+    for exact dpm++ 2M serving.
+
+Shared architecture (docs/DESIGN.md "Serving"):
 
   - a BOUNDED request queue with backpressure: a submit past
     `serve.queue_depth` is rejected immediately with a reason (and an
@@ -64,7 +88,11 @@ from novel_view_synthesis_3d_tpu import obs
 from novel_view_synthesis_3d_tpu.config import DiffusionConfig, ServeConfig
 from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
 from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
-from novel_view_synthesis_3d_tpu.sample.ddpm import make_request_sampler
+from novel_view_synthesis_3d_tpu.sample.ddpm import (
+    make_request_sampler,
+    make_slot_step_fn,
+)
+from novel_view_synthesis_3d_tpu.sample.stepper import ScheduleBank
 from novel_view_synthesis_3d_tpu.utils.profiling import ServiceStats
 
 COND_KEYS = ("x", "R1", "t1", "R2", "t2", "K")
@@ -144,6 +172,45 @@ class _Request:
         self.program_key = program_key
         self.t_submit = t_submit
         self.deadline_s = deadline_s  # 0 = none
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(self.cond["x"].shape[:2])
+
+
+class _Slot:
+    """One active request's ring state (step scheduler).
+
+    Carries exactly what the tentpole contract names: the evolving latent
+    `z` (host numpy between re-bucketings, device-resident on the carry
+    fast path), the ladder position `t` (steps_remaining = t + 1), the
+    conditioning (on the request), the per-row PRNG carry `keys`, and the
+    model_version the row was admitted under (pinned: swaps drain the
+    ring, so a slot never changes weights mid-flight)."""
+
+    __slots__ = ("req", "bank", "w", "z", "keys", "first", "t", "version",
+                 "t_admit", "device_s", "compile_s", "steps_done",
+                 "bucket0", "batch0")
+
+    def __init__(self, req: _Request, bank, version: str, t_admit: float):
+        self.req = req
+        self.bank = bank
+        self.w = float(req.program_key[3])
+        self.z: Optional[np.ndarray] = None  # drawn on device at step 1
+        self.keys = np.asarray(req.key, np.uint32)
+        self.first = True
+        self.t = bank.n - 1
+        self.version = version
+        self.t_admit = t_admit
+        self.device_s = 0.0
+        self.compile_s = 0.0
+        self.steps_done = 0
+        self.bucket0 = 0
+        self.batch0 = 0
+
+    @property
+    def shape(self) -> tuple:
+        return self.req.shape
 
 
 class SamplerProgramCache:
@@ -272,8 +339,20 @@ class SamplingService:
         while b <= self.serve.max_batch:
             self._buckets.append(b)
             b *= 2
-        self._programs = SamplerProgramCache(
-            self._build_program, self.serve.program_cache_entries)
+        if self.serve.scheduler == "step":
+            # Stepper programs depend on bucket/shape ONLY (t, steps and
+            # guidance ride as device args); the host-side coefficient
+            # bank supplies per-row schedule values per dispatch.
+            self._programs = SamplerProgramCache(
+                self._build_step_program, self.serve.program_cache_entries)
+            self._banks = ScheduleBank(self.diffusion)
+            # Per-bucket all-False `first` vectors, staged once: the
+            # carry fast path reuses them instead of re-uploading.
+            self._false_cache: Dict[int, object] = {}
+        else:
+            self._programs = SamplerProgramCache(
+                self._build_program, self.serve.program_cache_entries)
+            self._banks = None
         self._lock = threading.Lock()
         self._queue_cv = threading.Condition(self._lock)
         self._queue: collections.deque = collections.deque()
@@ -439,6 +518,10 @@ class SamplingService:
                 f"cond['x'] must be unbatched (H, W, 3); got {x.shape}")
         steps = sample_steps or self.serve.sample_steps or \
             self.diffusion.sample_timesteps
+        if not 1 <= int(steps) <= self.diffusion.timesteps:
+            raise Rejected(
+                f"sample_steps={steps} outside [1, diffusion.timesteps="
+                f"{self.diffusion.timesteps}]")
         w = (self.diffusion.guidance_weight
              if guidance_weight is None else float(guidance_weight))
         if deadline_ms is None:
@@ -498,6 +581,14 @@ class SamplingService:
 
     # -- batching worker -----------------------------------------------
     def _run(self) -> None:
+        if self.serve.scheduler == "step":
+            self._run_stepper()
+        else:
+            self._run_request()
+
+    def _run_request(self) -> None:
+        """Whole-request dispatch (PR 3 semantics; serve.scheduler=
+        'request'): one lax.scan program per coalesced group."""
         while not self._stop.is_set():
             # Swaps apply HERE — between dispatches, never under one, so
             # freeing the old tree can't race an in-flight program.
@@ -511,6 +602,287 @@ class SamplingService:
                 for req in group:
                     req.ticket._fail(
                         ServeError(f"dispatch failed: {exc!r}"))
+
+    # -- step-level continuous batching (serve.scheduler='step') --------
+    def _run_stepper(self) -> None:
+        """Persistent stepper: a ring of active slots advances one
+        denoise step per dispatch; arrivals join between steps, finished
+        rows exit immediately. `carry` keeps the ring's (z, keys, cond)
+        device-resident while the composition is stable — the common
+        no-join/no-exit iteration moves nothing through the host."""
+        ring: List[_Slot] = []
+        carry: Optional[dict] = None
+        try:
+            while not self._stop.is_set():
+                if not ring:
+                    # Swaps apply only on an empty ring (drain-on-swap):
+                    # in-flight requests keep their start version.
+                    if carry is not None:
+                        self._materialize(carry)
+                        carry = None
+                    self._apply_pending_swap()
+                if self._admit(ring):
+                    if carry is not None:
+                        self._materialize(carry)
+                        carry = None
+                if self._stop.is_set():
+                    break
+                if not ring:
+                    continue
+                try:
+                    carry = self._ring_step(ring, carry)
+                except BaseException as exc:  # fail the ring, keep serving
+                    for slot in ring:
+                        slot.req.ticket._fail(
+                            ServeError(f"ring step failed: {exc!r}"))
+                    ring.clear()
+                    carry = None
+        finally:
+            for slot in ring:
+                slot.req.ticket._fail(Rejected("service stopped"))
+
+    def _admit(self, ring: List[_Slot]) -> bool:
+        """Move queued requests into free ring slots; True if the ring
+        composition changed. Blocks only while the ring is empty and
+        there is nothing to do. On an EMPTY ring the oldest request is
+        held open for flush_timeout_ms so co-riders share the first
+        dispatch (the whole-request dispatcher's coalescing contract);
+        with steps already in flight arrivals join immediately. While a
+        swap is pending nothing is admitted — the ring drains, queued
+        requests ride the new version."""
+        flush_s = self.serve.flush_timeout_ms / 1000.0
+        admitted: List[_Request] = []
+        expired: List[tuple] = []
+        with self._queue_cv:
+            if not ring:
+                while (not self._queue and not self._stop.is_set()
+                       and self._pending_swap is None):
+                    self._queue_cv.wait(timeout=0.1)
+                if (self._stop.is_set() or not self._queue
+                        or self._pending_swap is not None):
+                    return False
+                head = self._queue[0]
+                deadline = head.t_submit + flush_s
+                shape = head.shape
+                while not self._stop.is_set():
+                    ready = sum(1 for r in self._queue if r.shape == shape)
+                    if ready >= self.serve.max_batch:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._queue_cv.wait(timeout=min(remaining, 0.05))
+                if self._stop.is_set():
+                    return False
+            elif self._pending_swap is not None:
+                return False
+            shape = ring[0].shape if ring else None
+            kept: List[_Request] = []
+            now = time.monotonic()
+            free = self.serve.max_batch - len(ring)
+            for r in self._queue:
+                waited = now - r.t_submit
+                if r.deadline_s and waited > r.deadline_s:
+                    expired.append((r, waited))
+                    continue
+                if shape is None:
+                    shape = r.shape
+                if r.shape == shape and len(admitted) < free:
+                    admitted.append(r)
+                else:
+                    kept.append(r)  # full ring or foreign image size
+            self._queue.clear()
+            self._queue.extend(kept)
+        for r, waited in expired:
+            self._log_event(
+                r.ticket.request_id, "deadline",
+                f"queued {waited * 1e3:.1f}ms > deadline "
+                f"{r.deadline_s * 1e3:.0f}ms")
+            r.ticket._fail(DeadlineExceeded(
+                f"request waited {waited * 1e3:.1f}ms, deadline was "
+                f"{r.deadline_s * 1e3:.0f}ms"))
+        if not admitted:
+            return False
+        now = time.monotonic()
+        version = self._live[1]
+        for r in admitted:
+            steps = int(r.program_key[2])
+            slot = _Slot(r, self._banks.get(steps), version, now)
+            ring.append(slot)
+            # step_wait: submit → ring admission (the stepper's analogue
+            # of queue_wait; bounded by steps in flight, not by whole
+            # requests ahead).
+            self.tracer.add_span("step_wait", now - r.t_submit,
+                                 request_id=r.ticket.request_id,
+                                 steps=slot.bank.n)
+        return True
+
+    def _place(self, tree, bucket: int):
+        """Device placement for one ring dispatch: shard over the mesh
+        'data' axis when the bucket divides it, replicate over the mesh
+        otherwise, default device without a mesh (same policy as the
+        whole-request dispatcher)."""
+        if mesh_lib.divides_data_axis(self.mesh, bucket):
+            return mesh_lib.shard_batch(self.mesh, tree)
+        if self.mesh is not None:
+            return jax.device_put(tree, mesh_lib.replicated(self.mesh))
+        return jax.device_put(tree, jax.devices()[0])
+
+    def _false_rows(self, bucket: int):
+        """Cached device-staged all-False (bucket,) `first` vector."""
+        dev = self._false_cache.get(bucket)
+        if dev is None:
+            dev = self._place(np.zeros(bucket, bool), bucket)
+            self._false_cache[bucket] = dev
+        return dev
+
+    def _materialize(self, carry: dict) -> None:
+        """Pull the carry's device-resident (z, keys) back into the host
+        slot state — the ring composition is about to change, so the next
+        dispatch rebuilds its batch from rows."""
+        z_host = np.asarray(jax.device_get(carry["z"]))
+        k_host = np.asarray(jax.device_get(carry["keys"]))
+        for i, slot in enumerate(carry["slots"]):
+            slot.z = z_host[i]
+            slot.keys = k_host[i]
+
+    def _step_cache_key(self, bucket: int, H: int, W: int) -> tuple:
+        """Stepper program identity: bucket SHAPE plus the DiffusionConfig
+        fields the compiled step bakes in. Deliberately NO steps, t, or
+        guidance weight — those are device arguments, which is what makes
+        a mixed 4/256-step warm sweep compile nothing (the PR 3 key
+        folded `steps` in, which under step-level scheduling would have
+        recompiled per step count)."""
+        d = self.diffusion
+        return (bucket, H, W, d.sampler, d.cfg_rescale, d.ddim_eta,
+                d.objective, d.clip_denoised, d.schedule, d.timesteps)
+
+    def _build_step_program(self):
+        return make_slot_step_fn(self.model, self.diffusion)
+
+    def _ring_step(self, ring: List[_Slot],
+                   carry: Optional[dict]) -> Optional[dict]:
+        """One denoise step over the whole ring. Returns the device-
+        resident carry for the next iteration, or None when rows exited
+        (the composition changed, so the next dispatch rebuilds)."""
+        n = len(ring)
+        bucket = bucket_for(n, self.serve.max_batch)
+        H, W = ring[0].shape
+        params, _ = self._live
+        pad = bucket - n
+        sig = (tuple(id(s) for s in ring), bucket)
+        with self.tracer.span("batch_form", bucket=bucket, batch_n=n):
+            if carry is not None and carry["sig"] != sig:
+                self._materialize(carry)
+                carry = None
+            if carry is None:
+                zeros_img = np.zeros((H, W, 3), np.float32)
+                z = np.stack(
+                    [s.z if s.z is not None else zeros_img for s in ring]
+                    + [zeros_img] * pad)
+                keys = np.stack([s.keys for s in ring]
+                                + [np.zeros(2, np.uint32)] * pad)
+                cond = {
+                    k: np.stack([s.req.cond[k] for s in ring]
+                                + [ring[-1].req.cond[k]] * pad)
+                    for k in COND_KEYS
+                }
+                z_dev = self._place(z, bucket)
+                keys_dev = self._place(keys, bucket)
+                cond_dev = self._place(cond, bucket)
+            else:
+                z_dev, keys_dev, cond_dev = (
+                    carry["z"], carry["keys"], carry["cond"])
+            # Per-row schedule coefficients: ONE packed (B, K) host
+            # gather + device transfer per step (bank.table rows) — this
+            # is what keeps t/steps/w out of the program identity. Pad
+            # rows repeat the last real row's coefficients so their
+            # (discarded) math stays finite. `first`/`w` only change
+            # when the ring composition does, so the carry fast path
+            # re-uploads nothing but the coefficient matrix.
+            last = ring[-1]
+            coefs = np.stack(
+                [s.bank.table[s.t] for s in ring]
+                + [last.bank.table[last.t]] * pad)
+            coefs_dev = self._place(coefs, bucket)
+            if carry is None:
+                first = np.asarray([s.first for s in ring] + [False] * pad)
+                w = np.asarray([s.w for s in ring] + [last.w] * pad,
+                               np.float32)
+                first_dev = self._place(first, bucket)
+                w_dev = self._place(w, bucket)
+            else:
+                first_dev, w_dev = carry["first"], carry["w"]
+            entry = self._programs.get(self._step_cache_key(bucket, H, W))
+        cold = not entry["warm"]
+        t0 = time.perf_counter()
+        z_next, keys_next = entry["fn"](params, z_dev, keys_dev, first_dev,
+                                        cond_dev, coefs_dev, w_dev)
+        jax.block_until_ready(z_next)
+        elapsed = time.perf_counter() - t0
+        entry["warm"] = True
+        self.tracer.add_span("compile" if cold else "ring_step", elapsed,
+                             bucket=bucket, batch_n=n)
+        self.stats.record_span("ring_step", elapsed)
+        finished: List[_Slot] = []
+        for s in ring:
+            if s.first:
+                s.bucket0, s.batch0 = bucket, n
+                s.first = False
+            # Cold dispatches land in compile_s, warm ones in device_s —
+            # the 'device' span keeps its PR 3 meaning (warm device time).
+            if cold:
+                s.compile_s += elapsed
+            else:
+                s.device_s += elapsed
+            s.steps_done += 1
+            s.t -= 1
+            if s.t < 0:
+                finished.append(s)
+        if not finished:
+            # Every continuing row has now taken its first step, so the
+            # carried `first` is the cached all-False vector (reusing
+            # this dispatch's `first_dev` would re-draw init noise).
+            return {"z": z_next, "keys": keys_next, "cond": cond_dev,
+                    "first": self._false_rows(bucket), "w": w_dev,
+                    "sig": sig, "slots": list(ring)}
+        z_host = np.asarray(jax.device_get(z_next))
+        k_host = np.asarray(jax.device_get(keys_next))
+        with self.tracer.span("respond", batch_n=len(finished)):
+            keep: List[_Slot] = []
+            for i, s in enumerate(ring):
+                if s.t < 0:
+                    self._resolve_slot(s, z_host[i])
+                else:
+                    s.z = z_host[i]
+                    s.keys = k_host[i]
+                    keep.append(s)
+            ring[:] = keep
+        return None
+
+    def _resolve_slot(self, slot: _Slot, image: np.ndarray) -> None:
+        req = slot.req
+        qw = max(0.0, slot.t_admit - req.t_submit)
+        timing = {
+            "queue_wait_s": qw,
+            "device_s": slot.device_s,
+            "bucket": slot.bucket0,
+            "batch_n": slot.batch0,
+            "steps": slot.steps_done,
+            "model_version": slot.version,
+        }
+        if slot.compile_s:
+            timing["compile_s"] = slot.compile_s
+        req.ticket.model_version = slot.version
+        self.stats.record_span("queue_wait", qw)
+        self.stats.record_span("device", slot.device_s)
+        if slot.compile_s:
+            self.stats.record_span("compile", slot.compile_s)
+        self.tracer.add_span("queue_wait", qw,
+                             request_id=req.ticket.request_id)
+        req.ticket._resolve(image, timing)
+        self.stats.count_requests(1)
+        self._requests_total.inc(1)
 
     def _collect_group(self) -> List[_Request]:
         """Pop one coalescable group: same program key, oldest first,

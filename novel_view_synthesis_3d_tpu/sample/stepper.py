@@ -1,0 +1,99 @@
+"""Host-side schedule bank for the step-level serving scheduler.
+
+The stepper's device program (`sample/ddpm.make_slot_step_fn`) is keyed
+on the bucket SHAPE only; everything schedule-dependent — a row's
+timestep position, its respaced ladder, its guidance weight — rides as
+device arguments. This module owns the host side of that contract: for
+each requested sampling-step count it builds (once, cached) the float32
+coefficient tables of the respaced schedule, exactly the values
+`DiffusionSchedule`'s jitted gathers would produce on device, so a host
+`coefs[name][t]` gather feeds the program the same numbers the
+whole-request `lax.scan` sampler reads from its on-device tables.
+
+One bank per step count, one program per bucket: a mixed 4-step/256-step
+warm sweep compiles NOTHING (asserted by tools/serve_bench.py and
+tests/test_stepper.py) — the fix for the PR 3 cache key folding `steps`
+into the program identity, which under step-level scheduling would have
+recompiled per step-count.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from novel_view_synthesis_3d_tpu.config import DiffusionConfig
+from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
+from novel_view_synthesis_3d_tpu.sample.ddpm import STEP_COEF_KEYS
+
+
+class StepBank:
+    """Per-step-count coefficient tables (numpy float32, host-resident).
+
+    `n` is the ACTUAL respaced ladder length (`respace` dedups timesteps,
+    so n <= requested steps). A request walks t = n-1, n-2, …, 0; its
+    per-step device argument is `table[t]`, one packed
+    (len(STEP_COEF_KEYS),) row — the stepper stacks one such row per
+    slot into the (B, K) matrix `make_slot_step_fn` consumes, so the
+    whole ring's schedule state moves host→device in ONE transfer per
+    step. `coefs` exposes the same values as named column views.
+    """
+
+    __slots__ = ("steps", "n", "table", "coefs")
+
+    def __init__(self, config: DiffusionConfig, steps: int):
+        sched = sampling_schedule(config, steps)
+        n = sched.num_timesteps
+        ts = jnp.arange(n)
+        self.steps = int(steps)
+        self.n = int(n)
+        by_name: Dict[str, np.ndarray] = {
+            # logsnr evaluated through the schedule's own jnp path (one
+            # vectorized call) so the values match what the scan sampler
+            # computes per step on device.
+            "logsnr": np.asarray(sched.logsnr(ts), np.float32),
+            "sqrt_recip_acp": np.asarray(
+                sched.sqrt_recip_alphas_cumprod, np.float32),
+            "sqrt_recipm1_acp": np.asarray(
+                sched.sqrt_recipm1_alphas_cumprod, np.float32),
+            "sqrt_acp": np.asarray(sched.sqrt_alphas_cumprod, np.float32),
+            "sqrt_1macp": np.asarray(
+                sched.sqrt_one_minus_alphas_cumprod, np.float32),
+            "pm_coef1": np.asarray(sched.posterior_mean_coef1, np.float32),
+            "pm_coef2": np.asarray(sched.posterior_mean_coef2, np.float32),
+            "post_log_var": np.asarray(
+                sched.posterior_log_variance_clipped, np.float32),
+            "acp": np.asarray(sched.alphas_cumprod, np.float32),
+            "acp_prev": np.asarray(sched.alphas_cumprod_prev, np.float32),
+            "nonzero": (np.arange(n) > 0).astype(np.float32),
+        }
+        assert set(by_name) == set(STEP_COEF_KEYS)
+        # (n, K) with columns in STEP_COEF_KEYS order — the layout the
+        # compiled step program indexes.
+        self.table = np.stack([by_name[k] for k in STEP_COEF_KEYS], axis=1)
+        self.coefs: Dict[str, np.ndarray] = {
+            k: self.table[:, i] for i, k in enumerate(STEP_COEF_KEYS)}
+
+
+class ScheduleBank:
+    """Thread-safe cache of StepBanks keyed by requested step count.
+
+    Banks are tiny (n × 11 float32 scalars) and immutable, so the cache
+    never evicts — a service serving every step count from 1 to
+    diffusion.timesteps holds at most that many rows of coefficients.
+    """
+
+    def __init__(self, config: DiffusionConfig):
+        self._config = config
+        self._banks: Dict[int, StepBank] = {}
+        self._lock = threading.Lock()
+
+    def get(self, steps: int) -> StepBank:
+        with self._lock:
+            bank = self._banks.get(steps)
+            if bank is None:
+                bank = self._banks[steps] = StepBank(self._config, steps)
+            return bank

@@ -1,0 +1,300 @@
+"""Progressive distillation: teacher→student step-halving rounds.
+
+Salimans & Ho, "Progressive Distillation for Fast Sampling of Diffusion
+Models" (arXiv 2202.00512): a student initialized from the teacher learns
+to match TWO deterministic (η=0 DDIM) teacher steps with ONE of its own,
+halving the sampling-step count per round — 256 → 128 → … → 4 — so the
+serving cost of the 3DiM reverse process drops by the same factor. The
+dominant serving cost in this repo is exactly that loop (ROADMAP item 1);
+the step-level scheduler (sample/service.py) makes the resulting 4-step
+requests first-class traffic.
+
+Discrete construction (the tables here are the repo's respaced DDPM
+tables, diffusion/schedules.py):
+
+  - the TEACHER samples on a 2S-step respaced ladder with ᾱ_t at indices
+    t = 0 … 2S−1;
+  - the STUDENT's S-step ladder is the teacher's odd indices:
+    ᾱ^s_k = ᾱ_t[2k+1] (`halved_schedule`), so student step k spans the
+    teacher pair (2k+1 → 2k → 2k−1) EXACTLY — same noise levels, same
+    logsnr conditioning (timestep_map re-indexes into the original T);
+  - the distill target inverts the student's one DDIM step analytically:
+    with z'' = two teacher steps from z_t, and (α, σ) = (√ᾱ, √(1−ᾱ)),
+        x̃ = (z'' − (σ''/σ_t) z_t) / (α'' − (σ''/σ_t) α_t)
+    (the paper's Algorithm 2 target; at k = 0, σ'' = 0 and x̃ = z'');
+  - loss = truncated-SNR-weighted x₀-space MSE:
+    w(t) = clip(SNR_t, 1, distill.snr_clip).
+
+The registry (PR 5) is the teacher/student store: `run_distill` reads
+nothing from disk itself — the CLI (`nvs3d distill`) resolves the teacher
+from a registry channel, each round's student is published as a version,
+and promotion runs the existing fixed-seed PSNR gate (registry/gate.py).
+Conditioning is dropped per-sample with train.cond_drop_prob — teacher
+and student see the SAME mask, so the student's unconditional branch is
+distilled too and CFG keeps working at serving time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from novel_view_synthesis_3d_tpu.config import Config
+from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+    DiffusionSchedule,
+    _tables_from_betas,
+    sampling_schedule,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundResult:
+    """One halving round's outcome (the JSON line `nvs3d distill` prints)."""
+
+    round_index: int
+    teacher_steps: int
+    student_steps: int
+    updates: int
+    loss_first: float
+    loss_last: float
+    seconds: float
+    version: str = ""  # registry version id when published
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def halved_schedule(teacher: DiffusionSchedule) -> DiffusionSchedule:
+    """Student schedule with half the teacher's steps.
+
+    Student step k carries the teacher's ᾱ at index 2k+1, so one student
+    DDIM step covers exactly the teacher's (2k+1 → 2k → 2k−1) pair:
+    identical noise levels at both endpoints, which is what makes the
+    distillation target exact rather than approximate. timestep_map (and
+    any exact logsnr table) re-index so the model is conditioned on the
+    same original-time logsnr it trained under.
+    """
+    n = teacher.num_timesteps
+    if n < 2 or n % 2 != 0:
+        raise ValueError(
+            f"halved_schedule needs an even teacher ladder, got {n} steps "
+            "(respacing can dedup to an odd length at tiny "
+            "diffusion.timesteps — pick start_steps so the respaced "
+            "ladder stays even)")
+    acp_t = np.asarray(teacher.alphas_cumprod, np.float64)
+    acp_s = acp_t[1::2]
+    prev = np.concatenate([[1.0], acp_s[:-1]])
+    # No 0.9999 ceiling here: a student step composes TWO teacher steps,
+    # so its β legitimately sits closer to 1 than any single-step
+    # schedule's (clipping would silently raise the noisiest student
+    # step's ᾱ and break the level-matching the target math relies on).
+    betas = np.clip(1.0 - acp_s / prev, 0.0, 1.0 - 1e-12)
+    tables = {k: jnp.asarray(v, dtype=jnp.float32)
+              for k, v in _tables_from_betas(betas).items()}
+    return DiffusionSchedule(
+        **tables,
+        logsnr_min=teacher.logsnr_min,
+        logsnr_max=teacher.logsnr_max,
+        timestep_map=jnp.asarray(np.asarray(teacher.timestep_map)[1::2],
+                                 jnp.int32),
+        num_original_timesteps=teacher.num_original_timesteps,
+        logsnr_table=teacher.logsnr_table,
+    )
+
+
+def distill_target(student: DiffusionSchedule, z_t, t_s, z_pp):
+    """Invert the student's single DDIM step: the x̃ that makes one η=0
+    student step from (z_t, t_s) land exactly on the teacher's two-step
+    result z''. Shapes: z_t/z_pp (B, H, W, 3), t_s (B,) int."""
+    def ex(table):
+        v = jnp.take(table, t_s, axis=0)
+        return v.reshape(v.shape + (1,) * (z_t.ndim - v.ndim))
+
+    alpha_t = ex(student.sqrt_alphas_cumprod)
+    sigma_t = ex(student.sqrt_one_minus_alphas_cumprod)
+    acp_prev = ex(student.alphas_cumprod_prev)
+    alpha_p = jnp.sqrt(acp_prev)
+    sigma_p = jnp.sqrt(jnp.maximum(1.0 - acp_prev, 0.0))
+    ratio = sigma_p / jnp.maximum(sigma_t, 1e-20)
+    denom = alpha_p - ratio * alpha_t
+    return (z_pp - ratio * z_t) / jnp.maximum(denom, 1e-20)
+
+
+def make_distill_step(config: Config, model,
+                      teacher_sched: DiffusionSchedule,
+                      student_sched: DiffusionSchedule,
+                      tx: optax.GradientTransformation) -> Callable:
+    """Jitted distillation update bound to one (teacher, student) ladder
+    pair: step(params, opt_state, teacher_params, batch, rng) ->
+    (params, opt_state, metrics)."""
+    dcfg = config.diffusion
+    objective = dcfg.objective
+    if objective not in ("eps", "x0", "v"):
+        raise ValueError(f"unknown objective {objective!r}")
+    snr_clip = config.distill.snr_clip
+    drop = config.train.cond_drop_prob
+    clip_denoised = dcfg.clip_denoised
+    S = student_sched.num_timesteps
+
+    def x0_from(schedule, z, t, out):
+        if objective == "eps":
+            return schedule.predict_start_from_noise(z, t, out)
+        if objective == "x0":
+            return out
+        return schedule.predict_start_from_v(z, t, out)
+
+    def teacher_ddim(teacher_params, cond, mask, z, t):
+        batch = dict(cond, z=z, logsnr=teacher_sched.logsnr(t))
+        out = model.apply({"params": teacher_params}, batch,
+                          cond_mask=mask, train=False)
+        x0 = x0_from(teacher_sched, z, t, out)
+        if clip_denoised:
+            x0 = jnp.clip(x0, -1.0, 1.0)
+        return teacher_sched.ddim_step(x0, z, t, 0.0, 0.0)
+
+    def loss_fn(params, teacher_params, batch, rng):
+        x0 = batch["target"]
+        B = x0.shape[0]
+        k_t, k_noise, k_mask, k_drop = jax.random.split(rng, 4)
+        t_s = jax.random.randint(k_t, (B,), 0, S)
+        noise = jax.random.normal(k_noise, x0.shape, dtype=x0.dtype)
+        z_t = student_sched.q_sample(x0, t_s, noise)
+        cond = {k: batch[k] for k in ("x", "R1", "t1", "R2", "t2", "K")}
+        # Teacher and student share one conditioning mask: the student's
+        # unconditional branch is distilled alongside the conditional
+        # one, so CFG still works on the few-step model.
+        mask = (jax.random.uniform(k_mask, (B,)) >= drop
+                ).astype(jnp.float32)
+        # Two deterministic teacher steps: 2t+1 → 2t → 2t−1.
+        t_hi = 2 * t_s + 1
+        z_mid = teacher_ddim(teacher_params, cond, mask, z_t, t_hi)
+        z_pp = teacher_ddim(teacher_params, cond, mask, z_mid, 2 * t_s)
+        x_target = jax.lax.stop_gradient(
+            distill_target(student_sched, z_t, t_s, z_pp))
+        # Student's one-step x̂₀ at the SAME noise level.
+        sbatch = dict(cond, z=z_t, logsnr=student_sched.logsnr(t_s))
+        out = model.apply({"params": params}, sbatch, cond_mask=mask,
+                          train=True, rngs={"dropout": k_drop})
+        x0_pred = x0_from(student_sched, z_t, t_s, out)
+        acp = jnp.take(student_sched.alphas_cumprod, t_s, axis=0)
+        snr = acp / jnp.maximum(1.0 - acp, 1e-20)
+        weight = jnp.clip(snr, 1.0, snr_clip)
+        per_sample = jnp.mean(
+            jnp.square(x_target - x0_pred).reshape(B, -1), axis=-1)
+        return jnp.mean(weight * per_sample)
+
+    @jax.jit
+    def step(params, opt_state, teacher_params, batch, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, teacher_params, batch, rng)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {
+            "loss": loss, "grad_norm": optax.global_norm(grads)}
+
+    return step
+
+
+def synthetic_batches(batch_size: int, sidelength: int,
+                      seed: int = 0) -> Iterator[dict]:
+    """Endless synthetic SRN-style batches (the no-dataset fallback —
+    still a valid teacher→student comparator: both see the same pairs)."""
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+
+    i = 0
+    while True:
+        yield make_example_batch(batch_size=batch_size,
+                                 sidelength=sidelength, seed=seed + i)
+        i += 1
+
+
+def run_distill(config: Config, model, teacher_params, *,
+                data_iter: Optional[Iterator[dict]] = None,
+                store=None, publish_channel: str = "distill",
+                base_step: int = 0,
+                event_cb: Optional[Callable] = None,
+                log: Callable[[str], None] = print) -> List[RoundResult]:
+    """Teacher→student halving rounds per config.distill.
+
+    Returns one RoundResult per round; the final round's student is the
+    few-step model. With `store` (a registry.RegistryStore) each round's
+    student is PUBLISHED as a version on `publish_channel` — promotion
+    through the PSNR gate stays an explicit operator step
+    (`nvs3d registry promote` / `nvs3d distill --promote-channel`).
+    """
+    dl = config.distill
+    if dl.start_steps > config.diffusion.timesteps:
+        raise ValueError(
+            f"distill.start_steps={dl.start_steps} exceeds "
+            f"diffusion.timesteps={config.diffusion.timesteps}")
+    if data_iter is None:
+        data_iter = synthetic_batches(dl.batch_size,
+                                      config.data.img_sidelength, dl.seed)
+    tx = optax.adam(dl.lr)
+    rng = jax.random.PRNGKey(dl.seed)
+    params = teacher_params
+    results: List[RoundResult] = []
+    cur = dl.start_steps
+    r = 0
+    while cur > dl.target_steps:
+        t_round = time.perf_counter()
+        teacher_sched = sampling_schedule(config.diffusion, cur)
+        student_sched = halved_schedule(teacher_sched)
+        student_steps = student_sched.num_timesteps
+        # Student initialized FROM the teacher (the paper's warm start).
+        teacher = params
+        student = jax.tree.map(jnp.asarray, teacher)
+        opt_state = tx.init(student)
+        step = make_distill_step(config, model, teacher_sched,
+                                 student_sched, tx)
+        loss_first = loss_last = float("nan")
+        for i in range(dl.steps_per_round):
+            rng, k = jax.random.split(rng)
+            batch = next(data_iter)
+            device_batch = {k2: jnp.asarray(v) for k2, v in batch.items()
+                            if k2 in ("x", "target", "R1", "t1", "R2",
+                                      "t2", "K")}
+            student, opt_state, metrics = step(
+                student, opt_state, teacher, device_batch, k)
+            if i == 0:
+                loss_first = float(jax.device_get(metrics["loss"]))
+        loss_last = float(jax.device_get(metrics["loss"]))
+        if not np.isfinite(loss_last):
+            raise FloatingPointError(
+                f"distill round {r} ({cur}→{student_steps} steps) "
+                f"diverged: loss={loss_last}")
+        version = ""
+        if store is not None:
+            host = jax.tree.map(np.asarray, jax.device_get(student))
+            m = store.publish_params(
+                host, step=base_step, ema=False,
+                channel=publish_channel,
+                notes=(f"progressive distillation round {r}: "
+                       f"{cur}→{student_steps} steps "
+                       f"(loss {loss_first:.4g}→{loss_last:.4g})"))
+            version = m.version
+            if event_cb is not None:
+                event_cb(base_step, "distill_publish",
+                         f"round {r}: {cur}→{student_steps} steps -> "
+                         f"{version} (channel {publish_channel})", version)
+        res = RoundResult(
+            round_index=r, teacher_steps=cur, student_steps=student_steps,
+            updates=dl.steps_per_round, loss_first=loss_first,
+            loss_last=loss_last,
+            seconds=round(time.perf_counter() - t_round, 3),
+            version=version)
+        results.append(res)
+        log(f"distill round {r}: {cur} -> {student_steps} steps, "
+            f"loss {loss_first:.4g} -> {loss_last:.4g} "
+            f"({res.seconds:.1f}s)"
+            + (f", published {version}" if version else ""))
+        params = student
+        cur = student_steps
+        r += 1
+    return results

@@ -60,21 +60,47 @@ def test_bench_data_python_backend():
     assert result["value"] > 0
 
 
-def test_bench_hard_fails_without_backend_instead_of_cpu_fallback():
-    """BENCH r1/r2 postmortem contract: an unreachable accelerator must
-    produce rc=3 and never a CPU number labeled as the device bench. The
-    failure now comes WITH a structured {"rc": 3, "reason": ...} object
-    (value/platform null) so BENCH_r0*.json archives record WHY a round
-    produced no number instead of a bare "parsed": null. The probe child
-    is pointed at a platform name that cannot initialize, with a tiny
-    retry budget."""
+def test_bench_falls_back_to_labeled_cpu_lane():
+    """ROADMAP item 5a contract (supersedes the rc=3 refusal that left
+    BENCH_r03-r05 with no parsed datapoint): an unreachable accelerator
+    drops the bench to an EXPLICITLY LABELED CPU tier — rc=0, a parsed
+    non-null value, platform/lane='cpu', and its own baseline file so
+    the number can never be confused with a device-lane one. The probe
+    child is pointed at a platform name that cannot initialize, with a
+    tiny retry budget."""
     env = dict(os.environ,
                # A platform name no host provides: backend init fails
                # everywhere, including real TPU VMs (JAX_PLATFORMS="tpu"
                # there would run a REAL device bench and fail the test).
                JAX_PLATFORMS="nonexistent_backend",
+               NVS3D_PROBE_BUDGET_S="8", NVS3D_PROBE_TRY_S="4",
+               JAX_COMPILATION_CACHE_DIR="/tmp/nvs3d_jax_cache")
+    env.pop("NVS3D_BENCH_REQUIRE_DEVICE", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "tiny64", "1"] + TINY + ["train.steps_per_dispatch=1"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO_ROOT)
+    assert out.returncode == 0, (out.returncode, out.stderr[-2000:])
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, lines
+    result = json.loads(lines[0])
+    assert result["metric"] == "train_imgs_per_sec_per_chip_tiny64"
+    assert result["value"] is not None and result["value"] > 0
+    assert result["platform"] == "cpu"
+    assert result["lane"] == "cpu"  # loud label, never a disguised number
+    assert result["baseline_file"] == "BASELINE_CPU.json"
+    assert "lane_reason" in result
+    assert "CPU benchmark lane" in out.stderr
+
+
+def test_bench_require_device_still_hard_fails():
+    """NVS3D_BENCH_REQUIRE_DEVICE=1 restores the PR 2 refusal: rc=3 with
+    the structured {"rc": 3, "reason": ...} object (value/platform null)
+    for rounds that must not produce a CPU number."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="nonexistent_backend",
+               NVS3D_BENCH_REQUIRE_DEVICE="1",
                NVS3D_PROBE_BUDGET_S="8", NVS3D_PROBE_TRY_S="4")
-    env.pop("NVS3D_BENCH_ALLOW_CPU", None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
          "tiny64", "1"] + TINY,

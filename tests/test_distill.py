@@ -1,0 +1,228 @@
+"""Progressive distillation (train/distill.py): the halved-schedule
+construction (student step k spans exactly the teacher pair 2k+1→2k−1),
+the analytic DDIM-inversion distillation target, a CPU-sized round-trip —
+distill rounds off a toy registry teacher, students published as
+versions, the final few-step student promoted through the existing PSNR
+gate — and serving the student at its distilled step count."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import (
+    Config,
+    DiffusionConfig,
+    DistillConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+    sampling_schedule)
+from novel_view_synthesis_3d_tpu.train.distill import (
+    RoundResult,
+    distill_target,
+    halved_schedule,
+    run_distill,
+    synthetic_batches,
+)
+
+pytestmark = pytest.mark.smoke
+
+TINY = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                   attn_resolutions=(8,), dropout=0.0)
+S = 16
+
+
+def test_halved_schedule_spans_teacher_pairs():
+    dcfg = DiffusionConfig(timesteps=64, sample_timesteps=64)
+    teacher = sampling_schedule(dcfg, 8)
+    student = halved_schedule(teacher)
+    assert student.num_timesteps == 4
+    # Student ᾱ_k = teacher ᾱ_{2k+1}: identical noise levels at every
+    # student step boundary (the construction the target math relies on).
+    np.testing.assert_allclose(
+        np.asarray(student.alphas_cumprod),
+        np.asarray(teacher.alphas_cumprod)[1::2], rtol=2e-6)
+    np.testing.assert_allclose(
+        np.asarray(student.alphas_cumprod_prev),
+        np.concatenate([[1.0],
+                        np.asarray(teacher.alphas_cumprod)[1::2][:-1]]),
+        rtol=2e-6)
+    # logsnr conditioning re-indexes into ORIGINAL time.
+    np.testing.assert_array_equal(
+        np.asarray(student.timestep_map),
+        np.asarray(teacher.timestep_map)[1::2])
+    # Odd ladders are refused loudly, not mis-paired.
+    with pytest.raises(ValueError, match="even"):
+        halved_schedule(sampling_schedule(dcfg, 5))
+
+
+def test_distill_target_inverts_student_ddim_step():
+    """distill_target is the exact algebraic inverse of one η=0 student
+    DDIM step: feeding the step's output back recovers the x̃ that
+    produced it — including the final step (t=0, σ''=0)."""
+    dcfg = DiffusionConfig(timesteps=64, sample_timesteps=64)
+    student = halved_schedule(sampling_schedule(dcfg, 8))
+    rng = np.random.default_rng(0)
+    B = student.num_timesteps  # one row per ladder position, incl. t=0
+    x_tilde = jnp.asarray(rng.standard_normal((B, 4, 4, 3)), jnp.float32)
+    z_t = jnp.asarray(rng.standard_normal((B, 4, 4, 3)), jnp.float32)
+    t_s = jnp.arange(B)
+    z_pp = student.ddim_step(x_tilde, z_t, t_s, 0.0, 0.0)
+    x_rec = distill_target(student, z_t, t_s, z_pp)
+    np.testing.assert_allclose(np.asarray(x_rec), np.asarray(x_tilde),
+                               rtol=2e-4, atol=2e-4)
+
+
+def toy_config(**distill_kw):
+    kw = dict(start_steps=4, target_steps=2, steps_per_round=2,
+              batch_size=2, lr=1e-4)
+    kw.update(distill_kw)
+    return Config(
+        model=TINY,
+        diffusion=DiffusionConfig(timesteps=16, sample_timesteps=16),
+        distill=DistillConfig(**kw),
+    ).override(**{"data.img_sidelength": S}).validate()
+
+
+def test_distill_config_validation():
+    with pytest.raises(ValueError, match="power of two"):
+        Config(distill=DistillConfig(start_steps=24,
+                                     target_steps=4)).validate()
+    with pytest.raises(ValueError, match="snr_clip"):
+        Config(distill=DistillConfig(snr_clip=0.5)).validate()
+    with pytest.raises(ValueError, match="steps_per_round"):
+        Config(distill=DistillConfig(steps_per_round=0)).validate()
+    # start_steps > timesteps is a point-of-use error, not a validate()
+    # one (tiny-timesteps configs that never distill must stay valid)...
+    cfg = Config(diffusion=DiffusionConfig(timesteps=8,
+                                           sample_timesteps=8),
+                 distill=DistillConfig(start_steps=256)).validate()
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    with pytest.raises(ValueError, match="start_steps"):
+        run_distill(cfg, XUNet(TINY), {})
+
+
+def test_distill_roundtrip_publish_gate_promote_serve(tmp_path):
+    """The acceptance path on a CPU toy model: registry teacher →
+    distill round (4→2 steps) → student published as a version → the
+    existing fixed-seed PSNR gate promotes it → the sampling service
+    serves it at its distilled step count."""
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.registry import (
+        RegistryStore, make_psnr_probe, promote, run_gate)
+    from novel_view_synthesis_3d_tpu.sample.service import (
+        SamplingService, request_cond_from_batch)
+
+    cfg = toy_config()
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=2, sidelength=S, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((2,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    teacher = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((2,)), train=False)["params"]
+    store = RegistryStore(str(tmp_path / "registry"))
+    mt = store.publish_params(jax.tree.map(np.asarray, teacher),
+                              step=100, ema=False, channel="stable")
+
+    events = []
+    results = run_distill(
+        cfg, model, store.load_params(mt.version),
+        data_iter=synthetic_batches(2, S, seed=3),
+        store=store, publish_channel="distill", base_step=mt.step,
+        event_cb=lambda s, kind, d, v: events.append(kind),
+        log=lambda *_: None)
+    assert len(results) == 1
+    r = results[0]
+    assert isinstance(r, RoundResult)
+    assert (r.teacher_steps, r.student_steps) == (4, 2)
+    assert np.isfinite(r.loss_first) and np.isfinite(r.loss_last)
+    assert r.version and store.read_channel("distill") == r.version
+    assert events == ["distill_publish"]
+
+    # Promote the student through the EXISTING gate, probed at the
+    # student's serving step count (bootstrap on a fresh channel).
+    probe = make_psnr_probe(
+        model, cfg.diffusion,
+        make_example_batch(batch_size=2, sidelength=S, seed=9),
+        sample_steps=r.student_steps, seed=0)
+    gate = run_gate(store, r.version, channel="fewstep", probe_fn=probe,
+                    margin_db=cfg.registry.gate_margin_db)
+    assert gate.passed and np.isfinite(gate.candidate_psnr)
+    promote(store, r.version, channel="fewstep", gate=gate)
+    assert store.read_channel("fewstep") == r.version
+
+    # Serve the promoted few-step student through the stepper.
+    student = store.load_params(r.version)
+    svc = SamplingService(
+        model, student, cfg.diffusion,
+        ServeConfig(scheduler="step", max_batch=2, flush_timeout_ms=10.0,
+                    queue_depth=8),
+        results_folder=str(tmp_path / "serve"), model_version=r.version)
+    try:
+        cond = request_cond_from_batch(mb, 0)
+        t = svc.submit(cond, seed=1, sample_steps=r.student_steps)
+        img = t.result(timeout=300)
+        assert img.shape == (S, S, 3) and np.isfinite(img).all()
+        assert t.timing["steps"] == r.student_steps
+        assert t.model_version == r.version
+    finally:
+        svc.stop()
+
+    # The distilled weights actually moved (a student that is still the
+    # teacher byte-for-byte would mean the round trained nothing).
+    moved = any(
+        not np.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(store.load_params(mt.version)),
+                        jax.tree.leaves(student)))
+    assert moved
+
+
+def test_distill_cli_roundtrip(tmp_path):
+    """`nvs3d distill` end to end in-process: registry teacher in,
+    published + gate-promoted few-step student out (rc=0)."""
+    from novel_view_synthesis_3d_tpu import cli
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.registry import RegistryStore
+
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=2, sidelength=S, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((2,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    teacher = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((2,)), train=False)["params"]
+    reg = str(tmp_path / "registry")
+    store = RegistryStore(reg)
+    store.publish_params(jax.tree.map(np.asarray, teacher), step=7,
+                         ema=False, channel="stable")
+    rc = cli.main([
+        "distill", "--registry", reg, "--teacher-channel", "stable",
+        "--promote-channel", "fewstep",
+    ] + [f"model.{k}={v!r}".replace("'", '"') if isinstance(v, str)
+         else f"model.{k}={list(v) if isinstance(v, tuple) else v}"
+         for k, v in dataclasses.asdict(TINY).items()
+         if k in ("ch", "ch_mult", "emb_ch", "num_res_blocks",
+                  "attn_resolutions")]
+      + ["model.dropout=0.0", "data.img_sidelength=16",
+         "diffusion.timesteps=16", "diffusion.sample_timesteps=16",
+         "distill.start_steps=4", "distill.target_steps=2",
+         "distill.steps_per_round=1", "distill.batch_size=2"])
+    assert rc == 0
+    few = store.read_channel("fewstep")
+    assert few is not None
+    assert "distillation round 0" in store.manifest(few).notes

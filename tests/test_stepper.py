@@ -1,0 +1,335 @@
+"""Step-level continuous batching (sample/service.py scheduler='step'):
+ring-composition invariance (bit-identical images solo vs interleaved,
+incl. mesh-sharded dispatch), heterogeneous step counts/guidance in one
+batch with ZERO recompiles (the program-cache key carries bucket/shape
+only — t, steps_remaining and w are device arguments), short requests
+finishing ahead of long ones (no head-of-line blocking), drain-on-swap
+version pinning, and deadline/backpressure semantics preserved."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import (
+    Config,
+    DiffusionConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+from novel_view_synthesis_3d_tpu.sample.service import (
+    DeadlineExceeded,
+    Rejected,
+    SamplingService,
+    request_cond_from_batch,
+)
+from novel_view_synthesis_3d_tpu.sample.stepper import ScheduleBank
+
+pytestmark = pytest.mark.smoke
+
+TINY = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                   attn_resolutions=(8,), dropout=0.0)
+T = 8  # training timesteps: leaves room for 2/4/8-step serving ladders
+S = 16
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T)
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=8, sidelength=S, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((8,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((8,)), train=False)["params"]
+    conds = [request_cond_from_batch(mb, i) for i in range(8)]
+    return model, params, dcfg, conds
+
+
+def make_service(setup, tmp, **serve_kw):
+    model, params, dcfg, _ = setup
+    kw = dict(scheduler="step", max_batch=4, flush_timeout_ms=30.0,
+              queue_depth=32)
+    kw.update(serve_kw)
+    return SamplingService(model, params, dcfg, ServeConfig(**kw),
+                          results_folder=str(tmp))
+
+
+@pytest.fixture(scope="module")
+def service(setup, tmp_path_factory):
+    svc = make_service(setup, tmp_path_factory.mktemp("stepper_events"))
+    yield svc
+    svc.stop()
+
+
+def solo_img(service, cond, *, seed, steps):
+    """Reference image: the request running ALONE through the ring."""
+    # Wait until the service is idle so nothing co-rides.
+    t = service.submit(cond, seed=seed, sample_steps=steps)
+    return t.result(timeout=300)
+
+
+def test_schedule_bank_matches_device_tables(setup):
+    _, _, dcfg, _ = setup
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        sampling_schedule)
+
+    bank = ScheduleBank(dcfg).get(4)
+    sched = sampling_schedule(dcfg, 4)
+    assert bank.n == sched.num_timesteps
+    np.testing.assert_array_equal(
+        bank.coefs["acp"], np.asarray(sched.alphas_cumprod))
+    np.testing.assert_array_equal(
+        bank.coefs["logsnr"],
+        np.asarray(sched.logsnr(jnp.arange(bank.n))))
+    assert bank.coefs["nonzero"][0] == 0.0
+    assert (bank.coefs["nonzero"][1:] == 1.0).all()
+    # Bank cache: one build per step count.
+    banks = ScheduleBank(dcfg)
+    assert banks.get(4) is banks.get(4)
+
+
+def test_ring_composition_invariance_bit_identical(service, setup):
+    """A request's image is BIT-IDENTICAL whether it ran solo or
+    interleaved with co-riders of different step counts joining and
+    leaving mid-flight — the per-row key threading + per-row schedule
+    coefficients make ring rows fully independent."""
+    _, _, _, conds = setup
+    a_solo = solo_img(service, conds[0], seed=11, steps=T)
+    b_solo = solo_img(service, conds[1], seed=22, steps=2)
+    c_solo = solo_img(service, conds[2], seed=33, steps=4)
+
+    before = service.stats.span_summary("ring_step").get("count", 0)
+    a = service.submit(conds[0], seed=11, sample_steps=T)
+    # Wait for A to take at least one ring step, then inject co-riders
+    # MID-FLIGHT (they must join between steps, not at a batch boundary).
+    deadline = time.monotonic() + 60
+    while (service.stats.span_summary("ring_step").get("count", 0)
+           <= before and time.monotonic() < deadline):
+        time.sleep(0.002)
+    b = service.submit(conds[1], seed=22, sample_steps=2)
+    c = service.submit(conds[2], seed=33, sample_steps=4)
+    imgs = {t: t.result(timeout=300) for t in (a, b, c)}
+
+    np.testing.assert_array_equal(imgs[a], a_solo)
+    np.testing.assert_array_equal(imgs[b], b_solo)
+    np.testing.assert_array_equal(imgs[c], c_solo)
+    # The co-riders really joined A's ring mid-flight (their first step
+    # ran at batch >= 2), and the short request was not head-of-line
+    # blocked: B (2 steps) resolved before A (8 steps).
+    assert imgs[b] is not None and b.timing["batch_n"] >= 2
+    assert b.timing["steps"] == 2 and a.timing["steps"] == T
+    assert a.done() and b.done()
+
+
+def test_short_request_not_blocked_behind_long(service, setup):
+    """The continuous-batching acceptance property: a 2-step request
+    submitted AFTER an 8-step one completes first."""
+    _, _, _, conds = setup
+    done_order = []
+    a = service.submit(conds[3], seed=44, sample_steps=T)
+    b = service.submit(conds[4], seed=55, sample_steps=2)
+    import threading
+
+    def wait(name, t):
+        t.result(timeout=300)
+        done_order.append(name)
+
+    threads = [threading.Thread(target=wait, args=("a", a)),
+               threading.Thread(target=wait, args=("b", b))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert done_order[0] == "b", done_order
+    # And the long request still finished with its full ladder.
+    assert a.timing["steps"] == T
+
+
+def test_mixed_steps_and_guidance_zero_recompiles(service, setup):
+    """The cache-key satellite: after the buckets are warm, traffic with
+    DIFFERENT step counts and guidance weights compiles NOTHING — the
+    stepper program is keyed on bucket/shape only."""
+    _, _, _, conds = setup
+    # Warm buckets 1, 2, 4 (whatever traffic above left cold).
+    seed = 700
+    for b in (1, 2, 4):
+        tickets = [service.submit(conds[j], seed=seed + j, sample_steps=T)
+                   for j in range(b)]
+        seed += b
+        for t in tickets:
+            t.result(timeout=300)
+    before = service.compile_counters()
+    assert before["programs_built"] == 3  # one per bucket, nothing else
+    # Mixed 2/4/8-step sweep at varied guidance, across all buckets.
+    groups = [[(2, 0.0)], [(T, 3.0), (2, 1.5)],
+              [(4, 3.0), (2, 0.0), (T, 7.0)], [(T, 3.0)]]
+    seed = 800
+    for group in groups:
+        tickets = [
+            service.submit(conds[(seed + j) % len(conds)], seed=seed + j,
+                           sample_steps=st, guidance_weight=w)
+            for j, (st, w) in enumerate(group)]
+        seed += len(group)
+        for t in tickets:
+            t.result(timeout=300)
+    after = service.compile_counters()
+    assert after["programs_built"] == before["programs_built"]
+    assert after["jit_cache_entries"] == before["jit_cache_entries"]
+    assert after["cache_hits"] > before["cache_hits"]
+
+
+def test_mesh_sharded_ring_matches_solo(setup, tmp_path):
+    """Ring invariance holds across the 8-device mesh: a full sharded
+    bucket reproduces every solo image, and a ragged ring (mid-flight
+    join to batch 3) still serves via replicated dispatch."""
+    from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+
+    model, params, dcfg, conds = setup
+    mesh = mesh_lib.make_mesh()
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=8, flush_timeout_ms=200.0,
+                    queue_depth=32),
+        mesh=mesh, results_folder=str(tmp_path))
+    try:
+        seeds = list(range(60, 68))
+        tickets = [svc.submit(conds[i], seed=seeds[i], sample_steps=4)
+                   for i in range(8)]
+        imgs = [t.result(timeout=600) for t in tickets]
+        assert tickets[0].timing["bucket"] == 8
+        # Solo references (bucket 1, replicated dispatch on the mesh).
+        # Mesh programs (sharded or replicated) reorder float ops at the
+        # ~1 ulp level between bucket shapes, so mesh comparisons use the
+        # same 1e-5 tolerance as the PR 3 mesh tests; the single-device
+        # tests above assert BIT-identity.
+        for i in (0, 3, 7):
+            ref = svc.submit(conds[i], seed=seeds[i],
+                             sample_steps=4).result(timeout=600)
+            np.testing.assert_allclose(imgs[i], ref, rtol=1e-5, atol=1e-5)
+        # Heterogeneous mid-flight join on the mesh: 8-step + late 2-step.
+        before = svc.stats.span_summary("ring_step").get("count", 0)
+        a = svc.submit(conds[0], seed=90, sample_steps=T)
+        deadline = time.monotonic() + 60
+        while (svc.stats.span_summary("ring_step").get("count", 0)
+               <= before and time.monotonic() < deadline):
+            time.sleep(0.002)
+        b = svc.submit(conds[1], seed=91, sample_steps=2)
+        img_a, img_b = a.result(timeout=600), b.result(timeout=600)
+        ref_a = svc.submit(conds[0], seed=90,
+                           sample_steps=T).result(timeout=600)
+        ref_b = svc.submit(conds[1], seed=91,
+                           sample_steps=2).result(timeout=600)
+        np.testing.assert_allclose(img_a, ref_a, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(img_b, ref_b, rtol=1e-5, atol=1e-5)
+    finally:
+        svc.stop()
+
+
+def test_swap_drains_ring_and_pins_versions(setup, tmp_path):
+    """A hot swap staged while requests are in flight waits for the ring
+    to drain: in-flight requests finish (and attribute) on their start
+    version, queued arrivals ride the new one."""
+    model, params, dcfg, conds = setup
+    params_v2 = jax.tree.map(lambda p: np.asarray(p) * 1.05,
+                             jax.device_get(params))
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=4, flush_timeout_ms=10.0,
+                    queue_depth=32),
+        results_folder=str(tmp_path), model_version="v1")
+    try:
+        ref_v1 = svc.submit(conds[0], seed=7,
+                            sample_steps=T).result(timeout=300)
+        before = svc.stats.span_summary("ring_step").get("count", 0)
+        a = svc.submit(conds[0], seed=7, sample_steps=T)
+        deadline = time.monotonic() + 60
+        while (svc.stats.span_summary("ring_step").get("count", 0)
+               <= before and time.monotonic() < deadline):
+            time.sleep(0.002)
+        applied = svc.swap_params(params_v2, "v2", step=2)
+        b = svc.submit(conds[1], seed=8, sample_steps=2)
+        img_a = a.result(timeout=300)
+        img_b = b.result(timeout=300)
+        assert applied.wait(60)
+        assert a.model_version == "v1"
+        assert b.model_version == "v2"
+        np.testing.assert_array_equal(img_a, ref_v1)
+        # And v2 requests reproduce v2 solo images.
+        ref_v2 = svc.submit(conds[1], seed=8,
+                            sample_steps=2).result(timeout=300)
+        np.testing.assert_array_equal(img_b, ref_v2)
+        assert svc.model_version == "v2"
+    finally:
+        svc.stop()
+
+
+def test_deadline_and_backpressure_preserved(setup, tmp_path):
+    """PR 3 service semantics survive the scheduler swap: queue-depth
+    backpressure rejects with a reason, and a request whose queue wait
+    blew its deadline expires at admission instead of burning steps."""
+    model, params, dcfg, conds = setup
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=8,
+                    flush_timeout_ms=5000.0, queue_depth=2),
+        results_folder=str(tmp_path))
+    try:
+        svc.submit(conds[0], seed=1)
+        svc.submit(conds[1], seed=2)
+        with pytest.raises(Rejected, match="queue full"):
+            svc.submit(conds[2], seed=3)
+        events = (tmp_path / "events.csv").read_text()
+        assert "reject" in events and "queue full" in events
+    finally:
+        svc.stop()
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=8,
+                    flush_timeout_ms=300.0, queue_depth=8),
+        results_folder=str(tmp_path))
+    try:
+        ticket = svc.submit(conds[0], seed=1, deadline_ms=1.0)
+        with pytest.raises(DeadlineExceeded):
+            ticket.result(timeout=300)
+        events = (tmp_path / "events.csv").read_text()
+        assert "deadline" in events
+        # Bad step counts are rejected at submit, not mid-ring.
+        with pytest.raises(Rejected, match="sample_steps"):
+            svc.submit(conds[0], seed=1, sample_steps=T + 1)
+    finally:
+        svc.stop()
+
+
+def test_scheduler_config_validation():
+    with pytest.raises(ValueError, match="scheduler"):
+        Config(serve=ServeConfig(scheduler="warp")).validate()
+    Config(serve=ServeConfig(scheduler="request")).validate()
+    Config(serve=ServeConfig(scheduler="step")).validate()
+
+
+def test_request_scheduler_still_available(setup, tmp_path):
+    """The PR 3 whole-request dispatcher stays selectable (serve_bench
+    baseline; exact dpm++ 2M serving)."""
+    model, params, dcfg, conds = setup
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="request", max_batch=4,
+                    flush_timeout_ms=20.0, queue_depth=8),
+        results_folder=str(tmp_path))
+    try:
+        t = svc.submit(conds[0], seed=5, sample_steps=2)
+        img = t.result(timeout=300)
+        assert img.shape == (S, S, 3) and np.isfinite(img).all()
+    finally:
+        svc.stop()

@@ -74,7 +74,13 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 
-def build(preset: str, sidelength: int, steps: int):
+def get_default_timesteps(preset: str) -> int:
+    from novel_view_synthesis_3d_tpu.config import get_preset
+
+    return get_preset(preset).diffusion.timesteps
+
+
+def build(preset: str, sidelength: int, steps: int, extra_overrides=()):
     from novel_view_synthesis_3d_tpu.config import get_preset
     from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
     from novel_view_synthesis_3d_tpu.models.xunet import XUNet
@@ -82,7 +88,10 @@ def build(preset: str, sidelength: int, steps: int):
     cfg = get_preset(preset).override(**{
         "data.img_sidelength": sidelength,
         "diffusion.sample_timesteps": steps,
-    }).validate()
+    })
+    if extra_overrides:
+        cfg = cfg.override(**dict(extra_overrides))
+    cfg = cfg.validate()
     model = XUNet(cfg.model)
     batch = make_example_batch(batch_size=8, sidelength=sidelength, seed=0)
     mb = {
@@ -200,6 +209,312 @@ def _p99(latencies) -> float:
         return 0.0
     vals = sorted(latencies)
     return vals[min(len(vals) - 1, int(round(0.99 * (len(vals) - 1))))]
+
+
+def _pctl(latencies, q: float) -> float:
+    if not latencies:
+        return 0.0
+    vals = sorted(latencies)
+    return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+
+# ---------------------------------------------------------------------------
+# --continuous: step-level continuous batching under mixed Poisson traffic
+# ---------------------------------------------------------------------------
+def parse_class_map(spec: str, what: str) -> dict:
+    """'4:0.8,64:0.12,256:0.08' -> {4: 0.8, 64: 0.12, 256: 0.08}."""
+    out = {}
+    for part in spec.split(","):
+        try:
+            k, v = part.split(":")
+            out[int(k)] = float(v)
+        except ValueError:
+            raise SystemExit(f"bad {what} entry {part!r} "
+                             "(want steps:value[,steps:value...])")
+    if not out:
+        raise SystemExit(f"empty {what}")
+    return out
+
+
+def poisson_trace(n: int, rate: float, mix: dict, slo_ms: dict,
+                  seed: int) -> list:
+    """Deterministic Poisson arrival trace with per-request step class."""
+    import numpy as _np
+
+    rng = _np.random.default_rng(seed)
+    classes = sorted(mix)
+    probs = _np.asarray([mix[c] for c in classes], float)
+    probs = probs / probs.sum()
+    t = 0.0
+    trace = []
+    for i in range(n):
+        t += float(rng.exponential(1.0 / rate))
+        c = int(rng.choice(classes, p=probs))
+        trace.append({"at": t, "steps": c,
+                      "slo_ms": float(slo_ms.get(c, 0.0)),
+                      "seed": 100_000 + i})
+    return trace
+
+
+def replay_trace(service, conds, trace, *, teacher_steps=None,
+                 use_deadlines=True) -> tuple:
+    """Open-loop replay of `trace` against a live service.
+
+    Each request is submitted at its arrival offset (never gated on
+    earlier completions — real traffic does not politely wait) and a
+    waiter thread records its outcome: ok / late (served past its SLO) /
+    expired (deadline reject) / rejected (backpressure) / failed.
+    `teacher_steps` overrides every request's step count (the PR 3
+    pre-distillation deployment: no students, everything runs the
+    teacher ladder). Returns (records, window_s) with window measured
+    from first submit to last completion."""
+    from novel_view_synthesis_3d_tpu.sample.service import Rejected
+
+    records = []
+    threads = []
+    t0 = time.perf_counter()
+
+    def waiter(ticket, rec, t_submit, slo_s):
+        from novel_view_synthesis_3d_tpu.sample.service import (
+            DeadlineExceeded)
+
+        try:
+            ticket.result(timeout=600)
+        except DeadlineExceeded:
+            rec["status"] = "expired"
+            return
+        except Exception:
+            rec["status"] = "failed"
+            return
+        lat = time.perf_counter() - t_submit
+        rec["latency_s"] = lat
+        rec["status"] = "ok" if (not slo_s or lat <= slo_s) else "late"
+
+    for i, req in enumerate(trace):
+        delay = t0 + req["at"] - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        steps = teacher_steps or req["steps"]
+        slo_s = (req["slo_ms"] / 1000.0) if req["slo_ms"] else 0.0
+        rec = {"class": req["steps"], "steps": steps, "status": "pending"}
+        records.append(rec)
+        try:
+            ticket = service.submit(
+                conds[i % len(conds)], seed=req["seed"],
+                sample_steps=steps,
+                deadline_ms=req["slo_ms"] if (use_deadlines
+                                              and req["slo_ms"]) else None)
+        except Rejected:
+            rec["status"] = "rejected"
+            continue
+        th = threading.Thread(
+            target=waiter, args=(ticket, rec, time.perf_counter(), slo_s))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=600)
+    return records, time.perf_counter() - t0
+
+
+def summarize_replay(records, window_s: float) -> dict:
+    """Per-step-class latency/outcome table + RPS over the replay
+    window. 'rps_goodput' counts only within-SLO completions — the
+    serving metric that punishes head-of-line blocking; 'rps_served'
+    counts everything that completed."""
+    classes = {}
+    for rec in records:
+        c = classes.setdefault(rec["class"], {"n": 0, "ok": 0, "late": 0,
+                                              "expired": 0, "rejected": 0,
+                                              "failed": 0, "lat": []})
+        c["n"] += 1
+        c[rec["status"]] = c.get(rec["status"], 0) + 1
+        if "latency_s" in rec:
+            c["lat"].append(rec["latency_s"])
+    out_classes = {}
+    for cls, c in sorted(classes.items()):
+        lat = c.pop("lat")
+        out_classes[str(cls)] = dict(
+            c, p50_s=round(_pctl(lat, 0.5), 4),
+            p99_s=round(_pctl(lat, 0.99), 4))
+    ok = sum(1 for r in records if r["status"] == "ok")
+    served = ok + sum(1 for r in records if r["status"] == "late")
+    return {
+        "window_s": round(window_s, 3),
+        "rps_served": round(served / window_s, 4) if window_s else 0.0,
+        "rps_goodput": round(ok / window_s, 4) if window_s else 0.0,
+        "classes": out_classes,
+    }
+
+
+def continuous_bench(model, params, cfg, conds, args) -> dict:
+    """The judged --continuous scenario (docs/DESIGN.md "Continuous
+    batching & distillation").
+
+    One deterministic Poisson trace with mixed step classes (the
+    post-distillation workload: mostly few-step requests, a tail of
+    teacher-ladder ones) runs through:
+
+      1. the STEPPER (serve.scheduler='step') — the headline. After a
+         few-step-only warmup, the mixed trace must compile NOTHING
+         (programs are keyed on bucket/shape; steps/t/w are device
+         arguments) — asserted, rc=1 on violation.
+      2. the PR 3 whole-request dispatcher on the SAME trace
+         ('scheduler_ab'): isolates scheduling — head-of-line blocking
+         shows up as expired/late few-step requests and
+         per-(steps,bucket) program builds (the old cache key) as
+         mid-run stalls.
+      3. the PR 3 DEPLOYMENT baseline ('pr3_teacher_steps'): whole-
+         request dispatch with every request at the teacher's step
+         count — before progressive distillation there were no few-step
+         students to serve, so this is what the PR 3 service actually
+         shipped for this demand. Capacity-bound, measured over a
+         truncated prefix of the trace (no deadlines — in its favor).
+
+    The headline vs_baseline is (1) vs (3) on SERVED RPS: few-step
+    serving = distillation × step-level scheduling, the two halves of
+    this PR. The (1) vs (2) ratio is reported alongside as the
+    scheduler-only delta on within-SLO goodput — on a 1-core CPU host
+    batching is throughput-neutral, so most of that delta is SLO
+    attainment, not raw rate; on accelerators with batch headroom both
+    multiply.
+
+    The arrival rate auto-calibrates to the measured per-row step cost
+    (default --cont-rate 0: target ~85% of the host's solo row-step
+    capacity) so the scenario stays in the same operating regime on any
+    machine; an explicit --cont-rate pins it. 85% loads the stepper at
+    the knee — an arrival-bound run (the earlier 60% target) measures
+    the TRACE's rate, not the scheduler's, and understates the win; the
+    solo-calibrated capacity is itself conservative (bigger buckets
+    amortize per-dispatch overhead), so the knee is not overload.
+    """
+    from novel_view_synthesis_3d_tpu.config import ServeConfig
+    from novel_view_synthesis_3d_tpu.sample.service import SamplingService
+
+    mix = parse_class_map(args.cont_mix, "--cont-mix")
+    slo = parse_class_map(args.cont_slo_ms, "--cont-slo-ms")
+    max_batch = args.cont_max_batch
+    buckets = []
+    b = 1
+    while b <= max_batch:
+        buckets.append(b)
+        b *= 2
+
+    def make_service(scheduler: str) -> SamplingService:
+        return SamplingService(
+            model, params, cfg.diffusion,
+            ServeConfig(scheduler=scheduler, max_batch=max_batch,
+                        flush_timeout_ms=args.flush_timeout_ms,
+                        queue_depth=max(64, 2 * args.cont_requests),
+                        results_folder="/tmp/nvs3d_serve_bench"),
+            results_folder="/tmp/nvs3d_serve_bench")
+
+    few = min(mix)  # the distilled few-step class warms the buckets
+    probs = {c: p / sum(mix.values()) for c, p in mix.items()}
+    mean_steps = sum(c * p for c, p in probs.items())
+
+    # --- 1. stepper on the mixed trace -------------------------------
+    svc = make_service("step")
+    try:
+        seed = 90_000
+        for b in buckets:  # warm with the FEW-STEP class only
+            tickets = [svc.submit(conds[j % len(conds)], seed=seed + j,
+                                  sample_steps=few) for j in range(b)]
+            seed += b
+            for t in tickets:
+                t.result(timeout=600)
+        warm = svc.compile_counters()
+        # Rate calibration: solo warm few-step requests give the host's
+        # per-row step cost; the Poisson rate targets ~85% utilization
+        # of that capacity (see the docstring: load at the knee — an
+        # arrival-bound run measures the trace, not the scheduler).
+        t0 = time.perf_counter()
+        cal = 3
+        for j in range(cal):
+            svc.submit(conds[j % len(conds)], seed=70_000 + j,
+                       sample_steps=few).result(timeout=600)
+        t_row = (time.perf_counter() - t0) / (cal * few)
+        rate = args.cont_rate
+        if rate <= 0:
+            rate = round(0.85 / (mean_steps * t_row), 3)
+        trace = poisson_trace(args.cont_requests, rate, mix, slo,
+                              args.cont_seed)
+        result = {"trace": {
+            "requests": args.cont_requests, "rate_per_s": rate,
+            "rate_auto_calibrated": args.cont_rate <= 0,
+            "row_step_s": round(t_row, 4),
+            "mix": {str(k): v for k, v in mix.items()},
+            "slo_ms": {str(k): v for k, v in slo.items()},
+            "seed": args.cont_seed, "teacher_steps": args.teacher_steps,
+            "max_batch": max_batch,
+        }}
+        records, window = replay_trace(svc, conds, trace)
+        after = svc.compile_counters()
+        stepper = summarize_replay(records, window)
+        stepper["programs_built_delta"] = (
+            after["programs_built"] - warm["programs_built"])
+        stepper["jit_cache_entries_delta"] = (
+            after["jit_cache_entries"] - warm["jit_cache_entries"])
+        result["stepper"] = stepper
+    finally:
+        svc.stop()
+
+    # --- 2. PR 3 dispatcher, same trace (scheduler A/B) ---------------
+    svc = make_service("request")
+    try:
+        seed = 95_000
+        for b in buckets:  # identical warmup policy: few-step class only
+            tickets = [svc.submit(conds[j % len(conds)], seed=seed + j,
+                                  sample_steps=few) for j in range(b)]
+            seed += b
+            for t in tickets:
+                t.result(timeout=600)
+        warm = svc.compile_counters()
+        records, window = replay_trace(svc, conds, trace)
+        after = svc.compile_counters()
+        ab = summarize_replay(records, window)
+        # The old cache key folds steps in: mixed traffic compiles one
+        # program per (steps, bucket) it meets — counted, not hidden.
+        ab["programs_built_delta"] = (
+            after["programs_built"] - warm["programs_built"])
+        result["scheduler_ab"] = ab
+    finally:
+        svc.stop()
+
+    # --- 3. PR 3 deployment: teacher-ladder serving -------------------
+    svc = make_service("request")
+    try:
+        base_n = min(args.cont_baseline_requests, len(trace))
+        # Warm the one program this lane uses (bucket-1 teacher scan).
+        svc.submit(conds[0], seed=80_000,
+                   sample_steps=args.teacher_steps).result(timeout=600)
+        records, window = replay_trace(
+            svc, conds, trace[:base_n],
+            teacher_steps=args.teacher_steps, use_deadlines=False)
+        pr3 = summarize_replay(records, window)
+        pr3["teacher_steps"] = args.teacher_steps
+        pr3["note"] = ("pre-distillation deployment: every request runs "
+                       "the teacher ladder; capacity-bound, measured "
+                       f"over the first {base_n} arrivals with no "
+                       "deadlines (in its favor)")
+        result["pr3_teacher_steps"] = pr3
+    finally:
+        svc.stop()
+
+    result["vs_whole_request_same_trace"] = round(
+        result["stepper"]["rps_goodput"]
+        / max(result["scheduler_ab"]["rps_goodput"], 1e-9), 3)
+    # Served-vs-served: delivery throughput of the few-step deployment
+    # against what PR 3 could deliver for the same demand.
+    result["vs_pr3_few_step_serving"] = round(
+        result["stepper"]["rps_served"]
+        / max(result["pr3_teacher_steps"]["rps_served"], 1e-9), 3)
+    few_cls = result["stepper"]["classes"].get(str(few), {})
+    result["p99_few_step_s"] = few_cls.get("p99_s", 0.0)
+    result["p99_few_step_bounded"] = bool(
+        few_cls and slo.get(few)
+        and few_cls["p99_s"] <= slo[few] / 1000.0
+        and few_cls.get("expired", 0) == 0)
+    return result
 
 
 def hot_swap_bench(service, conds, params, concurrency: int,
@@ -325,6 +640,51 @@ def main() -> int:
     ap.add_argument("--hot-swap", action="store_true",
                     help="publish a new version mid-bench and assert a "
                          "zero-downtime, zero-recompile swap")
+    ap.add_argument("--scheduler", choices=("step", "request"),
+                    default="step",
+                    help="service scheduler for the classic bench path "
+                         "(default: the step-level stepper)")
+    ap.add_argument("--continuous", action="store_true",
+                    help="judged continuous-batching scenario: Poisson "
+                         "arrivals with mixed step classes through the "
+                         "stepper vs the PR 3 whole-request dispatcher "
+                         "(same trace AND teacher-ladder deployment), "
+                         "with the zero-recompile mixed-sweep assert")
+    ap.add_argument("--cont-requests", type=int, default=128,
+                    help="trace length; long enough that the steady "
+                         "state, not the fixed ~one-teacher-ladder drain "
+                         "tail after the last arrival, dominates the "
+                         "measured window")
+    ap.add_argument("--cont-rate", type=float, default=0.0,
+                    help="Poisson arrival rate, requests/second "
+                         "(0 = auto-calibrate to ~85%% of the measured "
+                         "row-step capacity)")
+    ap.add_argument("--cont-mix", default="4:0.8,64:0.12,256:0.08",
+                    help="step-class mix 'steps:prob,...' (default: "
+                         "mostly 4-step distilled requests with a tail "
+                         "of 64/256-step legacy ones)")
+    ap.add_argument("--cont-slo-ms", default="4:5000,64:20000,256:60000",
+                    help="per-class latency SLO in ms (doubles as the "
+                         "request deadline; 0 = none). Defaults give "
+                         "each class ~10x its solo service time — tight "
+                         "enough that one teacher-ladder scan ahead of "
+                         "you (~20s+) blows the few-step SLO, loose "
+                         "enough that knee-load ring waits don't")
+    ap.add_argument("--cont-max-batch", type=int, default=16,
+                    help="ring capacity (power of two). Sized so bursts "
+                         "of long-ladder requests (~4 in flight at the "
+                         "default mix/rate) cannot fill the ring and "
+                         "starve few-step arrivals of slots — ring size "
+                         "bounds CONCURRENCY, not throughput, under "
+                         "processor sharing")
+    ap.add_argument("--cont-seed", type=int, default=0)
+    ap.add_argument("--teacher-steps", type=int, default=256,
+                    help="step count of the pre-distillation teacher "
+                         "(the PR 3 deployment baseline serves everything "
+                         "at this ladder)")
+    ap.add_argument("--cont-baseline-requests", type=int, default=6,
+                    help="trace prefix length for the capacity-bound "
+                         "teacher-ladder baseline")
     args = ap.parse_args()
 
     from novel_view_synthesis_3d_tpu.config import ServeConfig
@@ -332,7 +692,50 @@ def main() -> int:
 
     cfg, model, params, conds = build(args.preset, args.sidelength,
                                       args.steps)
-    scfg = ServeConfig(max_batch=args.max_batch,
+
+    if args.continuous:
+        # The continuous scenario runs its own model variant: the preset
+        # block with a LIGHT backbone (1 res-block, attention at the
+        # bottleneck only) so a 256-step teacher request costs seconds,
+        # not half a minute, on the 1-core CI host — its trajectory is a
+        # separate metric (serve_continuous_rps_*), never compared to
+        # the classic serve_rps numbers. Full-depth timesteps (the
+        # preset's) so every step class up to the teacher ladder fits.
+        cfg, model, params, conds = build(
+            args.preset, args.sidelength, args.steps,
+            extra_overrides=[("model.num_res_blocks", 1),
+                             ("model.attn_resolutions", [8]),
+                             ("diffusion.sample_timesteps",
+                              get_default_timesteps(args.preset))])
+        cont = continuous_bench(model, params, cfg, conds, args)
+        result = {
+            "metric": f"serve_continuous_rps_{args.preset}",
+            "value": cont["stepper"]["rps_served"],
+            "unit": "req/s",
+            "rps_goodput": cont["stepper"]["rps_goodput"],
+            "vs_baseline": cont["vs_pr3_few_step_serving"],
+            "baseline_value": cont["pr3_teacher_steps"]["rps_served"],
+            "baseline": ("PR 3 deployment: whole-request dispatcher, "
+                         "every request at the "
+                         f"{args.teacher_steps}-step teacher ladder "
+                         "(pre-distillation serving)"),
+            "vs_whole_request_same_trace":
+                cont["vs_whole_request_same_trace"],
+            "sidelength": args.sidelength,
+            "continuous": cont,
+            "platform": jax.default_backend(),
+        }
+        print(json.dumps(result))
+        sweep_delta = cont["stepper"]["programs_built_delta"]
+        if sweep_delta or cont["stepper"]["jit_cache_entries_delta"]:
+            print("error: the mixed-step trace compiled "
+                  f"{sweep_delta} new stepper program(s) — the stepper "
+                  "program cache must be keyed on bucket/shape only "
+                  "(steps/t/w are device arguments)", file=sys.stderr)
+            return 1
+        return 0
+
+    scfg = ServeConfig(scheduler=args.scheduler, max_batch=args.max_batch,
                        flush_timeout_ms=args.flush_timeout_ms,
                        queue_depth=max(64, 2 * args.requests),
                        results_folder="/tmp/nvs3d_serve_bench")

@@ -135,6 +135,89 @@ def telemetry_rows(search_dirs):
     return rows
 
 
+def continuous_lines(rows):
+    """Per-step-class latency tables for serve_bench --continuous rows
+    (the step-level continuous-batching scenario): one table per entry,
+    covering the stepper, the same-trace whole-request A/B, and the
+    PR 3 teacher-ladder deployment baseline."""
+    lines = []
+    for name, d in rows:
+        cont = d.get("continuous")
+        if not isinstance(cont, dict):
+            continue
+        lines += ["", f"## Continuous batching — {name}", ""]
+        tr = cont.get("trace", {})
+        lines.append(
+            f"- trace: {tr.get('requests')} req @ "
+            f"{tr.get('rate_per_s')}/s, mix {tr.get('mix')}, "
+            f"teacher {tr.get('teacher_steps')} steps")
+        lines.append(
+            f"- few-step serving vs PR 3 deployment: "
+            f"**{cont.get('vs_pr3_few_step_serving')}×**; scheduler-only "
+            f"(same trace): {cont.get('vs_whole_request_same_trace')}×; "
+            f"few-step p99 {cont.get('p99_few_step_s')}s "
+            f"(bounded={cont.get('p99_few_step_bounded')})")
+        lines += ["",
+                  "| lane | class | n | ok | late | expired | p50 (s) | "
+                  "p99 (s) |", "|---|---|---|---|---|---|---|---|"]
+        for lane in ("stepper", "scheduler_ab", "pr3_teacher_steps"):
+            summ = cont.get(lane)
+            if not summ:
+                continue
+            for cls, c in sorted(summ.get("classes", {}).items(),
+                                 key=lambda kv: int(kv[0])):
+                lines.append(
+                    "| {} | {} | {} | {} | {} | {} | {} | {} |".format(
+                        lane, cls, c.get("n"), c.get("ok"),
+                        c.get("late"), c.get("expired"),
+                        fmt(c.get("p50_s", 0.0)), fmt(c.get("p99_s", 0.0))))
+        delta = cont.get("stepper", {}).get("programs_built_delta")
+        lines.append("")
+        lines.append(
+            f"- stepper programs built during the mixed trace: {delta} "
+            "(zero-recompile contract)"
+            + (f"; whole-request built "
+               f"{cont.get('scheduler_ab', {}).get('programs_built_delta')}"
+               " (per-(steps,bucket) cache key)" if cont.get("scheduler_ab")
+               else ""))
+    return lines
+
+
+def cpu_lane_lines(repo_root: str):
+    """The restored CPU-lane trajectory: every BENCH_r*.json archive at
+    the repo root, with its lane/platform/value — four rc=3 rounds with
+    'parsed: null' (BENCH_r03-r05) is the blindness this replaces."""
+    import glob
+
+    lines = ["", "## Bench-lane trajectory (BENCH_r*.json)", ""]
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json"))):
+        try:
+            with open(path) as fh:
+                d = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = d.get("parsed")
+        if isinstance(parsed, dict):
+            rows.append((os.path.basename(path), d.get("rc"),
+                         parsed.get("lane", parsed.get("platform", "?")),
+                         parsed.get("metric"), parsed.get("value"),
+                         parsed.get("vs_baseline")))
+        else:
+            rows.append((os.path.basename(path), d.get("rc"), "-",
+                         "(no parsed datapoint)", None, None))
+    if not rows:
+        return []
+    lines += ["| round | rc | lane | metric | value | vs_baseline |",
+              "|---|---|---|---|---|---|"]
+    for name, rc, lane, metric, value, vsb in rows:
+        lines.append("| {} | {} | {} | {} | {} | {} |".format(
+            name, rc, lane, metric,
+            fmt(value) if value is not None else "null",
+            fmt(vsb) if vsb is not None else ""))
+    return lines
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     out_dir = args[0] if args else os.path.join("results", "tpu_r04")
@@ -164,6 +247,12 @@ def main() -> int:
                 "| {} | {} | {} | {} | | {} | |".format(
                     qdir, s.get("metric"), fmt(s.get("value")),
                     s.get("unit"), s.get("platform")))
+    # Per-step-class latency tables for any serve_bench --continuous
+    # artifacts in the dir (the step-level continuous-batching scenario).
+    lines += continuous_lines(rows)
+    # The restored CPU-lane trajectory from the repo-root BENCH archives.
+    lines += cpu_lane_lines(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     # Recovery events: every training metrics.csv under the bench dir (and
     # the quality sibling dirs) that recorded anomaly-guard skips or
     # checkpoint rollbacks. "none" is an explicit claim, not silence.
