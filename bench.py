@@ -757,6 +757,11 @@ def main():
     # Always emitted (even spd=1): every record is self-describing, so
     # older spd-implicit JSONs can't be confused with newer defaults.
     result["steps_per_dispatch"] = spd
+    # Input-pipeline attribution: which record backend/loader the config
+    # selects (the judged loop itself runs on a staged synthetic batch,
+    # but BENCH_r* rounds comparing loader changes need the label).
+    result["data_backend"] = cfg.data.backend
+    result["data_loader"] = cfg.data.loader
     if flops:
         # Peak table lives in obs/devmon.py (one home — the trainer's MFU
         # gauge reads the same numbers). Unknown kinds report raw

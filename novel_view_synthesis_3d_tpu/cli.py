@@ -1,4 +1,5 @@
-"""Command-line entry points: train / sample / serve / eval / prep / config.
+"""Command-line entry points: train / sample / serve / eval / prep / pack
+/ config.
 
 The reference's entry points are two hardwired scripts with zero flags
 (`/root/reference/train.py:174-176` — dataset path literal 'cars_train_val';
@@ -557,6 +558,63 @@ def cmd_prep(args, overrides: List[str]) -> int:
     return 0
 
 
+def cmd_pack(args, overrides: List[str]) -> int:
+    """Pack an SRN tree into sharded records, or verify a packed corpus.
+
+    Two modes:
+      nvs3d pack SRN_DIR --out PACKED_DIR [--shard-mb N] [--verify]
+        walks the SRN layout once, writes shard-*.nvsrec + index.json
+        (sharded by scene at a target shard size), optionally verifying
+        the result before reporting;
+      nvs3d pack PACKED_DIR --verify
+        integrity sweep over an existing corpus: re-hash every shard,
+        cross-check footers against index.json, unpack every record,
+        decode a probe view per scene. rc=1 if anything fails — the
+        pre-flight for pointing data.backend='packed' at a corpus.
+    """
+    del overrides
+    from novel_view_synthesis_3d_tpu.data import records
+
+    def run_verify(root: str) -> int:
+        problems = records.verify_packed(
+            root, decode="all" if args.deep else "first")
+        print(json.dumps({
+            "verified": not problems, "dir": root,
+            "problems": problems[:50],
+            "num_problems": len(problems)}))
+        if problems:
+            print(f"verification FAILED: {len(problems)} problem(s)",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+    if os.path.exists(os.path.join(args.src, records.INDEX_NAME)) \
+            and not args.out:
+        if not args.verify:
+            raise SystemExit(
+                f"{args.src!r} is already a packed corpus; pass --verify "
+                "to check it, or --out DIR to re-pack somewhere else")
+        return run_verify(args.src)
+    if not args.out:
+        raise SystemExit("--out DIR is required when packing")
+    index = records.pack_srn(
+        args.src, args.out, shard_mb=args.shard_mb,
+        max_num_instances=args.max_instances,
+        progress=((lambda name, views, shard: print(
+            f"  packed {name} ({views} views) -> shard {shard}"))
+            if args.progress else None))
+    print(json.dumps({
+        "packed": args.out,
+        "shards": len(index["shards"]),
+        "instances": index["num_instances"],
+        "views": index["num_views"],
+        "bytes": sum(s["bytes"] for s in index["shards"]),
+    }))
+    if args.verify:
+        return run_verify(args.out)
+    return 0
+
+
 def cmd_config(args, overrides: List[str]) -> int:
     print(build_config(args, overrides).to_json())
     return 0
@@ -1079,6 +1137,33 @@ def make_parser() -> argparse.ArgumentParser:
     q.add_argument("csv_path")
     q.add_argument("--symlink", action="store_true")
 
+    p = sub.add_parser(
+        "pack",
+        help="pack an SRN tree into sharded records (data.backend="
+             "'packed'), or --verify an existing packed corpus")
+    p.add_argument("src",
+                   help="SRN dataset root to pack, or a packed corpus "
+                        "dir with --verify and no --out")
+    p.add_argument("--out", default=None, metavar="DIR",
+                   help="output corpus dir (shard-*.nvsrec + index.json)")
+    p.add_argument("--shard-mb", type=float, default=64.0,
+                   help="target shard size in MB; shards close at the "
+                        "scene boundary past this (default 64). Pack "
+                        "with at least as many shards as training hosts "
+                        "— per-host reads slice at shard granularity")
+    p.add_argument("--max-instances", type=int, default=-1,
+                   help="pack only the first N instances (-1 = all)")
+    p.add_argument("--verify", action="store_true",
+                   help="after packing (or on an existing corpus with no "
+                        "--out): re-hash every shard, cross-check "
+                        "footers vs index.json, unpack every record, "
+                        "decode a probe view per scene; rc=1 on failure")
+    p.add_argument("--deep", action="store_true",
+                   help="with --verify: decode EVERY view, not one per "
+                        "scene")
+    p.add_argument("--progress", action="store_true",
+                   help="print one line per packed instance")
+
     p = sub.add_parser("config", help="print the resolved config JSON")
     _add_common(p)
 
@@ -1179,6 +1264,7 @@ _COMMANDS = {
     "serve": cmd_serve,
     "eval": cmd_eval,
     "prep": cmd_prep,
+    "pack": cmd_pack,
     "config": cmd_config,
     "export": cmd_export,
     "registry": cmd_registry,

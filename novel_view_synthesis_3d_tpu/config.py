@@ -132,9 +132,19 @@ class DataConfig:
     max_observations_per_instance: int = 50
     specific_observation_idcs: Optional[Tuple[int, ...]] = None
     samples_per_instance: int = 1
-    # Pipeline backend: 'native' = C++ threaded loader (native/libnvs3d_io.so,
-    # falls back to grain if the library can't build), 'grain' = Grain worker
-    # processes, 'python' = in-process iterator.
+    # Record backend: 'files' = walk the SRN per-scene PNG/pose tree (the
+    # reference layout); 'packed' = read the sharded record format
+    # (data/records.py — root_dir is then a `nvs3d pack` output dir with
+    # index.json). Packed reads are per-host at shard granularity, served
+    # through the compute-overlapped PipelinedLoader (decode worker pool
+    # sized by num_workers, depth by prefetch), and produce bit-identical
+    # training batches to 'files' for the same (seed, epoch, index). The
+    # `loader` knob below only applies to 'files'.
+    backend: str = "files"
+    # Pipeline loader for backend='files': 'native' = C++ threaded loader
+    # (native/libnvs3d_io.so, falls back to grain if the library can't
+    # build), 'grain' = Grain worker processes, 'python' = in-process
+    # iterator.
     loader: str = "native"
     num_workers: int = 8
     prefetch: int = 4
@@ -679,6 +689,16 @@ class Config:
             errors.append(
                 f"data.max_record_retries={d.max_record_retries} must be "
                 ">= 0")
+        if d.backend not in ("files", "packed"):
+            errors.append(
+                f"data.backend={d.backend!r} must be 'files' (SRN "
+                "PNG/pose tree) or 'packed' (sharded records from "
+                "`nvs3d pack`; data.root_dir is the packed corpus dir)")
+        if d.backend == "packed" and d.prefetch < 1:
+            errors.append(
+                f"data.prefetch={d.prefetch} must be >= 1 with "
+                "data.backend='packed' (it sizes the pipelined loader's "
+                "decode-ahead depth)")
         if t.max_restarts < 0:
             errors.append(
                 f"train.max_restarts={t.max_restarts} must be >= 0")

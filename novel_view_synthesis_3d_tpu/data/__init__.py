@@ -1,10 +1,18 @@
 from novel_view_synthesis_3d_tpu.data.pipeline import (  # noqa: F401
+    PipelinedLoader,
     cycle,
     iter_batches,
     make_dataset,
     make_grain_loader,
+    make_packed_loader,
+)
+from novel_view_synthesis_3d_tpu.data.records import (  # noqa: F401
+    PackedDataset,
+    pack_srn,
+    verify_packed,
 )
 from novel_view_synthesis_3d_tpu.data.srn import (  # noqa: F401
+    FlatViewDataset,
     SRNDataset,
     SRNInstance,
     load_pose,

@@ -1,0 +1,610 @@
+"""Packed-record data plane: sharded record format + indexed reader.
+
+The SRN file walker (data/srn.py) opens three files per view — fine for
+one class of ShapeNet cars, hopeless at a millions-of-scenes corpus
+(ROADMAP item 3): metadata walks dominate startup, random reads seek per
+view, and no object store serves millions of tiny files well. This module
+packs an SRN-layout tree into a few large shards once (`nvs3d pack`) and
+serves training from them:
+
+  shard-00000.nvsrec                      one record per SCENE
+  ┌──────────────────────────────────────────────────────────────┐
+  │ b"NVS3DRC1"          magic (8 B)                             │
+  │ <II                  version, flags (8 B)                    │
+  │ record 0             msgpack {name, intrinsics,              │
+  │ record 1                      views: [{rgb: png-bytes,       │
+  │ ...                                    pose: 16×f32-LE}]}    │
+  │ footer               msgpack {instances:                     │
+  │                               [[name, offset, length,        │
+  │                                 num_views], ...]}            │
+  │ <QQ                  footer offset, footer length (16 B)     │
+  │ sha256               over bytes [0, footer end) (32 B)       │
+  │ b"NVS3DEND"          end marker (8 B)                        │
+  └──────────────────────────────────────────────────────────────┘
+
+  index.json            corpus-level: ordered instance entries
+                        {name, shard, offset, length, views,
+                        intrinsics-text} + per-shard {file, bytes,
+                        sha256} — (instance, view) → (shard, offset)
+                        without touching any shard.
+
+Design decisions:
+  - RGB stays in its ORIGINAL encoded form (the source PNG/JPG bytes):
+    decode + square-crop + resize remain read-time decisions, so one
+    packed corpus serves every img_sidelength, and the decode chain is
+    byte-for-byte the file walker's (srn.decode_rgb) — the foundation of
+    the bit-identity contract between `backend='packed'` and 'files'.
+  - Sharded BY SCENE: every view of an instance lives in one record, so
+    the reference's same-instance pair/group sampling touches one shard
+    region, and per-host sharding at shard granularity keeps instances
+    whole.
+  - Per-host reads: a process opens only the shards whose ordinal lands
+    in its 1/process_count() slice — no host ever stats, hashes, or reads
+    another host's bytes (composes with parallel/mesh.shard_batch exactly
+    like the Grain path's per-host IndexSampler shards).
+  - Integrity first (PR 1 quarantine semantics): every shard is re-hashed
+    at open; a flipped byte or torn tail quarantines that shard's records
+    BY ID (reported, skipped) and the run continues on the remaining
+    shards — one bad shard costs its records, never the run. Records that
+    fail decode despite a clean hash quarantine individually through the
+    shared FlatViewDataset ladder, bounded by data.max_record_retries.
+
+Fault injection: NVS3D_FI_CORRUPT_SHARD_AT / NVS3D_FI_TRUNCATE_SHARD_AT
+(utils/faultinject.py) mutate the byte stream AS READ at open — the
+tier-1 drills prove both quarantine lanes without touching disk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import struct
+import sys
+import threading
+from collections import OrderedDict
+from glob import glob
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import msgpack
+import numpy as np
+
+from novel_view_synthesis_3d_tpu.data.srn import (
+    FlatViewDataset,
+    _subset,
+    decode_rgb,
+    glob_images,
+    load_pose,
+    parse_intrinsics_text,
+)
+from novel_view_synthesis_3d_tpu.utils import faultinject
+
+SHARD_MAGIC = b"NVS3DRC1"
+SHARD_END = b"NVS3DEND"
+SHARD_VERSION = 1
+SHARD_SUFFIX = ".nvsrec"
+INDEX_NAME = "index.json"
+FORMAT_NAME = "nvs3d-packed"
+_HEADER = struct.Struct("<II")  # version, flags
+_TAIL_FIXED = struct.Struct("<QQ")  # footer offset, footer length
+HEADER_LEN = len(SHARD_MAGIC) + _HEADER.size
+TAIL_LEN = _TAIL_FIXED.size + 32 + len(SHARD_END)
+
+
+class ShardCorrupt(RuntimeError):
+    """A shard failed its open-time integrity check (bad magic, torn
+    tail, sha256 mismatch, or footer/index disagreement)."""
+
+
+class PackedRecordError(RuntimeError):
+    """A record inside a VERIFIED shard failed to decode. Carries
+    `.flat_index` so the shared quarantine ladder (FlatViewDataset.
+    _safe_fetch) hits the exact record, sibling draws included."""
+
+    def __init__(self, msg: str, flat_index: int):
+        super().__init__(msg)
+        self.flat_index = int(flat_index)
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+class ShardWriter:
+    """One shard file: header + scene records + footer index + hash tail.
+
+    The sha256 covers every byte before the tail, so a reader can prove
+    end-to-end integrity (including the footer it is about to trust) from
+    one streaming pass."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = open(path + ".tmp", "wb")
+        self._hash = hashlib.sha256()
+        self._entries: List[list] = []
+        self._write(SHARD_MAGIC + _HEADER.pack(SHARD_VERSION, 0))
+
+    def _write(self, data: bytes) -> None:
+        self._fh.write(data)
+        self._hash.update(data)
+
+    @property
+    def bytes_written(self) -> int:
+        return self._fh.tell()
+
+    def add(self, name: str, payload: bytes, num_views: int) -> int:
+        offset = self._fh.tell()
+        self._write(payload)
+        self._entries.append([name, offset, len(payload), int(num_views)])
+        return offset
+
+    def close(self) -> dict:
+        """Footer + tail, fsync, atomic rename. Returns the shard's
+        index.json entry (minus the file name the caller assigns)."""
+        footer = msgpack.packb({"instances": self._entries},
+                               use_bin_type=True)
+        footer_off = self._fh.tell()
+        self._write(footer)
+        self._fh.write(_TAIL_FIXED.pack(footer_off, len(footer))
+                       + self._hash.digest() + SHARD_END)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        os.replace(self.path + ".tmp", self.path)
+        return {
+            "bytes": os.path.getsize(self.path),
+            "sha256": self._hash.hexdigest(),
+            "num_instances": len(self._entries),
+            "num_views": sum(e[3] for e in self._entries),
+        }
+
+
+def pack_srn(root_dir: str, out_dir: str, *, shard_mb: float = 64.0,
+             max_num_instances: int = -1,
+             progress: Optional[callable] = None) -> dict:
+    """Pack an SRN-layout directory into sharded records + index.json.
+
+    Shards by scene: a shard is closed once it crosses `shard_mb` (so
+    every scene's views stay together). RGB bytes are stored as found on
+    disk (no re-encode — see the module docstring), poses as parsed f32,
+    intrinsics as raw text. Returns the index dict that was written."""
+    instance_dirs = sorted(glob(os.path.join(root_dir, "*/")))
+    if not instance_dirs:
+        raise FileNotFoundError(f"no instances under {root_dir!r}")
+    if max_num_instances != -1:
+        instance_dirs = instance_dirs[:max_num_instances]
+    os.makedirs(out_dir, exist_ok=True)
+    target_bytes = max(1, int(shard_mb * 1e6))
+
+    shards: List[dict] = []
+    instances: List[dict] = []
+    writer: Optional[ShardWriter] = None
+
+    def close_shard():
+        nonlocal writer
+        meta = writer.close()
+        meta = dict(file=os.path.basename(writer.path), **meta)
+        shards.append(meta)
+        writer = None
+
+    for d in instance_dirs:
+        name = os.path.basename(os.path.normpath(d))
+        colors = glob_images(os.path.join(d, "rgb"))
+        poses = sorted(glob(os.path.join(d, "pose", "*.txt")))
+        if len(colors) != len(poses):
+            raise ValueError(f"{d}: {len(colors)} images vs "
+                             f"{len(poses)} poses")
+        with open(os.path.join(d, "intrinsics.txt")) as fh:
+            intrinsics = fh.read()
+        views = []
+        for c, p in zip(colors, poses):
+            with open(c, "rb") as fh:
+                rgb = fh.read()
+            views.append({"rgb": rgb,
+                          "pose": load_pose(p).astype("<f4").tobytes()})
+        payload = msgpack.packb(
+            {"name": name, "intrinsics": intrinsics, "views": views},
+            use_bin_type=True)
+        if writer is None:
+            writer = ShardWriter(os.path.join(
+                out_dir, f"shard-{len(shards):05d}{SHARD_SUFFIX}"))
+        offset = writer.add(name, payload, len(views))
+        instances.append({"name": name, "shard": len(shards),
+                          "offset": offset, "length": len(payload),
+                          "views": len(views), "intrinsics": intrinsics})
+        if progress is not None:
+            progress(name, len(views), len(shards))
+        if writer.bytes_written >= target_bytes:
+            close_shard()
+    if writer is not None:
+        close_shard()
+
+    index = {
+        "format": FORMAT_NAME,
+        "version": SHARD_VERSION,
+        "source": os.path.abspath(root_dir),
+        "num_instances": len(instances),
+        "num_views": sum(e["views"] for e in instances),
+        "shards": shards,
+        "instances": instances,
+    }
+    tmp = os.path.join(out_dir, INDEX_NAME + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(index, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, os.path.join(out_dir, INDEX_NAME))
+    return index
+
+
+# ---------------------------------------------------------------------------
+# Shard open + verification
+# ---------------------------------------------------------------------------
+def read_shard_footer(path: str, ordinal: int = 0, *,
+                      fault_injection: bool = False) -> dict:
+    """Open + VERIFY one shard (magic, end marker, sha256 re-hash over
+    header+records+footer) and return its footer dict. Raises
+    ShardCorrupt on any integrity failure — a torn tail (interrupted
+    write) and a flipped byte are both caught here, before any record
+    bytes are trusted.
+
+    The whole shard is read once for the hash (transient — the bytes are
+    dropped on return; record access later seeks the file directly).
+    `fault_injection=True` lets the NVS3D_FI_*_SHARD_AT env points mutate
+    the stream as read (reader path only; `nvs3d pack --verify` sees the
+    real bytes)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if fault_injection:
+        data = faultinject.maybe_corrupt_shard_bytes(ordinal, data)
+    if len(data) < HEADER_LEN + TAIL_LEN:
+        raise ShardCorrupt(f"{path}: truncated ({len(data)} bytes — "
+                           "shorter than header + tail)")
+    if data[:len(SHARD_MAGIC)] != SHARD_MAGIC:
+        raise ShardCorrupt(f"{path}: bad magic (not a packed shard)")
+    version, _ = _HEADER.unpack(
+        data[len(SHARD_MAGIC):HEADER_LEN])
+    if version != SHARD_VERSION:
+        raise ShardCorrupt(f"{path}: shard version {version} != "
+                           f"{SHARD_VERSION}")
+    tail = data[-TAIL_LEN:]
+    if tail[-len(SHARD_END):] != SHARD_END:
+        raise ShardCorrupt(f"{path}: torn tail (end marker missing — "
+                           "interrupted write?)")
+    footer_off, footer_len = _TAIL_FIXED.unpack(tail[:_TAIL_FIXED.size])
+    digest = tail[_TAIL_FIXED.size:_TAIL_FIXED.size + 32]
+    body = data[:-TAIL_LEN]
+    if footer_off + footer_len != len(body):
+        raise ShardCorrupt(f"{path}: footer bounds ({footer_off}+"
+                           f"{footer_len}) disagree with file size")
+    if hashlib.sha256(body).digest() != digest:
+        raise ShardCorrupt(f"{path}: sha256 mismatch — flipped byte or "
+                           "partial write")
+    try:
+        footer = msgpack.unpackb(body[footer_off:footer_off + footer_len],
+                                 raw=False)
+    except Exception as exc:
+        raise ShardCorrupt(f"{path}: footer undecodable: {exc}") from exc
+    if not isinstance(footer, dict) or "instances" not in footer:
+        raise ShardCorrupt(f"{path}: footer missing instance table")
+    return footer
+
+
+def verify_packed(root_dir: str, *, decode: str = "first") -> List[str]:
+    """Integrity sweep over a packed corpus (`nvs3d pack --verify`).
+
+    Per shard: re-hash + footer check (read_shard_footer), then
+    cross-check every index.json entry against the footer, unpack every
+    record, and — decode='first' (default) — PNG-decode one view per
+    record and parse its pose as a torn-content tripwire ('all' decodes
+    every view; 'none' skips decode). Returns a list of problem strings
+    (empty = corpus verified)."""
+    problems: List[str] = []
+    index_path = os.path.join(root_dir, INDEX_NAME)
+    try:
+        with open(index_path) as fh:
+            index = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        return [f"{index_path}: unreadable index ({exc})"]
+    if index.get("format") != FORMAT_NAME:
+        return [f"{index_path}: format {index.get('format')!r} != "
+                f"{FORMAT_NAME!r}"]
+    by_shard: Dict[int, List[dict]] = {}
+    for e in index.get("instances", []):
+        by_shard.setdefault(int(e["shard"]), []).append(e)
+    for ordinal, meta in enumerate(index.get("shards", [])):
+        path = os.path.join(root_dir, meta["file"])
+        try:
+            footer = read_shard_footer(path, ordinal)
+        except (ShardCorrupt, OSError) as exc:
+            problems.append(str(exc))
+            continue
+        if meta.get("sha256"):
+            with open(path, "rb") as fh:
+                body = fh.read()[:-TAIL_LEN]
+            if hashlib.sha256(body).hexdigest() != meta["sha256"]:
+                problems.append(f"{path}: sha256 differs from index.json")
+        footer_map = {e[0]: tuple(e[1:]) for e in footer["instances"]}
+        for entry in by_shard.get(ordinal, []):
+            got = footer_map.get(entry["name"])
+            want = (entry["offset"], entry["length"], entry["views"])
+            if got != want:
+                problems.append(
+                    f"{path}: index entry {entry['name']!r} {want} "
+                    f"disagrees with shard footer {got}")
+                continue
+            try:
+                with open(path, "rb") as fh:
+                    fh.seek(entry["offset"])
+                    rec = msgpack.unpackb(fh.read(entry["length"]),
+                                          raw=False)
+                if rec["name"] != entry["name"]:
+                    raise ValueError(
+                        f"record name {rec['name']!r} != index entry")
+                if len(rec["views"]) != entry["views"]:
+                    raise ValueError(
+                        f"{len(rec['views'])} views != "
+                        f"{entry['views']} in index")
+                to_decode = (range(len(rec["views"]))
+                             if decode == "all"
+                             else ([0] if decode == "first" else []))
+                for v in to_decode:
+                    view = rec["views"][v]
+                    decode_rgb(io.BytesIO(view["rgb"]))
+                    pose = np.frombuffer(view["pose"], dtype="<f4")
+                    if pose.shape != (16,):
+                        raise ValueError(
+                            f"view {v}: pose has {pose.size} floats")
+            except Exception as exc:
+                problems.append(
+                    f"{path}: record {entry['name']!r}: "
+                    f"{type(exc).__name__}: {exc}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Reader
+# ---------------------------------------------------------------------------
+class PackedInstance:
+    """One scene of a packed corpus — the read-side twin of SRNInstance.
+    Decoding is delegated to the owning dataset (shard seeks + scene
+    cache); only metadata lives here."""
+
+    __slots__ = ("_ds", "instance_idx", "instance_dir", "K",
+                 "img_sidelength", "view_ids")
+
+    def __init__(self, ds: "PackedDataset", instance_idx: int, name: str,
+                 K: np.ndarray, img_sidelength: int,
+                 view_ids: Sequence[int]):
+        self._ds = ds
+        self.instance_idx = instance_idx
+        self.instance_dir = name  # quarantine reports use the basename
+        self.K = K
+        self.img_sidelength = img_sidelength
+        self.view_ids = list(view_ids)
+
+    def __len__(self) -> int:
+        return len(self.view_ids)
+
+    def view(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(image HWC [-1,1], pose 4×4) for one observation."""
+        return self._ds._decode_view(self.instance_idx, idx)
+
+
+class PackedDataset(FlatViewDataset):
+    """Indexed reader over a packed corpus (`nvs3d pack` output) with
+    per-host sharding at shard granularity.
+
+    Drop-in for SRNDataset (same flat indexing, pair/samples semantics,
+    safe_* quarantine ladder — all shared via FlatViewDataset), but:
+      - opens ONLY the shards whose ordinal % shard_count == shard_index
+        (each host reads its 1/process_count() slice);
+      - RE-HASHES every opened shard: a corrupt or torn shard quarantines
+        its records by id at open (loud report, run continues on the
+        remaining shards) instead of surfacing as garbage batches later;
+      - serves record bytes by (shard, offset) seek with a small LRU of
+        unpacked scenes (instance-grouped sampling touches one scene
+        repeatedly) — no per-view file opens, no metadata walk.
+    """
+
+    def __init__(self, root_dir: str, img_sidelength: int = 64,
+                 max_num_instances: int = -1,
+                 max_observations_per_instance: int = -1,
+                 specific_observation_idcs: Optional[Sequence[int]] = None,
+                 samples_per_instance: int = 1,
+                 max_record_retries: int = 3,
+                 shard_index: int = 0, shard_count: int = 1,
+                 scene_cache: int = 64):
+        super().__init__(samples_per_instance=samples_per_instance,
+                         max_record_retries=max_record_retries)
+        self.root_dir = root_dir
+        self.img_sidelength = img_sidelength
+        index_path = os.path.join(root_dir, INDEX_NAME)
+        if not os.path.exists(index_path):
+            raise FileNotFoundError(
+                f"no {INDEX_NAME} under {root_dir!r} — not a packed "
+                "corpus; create one with `nvs3d pack <srn_dir> --out "
+                f"{root_dir}` or set data.backend='files'")
+        with open(index_path) as fh:
+            index = json.load(fh)
+        if index.get("format") != FORMAT_NAME:
+            raise ValueError(
+                f"{index_path}: format {index.get('format')!r} != "
+                f"{FORMAT_NAME!r}")
+        if not 0 <= shard_index < max(1, shard_count):
+            raise ValueError(
+                f"shard_index {shard_index} outside [0, {shard_count})")
+
+        entries = list(index["instances"])
+        if max_num_instances != -1:
+            # Global-order subset FIRST (same records on every host),
+            # then the per-host shard slice below.
+            entries = entries[:max_num_instances]
+        if shard_count > 1:
+            entries = [e for e in entries
+                       if int(e["shard"]) % shard_count == shard_index]
+            if not entries:
+                raise ValueError(
+                    f"host slice {shard_index}/{shard_count} of "
+                    f"{root_dir!r} holds no shards "
+                    f"({len(index['shards'])} total) — repack with a "
+                    "smaller --shard-mb so every host gets at least one")
+
+        self._entries = entries
+        self._shard_paths: Dict[int, str] = {
+            int(e["shard"]): os.path.join(root_dir,
+                                          index["shards"][int(e["shard"])]
+                                          ["file"])
+            for e in entries}
+        self._shard_locks: Dict[int, threading.Lock] = {
+            s: threading.Lock() for s in self._shard_paths}
+        self._cache: "OrderedDict[int, dict]" = OrderedDict()
+        self._cache_lock = threading.Lock()
+        self._scene_cache = max(1, scene_cache)
+
+        # Open + verify this host's shard slice. A failing shard
+        # quarantines its records by id; the survivors keep training.
+        by_shard: Dict[int, List[dict]] = {}
+        for e in entries:
+            by_shard.setdefault(int(e["shard"]), []).append(e)
+        bad_shards: Dict[int, str] = {}
+        for ordinal in sorted(self._shard_paths):
+            try:
+                footer = read_shard_footer(self._shard_paths[ordinal],
+                                           ordinal, fault_injection=True)
+            except (ShardCorrupt, OSError) as exc:
+                bad_shards[ordinal] = f"{type(exc).__name__}: {exc}"
+                continue
+            footer_map = {e[0]: tuple(e[1:])
+                          for e in footer["instances"]}
+            for e in by_shard.get(ordinal, ()):
+                if footer_map.get(e["name"]) != (e["offset"], e["length"],
+                                                 e["views"]):
+                    bad_shards[ordinal] = (
+                        "footer disagrees with index.json (stale or "
+                        "swapped shard file)")
+                    break
+
+        for idx, e in enumerate(entries):
+            selected = _subset(list(range(int(e["views"]))),
+                               specific_observation_idcs,
+                               max_observations_per_instance)
+            K, _, _, _ = parse_intrinsics_text(
+                e["intrinsics"], trgt_sidelength=img_sidelength)
+            self.instances.append(PackedInstance(
+                self, idx, e["name"], K, img_sidelength, selected))
+        self._finalize_index()
+
+        self.shards_open = len(self._shard_paths) - len(bad_shards)
+        self.shards_quarantined = len(bad_shards)
+        for ordinal, reason in sorted(bad_shards.items()):
+            names = [e["name"] for e in entries
+                     if int(e["shard"]) == ordinal]
+            ids: List[int] = []
+            for obj, e in enumerate(entries):
+                if int(e["shard"]) == ordinal:
+                    ids.extend(range(int(self._offsets[obj]),
+                                     int(self._offsets[obj + 1])))
+            self.quarantined.update(ids)
+            report = {
+                "shard": os.path.basename(self._shard_paths[ordinal]),
+                "records": len(ids),
+                "instances": names,
+                "error": reason,
+            }
+            self.fault_reports.append(report)
+            print(f"warning: data fault: shard "
+                  f"{report['shard']} quarantined at open "
+                  f"({len(ids)} records across {len(names)} instances): "
+                  f"{reason}", file=sys.stderr, flush=True)
+        if len(self) > 0 and len(self.quarantined) >= len(self):
+            raise RuntimeError(
+                f"packed corpus {root_dir!r}: every local shard failed "
+                "verification — nothing left to train on; re-pack or "
+                "restore the shards (see the quarantine reports above)")
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        """Data-plane health on the shared obs registry: shard + record
+        quarantine state next to the trainer's step gauges."""
+        try:
+            from novel_view_synthesis_3d_tpu import obs
+
+            reg = obs.get_registry()
+            reg.gauge("nvs3d_data_shards_open",
+                      "packed shards this process serves from").set(
+                          self.shards_open)
+            reg.gauge("nvs3d_data_shards_quarantined",
+                      "packed shards quarantined at open "
+                      "(hash/tail failure)").set(self.shards_quarantined)
+            reg.gauge("nvs3d_data_records_quarantined",
+                      "records quarantined by the data fault ladder").set(
+                          len(self.quarantined))
+        except Exception:
+            pass  # telemetry must never fail the data path
+
+    # -- record access --------------------------------------------------
+    def _scene(self, obj: int) -> dict:
+        """Unpacked scene record for instance `obj` (LRU-cached; the seek
+        + read is serialized per shard, the msgpack decode is not)."""
+        with self._cache_lock:
+            rec = self._cache.get(obj)
+            if rec is not None:
+                self._cache.move_to_end(obj)
+                return rec
+        e = self._entries[obj]
+        ordinal = int(e["shard"])
+        with self._shard_locks[ordinal]:
+            with open(self._shard_paths[ordinal], "rb") as fh:
+                fh.seek(int(e["offset"]))
+                payload = fh.read(int(e["length"]))
+        rec = msgpack.unpackb(payload, raw=False)
+        if (rec.get("name") != e["name"]
+                or len(rec.get("views", ())) != int(e["views"])):
+            raise ValueError(
+                f"record at {self._shard_paths[ordinal]}:{e['offset']} "
+                "does not match its index entry (corrupt offset?)")
+        with self._cache_lock:
+            self._cache[obj] = rec
+            while len(self._cache) > self._scene_cache:
+                self._cache.popitem(last=False)
+        return rec
+
+    def _decode_view(self, obj: int, idx: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        inst = self.instances[obj]
+        try:
+            rec = self._scene(obj)
+            view = rec["views"][inst.view_ids[idx]]
+            rgb = decode_rgb(io.BytesIO(view["rgb"]), self.img_sidelength)
+            pose = np.frombuffer(view["pose"],
+                                 dtype="<f4").reshape(4, 4).astype(
+                                     np.float32)
+        except Exception as exc:
+            flat = int(self._offsets[obj]) + int(idx)
+            raise PackedRecordError(
+                f"packed record {inst.instance_dir!r} view {idx} "
+                f"(flat {flat}): {type(exc).__name__}: {exc}",
+                flat_index=flat) from exc
+        return rgb, pose
+
+    def _quarantine(self, flat_idx: int, exc: Exception) -> None:
+        super()._quarantine(flat_idx, exc)
+        self._publish_gauges()
+
+
+def make_packed_dataset(cfg, *, shard_index: int = 0,
+                        shard_count: int = 1) -> PackedDataset:
+    """PackedDataset from a DataConfig (`data.backend='packed'`:
+    data.root_dir IS the packed corpus directory)."""
+    return PackedDataset(
+        root_dir=cfg.root_dir,
+        img_sidelength=cfg.img_sidelength,
+        max_num_instances=cfg.max_num_instances,
+        max_observations_per_instance=cfg.max_observations_per_instance,
+        specific_observation_idcs=cfg.specific_observation_idcs,
+        samples_per_instance=cfg.samples_per_instance,
+        max_record_retries=cfg.max_record_retries,
+        shard_index=shard_index,
+        shard_count=shard_count,
+    )

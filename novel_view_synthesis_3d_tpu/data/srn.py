@@ -53,19 +53,21 @@ def glob_images(directory: str) -> List[str]:
     return sorted(set(paths))
 
 
-def parse_intrinsics(filepath: str, trgt_sidelength: Optional[int] = None):
-    """Parse SRN intrinsics.txt → (K 3×3 f32, barycenter, scale, world2cam).
+def parse_intrinsics_text(text: str,
+                          trgt_sidelength: Optional[int] = None):
+    """Parse SRN intrinsics.txt CONTENT — the packed-record backend stores
+    the raw text in its index and parses at read time, so the sidelength
+    rescale below stays a read-time decision and both backends share one
+    implementation (bit-identical K for any sidelength)."""
+    import io
 
-    Focal length and principal point are rescaled to the target sidelength:
-    cx·S/W, cy·S/H, f·S/H (reference util.py:64-67).
-    """
-    with open(filepath, "r") as fh:
-        f, cx, cy, _ = map(float, fh.readline().split())
-        barycenter = np.array(list(map(float, fh.readline().split())),
-                              dtype=np.float32)
-        scale = float(fh.readline())
-        height, width = map(float, fh.readline().split())
-        line5 = fh.readline().strip()
+    fh = io.StringIO(text)
+    f, cx, cy, _ = map(float, fh.readline().split())
+    barycenter = np.array(list(map(float, fh.readline().split())),
+                          dtype=np.float32)
+    scale = float(fh.readline())
+    height, width = map(float, fh.readline().split())
+    line5 = fh.readline().strip()
     try:
         world2cam = bool(int(line5))
     except ValueError:
@@ -79,6 +81,17 @@ def parse_intrinsics(filepath: str, trgt_sidelength: Optional[int] = None):
     K = np.array([[f, 0.0, cx], [0.0, f, cy], [0.0, 0.0, 1.0]],
                  dtype=np.float32)
     return K, barycenter, scale, world2cam
+
+
+def parse_intrinsics(filepath: str, trgt_sidelength: Optional[int] = None):
+    """Parse SRN intrinsics.txt → (K 3×3 f32, barycenter, scale, world2cam).
+
+    Focal length and principal point are rescaled to the target sidelength:
+    cx·S/W, cy·S/H, f·S/H (reference util.py:64-67).
+    """
+    with open(filepath, "r") as fh:
+        return parse_intrinsics_text(fh.read(),
+                                     trgt_sidelength=trgt_sidelength)
 
 
 def load_pose(filename: str) -> np.ndarray:
@@ -98,10 +111,14 @@ def square_center_crop(img: np.ndarray) -> np.ndarray:
     return img[ch - m // 2: ch + m // 2, cw - m // 2: cw + m // 2]
 
 
-def load_rgb(path: str, sidelength: Optional[int] = None) -> np.ndarray:
-    """Image → HWC float32 in [-1, 1]: decode, drop alpha, square-crop,
-    INTER_AREA resize (reference data_util.py:12-24 semantics)."""
-    img = np.asarray(Image.open(path).convert("RGB"), dtype=np.float32) / 255.0
+def decode_rgb(source, sidelength: Optional[int] = None) -> np.ndarray:
+    """Image (path OR file-like, e.g. BytesIO over packed-shard bytes) →
+    HWC float32 in [-1, 1]: decode, drop alpha, square-crop, INTER_AREA
+    resize (reference data_util.py:12-24 semantics). One implementation
+    for the file-walking and packed backends — the bit-identity contract
+    between them rests on sharing this exact decode chain."""
+    img = np.asarray(Image.open(source).convert("RGB"),
+                     dtype=np.float32) / 255.0
     img = square_center_crop(img)
     if sidelength is not None and img.shape[0] != sidelength:
         if _HAS_CV2:
@@ -112,6 +129,11 @@ def load_rgb(path: str, sidelength: Optional[int] = None) -> np.ndarray:
             pil = pil.resize((sidelength, sidelength), Image.BOX)
             img = np.asarray(pil, dtype=np.float32) / 255.0
     return (img - 0.5) * 2.0
+
+
+def load_rgb(path: str, sidelength: Optional[int] = None) -> np.ndarray:
+    """Image file → HWC float32 in [-1, 1] (see decode_rgb)."""
+    return decode_rgb(path, sidelength)
 
 
 def load_depth(path: str, sidelength: Optional[int] = None) -> np.ndarray:
@@ -177,21 +199,32 @@ def _subset(paths: List[str],
     return paths
 
 
-class SRNDataset:
-    """All instances of a class directory (reference SceneClassDataset,
-    data_loader.py:116-161), flat-indexed over (instance, view)."""
+class FlatViewDataset:
+    """Flat (instance, view) indexing, pair/group sampling, and fault
+    quarantine — the backend-independent half of the data plane.
 
-    def __init__(self, root_dir: str, img_sidelength: int = 64,
-                 max_num_instances: int = -1,
-                 max_observations_per_instance: int = -1,
-                 specific_observation_idcs: Optional[Sequence[int]] = None,
-                 samples_per_instance: int = 1,
+    Subclasses (SRNDataset walking files, records.PackedDataset reading
+    sharded records) populate `self.instances` with objects exposing
+    `__len__()`, `view(idx) -> (rgb HWC [-1,1], pose 4×4)`, `.K`, and
+    `.instance_dir`, then call `_finalize_index()`. Everything above that
+    surface — the cumulative-views offsets array with binary-search
+    `locate`, the rng-draw order of `pair`/`samples`, and the
+    quarantine-and-redraw ladder — is ONE shared implementation, which is
+    what makes `backend='packed'` batches bit-identical to
+    `backend='files'` for the same (seed, epoch, index).
+
+    `pair`/`samples` are split into a PLAN phase (consumes the rng,
+    touches no IO) and an ASSEMBLE phase (decodes the planned views,
+    consumes no rng): the compute-overlapped loader
+    (pipeline.PipelinedLoader) plans sequentially on the coordinator
+    thread and decodes on a worker pool without perturbing the random
+    stream."""
+
+    def __init__(self, samples_per_instance: int = 1,
                  max_record_retries: int = 3):
         if samples_per_instance < 1:
             raise ValueError(
                 f"samples_per_instance must be >= 1, got {samples_per_instance}")
-        self.root_dir = root_dir
-        self.img_sidelength = img_sidelength
         self.samples_per_instance = samples_per_instance
         # Data fault tolerance (safe_pair/safe_samples): records whose
         # image/pose failed to load, skipped for the rest of the run.
@@ -200,29 +233,12 @@ class SRNDataset:
         self.max_record_retries = max_record_retries
         self.quarantined: set = set()
         self.fault_reports: List[dict] = []
-        instance_dirs = sorted(glob(os.path.join(root_dir, "*/")))
-        if not instance_dirs:
-            raise FileNotFoundError(f"no instances under {root_dir!r}")
-        if max_num_instances != -1:
-            instance_dirs = instance_dirs[:max_num_instances]
+        self.instances: List = []
+        self.root_dir = ""
 
-        self.instances: List[SRNInstance] = []
-        for idx, d in enumerate(instance_dirs):
-            color = _subset(glob_images(os.path.join(d, "rgb")),
-                            specific_observation_idcs,
-                            max_observations_per_instance)
-            pose = _subset(sorted(glob(os.path.join(d, "pose", "*.txt"))),
-                           specific_observation_idcs,
-                           max_observations_per_instance)
-            if len(color) != len(pose):
-                raise ValueError(
-                    f"{d}: {len(color)} images vs {len(pose)} poses")
-            K, _, _, _ = parse_intrinsics(os.path.join(d, "intrinsics.txt"),
-                                          trgt_sidelength=img_sidelength)
-            self.instances.append(SRNInstance(
-                instance_idx=idx, instance_dir=d, color_paths=color,
-                pose_paths=pose, K=K, img_sidelength=img_sidelength))
-
+    def _finalize_index(self) -> None:
+        """Cumulative-views array over self.instances: one O(num_instances)
+        pass at init, then every locate() is a binary search."""
         self._sizes = np.array([len(i) for i in self.instances])
         self._offsets = np.concatenate([[0], np.cumsum(self._sizes)])
 
@@ -234,37 +250,58 @@ class SRNDataset:
         return len(self.instances)
 
     def locate(self, flat_idx: int) -> Tuple[int, int]:
-        """flat index → (instance_idx, view_idx) via binary search (the
-        reference does a linear scan per item, data_loader.py:153-161)."""
+        """flat index → (instance_idx, view_idx) via binary search over the
+        precomputed cumulative-views array (the reference does a linear
+        scan over instances per item, data_loader.py:153-161 — O(N) per
+        fetch, ruinous at production instance counts)."""
         obj = int(np.searchsorted(self._offsets, flat_idx, side="right") - 1)
         return obj, int(flat_idx - self._offsets[obj])
 
-    def pair(self, flat_idx: int, rng: np.random.Generator,
-             num_cond: int = 1) -> dict:
-        """One training record: clean cond view(s) + a random clean target
-        view of the same instance, with poses + intrinsics.
+    def live_indices(self) -> np.ndarray:
+        """Flat indices NOT quarantined (the pipelined loader's sample
+        space — with nothing quarantined this is arange(len))."""
+        if not self.quarantined:
+            return np.arange(len(self), dtype=np.int64)
+        return np.array([i for i in range(len(self))
+                         if i not in self.quarantined], dtype=np.int64)
 
-        num_cond=1 matches the reference's per-item semantics
-        (data_loader.py:80-113: item idx = conditioning view, uniformly
-        random second view = target) minus the CPU-side noising, which lives
-        on device now. num_cond>1 (3DiM k>1 training) keeps the indexed view
-        as the first conditioning frame and draws the rest uniformly; frames
-        are stacked on a leading axis (x (Fc,H,W,3), R1 (Fc,3,3), t1 (Fc,3)).
-        """
+    # -- plan phase (rng only, no IO) ----------------------------------
+    def _plan_pair(self, flat_idx: int, rng: np.random.Generator,
+                   num_cond: int = 1) -> tuple:
+        """Consume exactly `pair`'s rng draws and return the decode plan
+        (obj, target_view, cond_views). Decoding consumes no randomness,
+        so plan-then-assemble is bit-identical to the inline path."""
         faultinject.maybe_raise_record(int(flat_idx))
         obj, view = self.locate(flat_idx)
-        inst = self.instances[obj]
         view2 = self._draw_view(obj, rng)
-        target, pose2 = inst.view(view2)
         cond_views = [view] + [self._draw_view(obj, rng)
                                for _ in range(num_cond - 1)]
+        return (obj, view2, cond_views)
+
+    def _plan_samples(self, flat_idx: int, rng: np.random.Generator,
+                      num_cond: int = 1) -> List[tuple]:
+        """Plan-phase twin of `samples` — same rng call order (pair draws,
+        then each sibling's index draw followed by its pair draws)."""
+        plans = [self._plan_pair(flat_idx, rng, num_cond=num_cond)]
+        obj, _ = self.locate(flat_idx)
+        base = int(self._offsets[obj])
+        for _ in range(self.samples_per_instance - 1):
+            v = int(rng.integers(len(self.instances[obj])))
+            plans.append(self._plan_pair(base + v, rng, num_cond=num_cond))
+        return plans
+
+    # -- assemble phase (IO only, no rng) ------------------------------
+    def _assemble_pair(self, plan: tuple) -> dict:
+        obj, view2, cond_views = plan
+        inst = self.instances[obj]
+        target, pose2 = inst.view(view2)
         xs, R1s, t1s = [], [], []
         for v in cond_views:
             x, pose1 = inst.view(v)
             xs.append(x.astype(np.float32))
             R1s.append(pose1[:3, :3])
             t1s.append(pose1[:3, 3])
-        if num_cond == 1:
+        if len(cond_views) == 1:
             x_out, R1_out, t1_out = xs[0], R1s[0], t1s[0]
         else:
             x_out = np.stack(xs)
@@ -279,6 +316,21 @@ class SRNDataset:
             "t2": pose2[:3, 3],
             "K": inst.K,
         }
+
+    def pair(self, flat_idx: int, rng: np.random.Generator,
+             num_cond: int = 1) -> dict:
+        """One training record: clean cond view(s) + a random clean target
+        view of the same instance, with poses + intrinsics.
+
+        num_cond=1 matches the reference's per-item semantics
+        (data_loader.py:80-113: item idx = conditioning view, uniformly
+        random second view = target) minus the CPU-side noising, which lives
+        on device now. num_cond>1 (3DiM k>1 training) keeps the indexed view
+        as the first conditioning frame and draws the rest uniformly; frames
+        are stacked on a leading axis (x (Fc,H,W,3), R1 (Fc,3,3), t1 (Fc,3)).
+        """
+        return self._assemble_pair(
+            self._plan_pair(flat_idx, rng, num_cond=num_cond))
 
     def samples(self, flat_idx: int, rng: np.random.Generator,
                 num_cond: int = 1) -> List[dict]:
@@ -327,15 +379,10 @@ class SRNDataset:
         return int(allowed[int(rng.integers(len(allowed)))])
 
     def _locate_failing_record(self, msg: str) -> Optional[int]:
-        """Flat index of the record whose image/pose path appears in an
-        error message, or None. Lets the quarantine hit the file that
-        actually failed even when it was a randomly-drawn sibling of the
-        indexed record. O(records) — fault-path only."""
-        for obj, inst in enumerate(self.instances):
-            for v, (c, p) in enumerate(zip(inst.color_paths,
-                                           inst.pose_paths)):
-                if c in msg or p in msg:
-                    return int(self._offsets[obj]) + v
+        """Flat index of the record an error message names, or None.
+        Backend-specific (the file walker matches paths, the packed reader
+        tags its exceptions with .flat_index instead)."""
+        del msg
         return None
 
     def _quarantine(self, flat_idx: int, exc: Exception) -> None:
@@ -364,10 +411,14 @@ class SRNDataset:
                     # Quarantine the record whose FILE failed (it may be a
                     # randomly-drawn sibling view, not the indexed record);
                     # fall back to the index when the error names no known
-                    # path. Subsequent random view draws avoid quarantined
+                    # record. Subsequent random view draws avoid quarantined
                     # views (_draw_view), so the retry below can succeed on
-                    # the same index.
-                    failed = self._locate_failing_record(str(exc))
+                    # the same index. Packed-record errors carry the flat
+                    # index directly (records.PackedDataset tags them);
+                    # the file walker falls back to a path scan.
+                    failed = getattr(exc, "flat_index", None)
+                    if failed is None:
+                        failed = self._locate_failing_record(str(exc))
                     self._quarantine(idx if failed is None else failed, exc)
                     if failed is not None and failed != idx:
                         continue  # same index, bad sibling now avoided
@@ -392,3 +443,56 @@ class SRNDataset:
         records from one instance) holds even through a fault."""
         return self._safe_fetch(
             lambda i: self.samples(i, rng, num_cond=num_cond), flat_idx, rng)
+
+
+class SRNDataset(FlatViewDataset):
+    """All instances of a class directory (reference SceneClassDataset,
+    data_loader.py:116-161), flat-indexed over (instance, view) — the
+    file-walking backend (`data.backend='files'`). The packed-record
+    backend (records.PackedDataset) shares every sampling/quarantine
+    semantic through FlatViewDataset."""
+
+    def __init__(self, root_dir: str, img_sidelength: int = 64,
+                 max_num_instances: int = -1,
+                 max_observations_per_instance: int = -1,
+                 specific_observation_idcs: Optional[Sequence[int]] = None,
+                 samples_per_instance: int = 1,
+                 max_record_retries: int = 3):
+        super().__init__(samples_per_instance=samples_per_instance,
+                         max_record_retries=max_record_retries)
+        self.root_dir = root_dir
+        self.img_sidelength = img_sidelength
+        instance_dirs = sorted(glob(os.path.join(root_dir, "*/")))
+        if not instance_dirs:
+            raise FileNotFoundError(f"no instances under {root_dir!r}")
+        if max_num_instances != -1:
+            instance_dirs = instance_dirs[:max_num_instances]
+
+        for idx, d in enumerate(instance_dirs):
+            color = _subset(glob_images(os.path.join(d, "rgb")),
+                            specific_observation_idcs,
+                            max_observations_per_instance)
+            pose = _subset(sorted(glob(os.path.join(d, "pose", "*.txt"))),
+                           specific_observation_idcs,
+                           max_observations_per_instance)
+            if len(color) != len(pose):
+                raise ValueError(
+                    f"{d}: {len(color)} images vs {len(pose)} poses")
+            K, _, _, _ = parse_intrinsics(os.path.join(d, "intrinsics.txt"),
+                                          trgt_sidelength=img_sidelength)
+            self.instances.append(SRNInstance(
+                instance_idx=idx, instance_dir=d, color_paths=color,
+                pose_paths=pose, K=K, img_sidelength=img_sidelength))
+        self._finalize_index()
+
+    def _locate_failing_record(self, msg: str) -> Optional[int]:
+        """Flat index of the record whose image/pose path appears in an
+        error message, or None. Lets the quarantine hit the file that
+        actually failed even when it was a randomly-drawn sibling of the
+        indexed record. O(records) — fault-path only."""
+        for obj, inst in enumerate(self.instances):
+            for v, (c, p) in enumerate(zip(inst.color_paths,
+                                           inst.pose_paths)):
+                if c in msg or p in msg:
+                    return int(self._offsets[obj]) + v
+        return None

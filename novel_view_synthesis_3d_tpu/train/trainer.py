@@ -196,11 +196,19 @@ class Trainer:
 
         # --- data ---
         self._native_loader = None
+        self._packed_loader = None
         if data_iter is not None:
             self.data_iter = data_iter
             self.dataset = None
         else:
-            self.dataset = make_dataset(config.data)
+            self.dataset = make_dataset(
+                config.data,
+                # Packed backend: per-host reads at shard granularity —
+                # this process opens only its 1/process_count() slice of
+                # the shard set (files backend ignores the kwargs; its
+                # sharding happens at the index-sampler level).
+                shard_index=jax.process_index(),
+                shard_count=jax.process_count())
             assert len(self.dataset) > 0
             local_bs = dist.local_batch_size(tcfg.batch_size)
             num_cond = config.model.num_cond_frames
@@ -214,11 +222,27 @@ class Trainer:
                     f"processes) is not divisible by "
                     f"data.samples_per_instance={spi}")
             # Instance-grouped sampling (samples_per_instance > 1) is
-            # implemented by all three backends: in-process iterator,
-            # Grain (grouped transform + flatten), and the native loader
-            # (grouped claims in C++) — no fallback needed.
+            # implemented by all backends: in-process iterator, Grain
+            # (grouped transform + flatten), the native loader (grouped
+            # claims in C++), and the packed pipelined loader (grouped
+            # plans) — no fallback needed.
             backend = config.data.loader if use_grain else "python"
-            if backend == "native":
+            if config.data.backend == "packed":
+                # Compute-overlapped pipelined loader (decode worker pool
+                # feeding the _DevicePrefetcher below); `loader`/use_grain
+                # govern the files backend only.
+                from novel_view_synthesis_3d_tpu.data.pipeline import (
+                    make_packed_loader)
+
+                self._packed_loader = make_packed_loader(
+                    self.dataset, local_bs,
+                    seed=config.data.shuffle_seed,
+                    shard_index=jax.process_index(),
+                    num_cond=num_cond,
+                    workers=config.data.num_workers,
+                    depth=config.data.prefetch)
+                self.data_iter = iter(self._packed_loader)
+            elif backend == "native":
                 from novel_view_synthesis_3d_tpu.data import native_io
                 if native_io.available():
                     self._native_loader = native_io.make_native_loader(
@@ -232,7 +256,9 @@ class Trainer:
                     self.data_iter = iter(self._native_loader)
                 else:
                     backend = "grain"  # graceful fallback
-            if backend == "grain" and config.data.num_workers > 0:
+            if self._packed_loader is not None:
+                pass  # data_iter already set above
+            elif backend == "grain" and config.data.num_workers > 0:
                 loader = make_grain_loader(
                     self.dataset, local_bs,
                     seed=config.data.shuffle_seed,

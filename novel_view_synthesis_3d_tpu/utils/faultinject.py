@@ -31,6 +31,14 @@ Injection points:
                             child sleeps forever (wedged-tunnel drill);
   NVS3D_FI_PROBE_FAIL       "1": the probe child exits non-zero instead
                             (dead-backend drill, no timeout burn).
+  NVS3D_FI_CORRUPT_SHARD_AT comma list of packed-shard ordinals; the
+                            packed-record reader (data/records.py) sees a
+                            FLIPPED BYTE in those shards' streams at open
+                            (sha256 mismatch → shard quarantined). The
+                            mutation is in-memory — disk is untouched.
+  NVS3D_FI_TRUNCATE_SHARD_AT same, but the stream is cut in half (torn
+                            tail → end marker missing → quarantined),
+                            the shape a host dying mid-write leaves.
 
 plus `truncate_checkpoint`, a direct helper that corrupts an on-disk Orbax
 step the way a mid-write preemption does (the checkpoint-fallback drill).
@@ -89,6 +97,31 @@ def maybe_sigterm(step: int) -> bool:
         os.environ.pop("NVS3D_FI_SIGTERM_AT", None)
         return True
     return False
+
+
+def corrupt_shard_ordinals() -> Tuple[int, ...]:
+    """Packed-shard ordinals whose open-time stream gets a flipped byte."""
+    return _int_list("NVS3D_FI_CORRUPT_SHARD_AT")
+
+
+def truncate_shard_ordinals() -> Tuple[int, ...]:
+    """Packed-shard ordinals whose open-time stream is torn (truncated)."""
+    return _int_list("NVS3D_FI_TRUNCATE_SHARD_AT")
+
+
+def maybe_corrupt_shard_bytes(ordinal: int, data: bytes) -> bytes:
+    """Hook for the packed-record reader (data/records.py): mutate shard
+    `ordinal`'s byte stream AS READ at open. Truncation halves the stream
+    (a torn tail — the end marker vanishes); corruption XORs one middle
+    byte (the sha256 re-hash catches it). Disk is never touched, so the
+    same corpus serves clean runs and drills; with neither env var set
+    the stream passes through untouched."""
+    if ordinal in truncate_shard_ordinals():
+        data = data[: len(data) // 2]
+    if ordinal in corrupt_shard_ordinals() and data:
+        i = len(data) // 2
+        data = data[:i] + bytes([data[i] ^ 0x01]) + data[i + 1:]
+    return data
 
 
 _STALL_ENVS = {

@@ -127,12 +127,46 @@ def telemetry_rows(search_dirs):
                 continue
             phases = {}
             for name, durs in sorted(spans.items()):
+                total = sum(durs)
                 durs.sort()
                 phases[name] = (len(durs), _pctl(durs, 0.5),
-                                _pctl(durs, 0.9), _pctl(durs, 0.99))
+                                _pctl(durs, 0.9), _pctl(durs, 0.99),
+                                total)
             if phases or peak_bytes or versions:
                 rows.append((path, phases, peak_bytes, versions, swaps))
     return rows
+
+
+def input_pipeline_lines(telem):
+    """Input-pipeline health per run: data_fetch percentiles against
+    train_step, plus the overlap ratio — the fraction of total fetch time
+    hidden behind device compute (1.0 = the loader never sat on the step
+    loop's critical path; the packed-backend acceptance target is
+    data_fetch p99 < 10% of train_step p50). data_fetch spans run on the
+    prefetcher thread, so fetch/step = producer duty cycle, and
+    overlap = 1 − Σfetch/Σstep clamped to [0, 1]."""
+    lines = ["", "## Input pipeline (data_fetch vs train_step, "
+                 "from telemetry.jsonl)", ""]
+    rows = []
+    for path, phases, _peak, _versions, _swaps in telem:
+        fetch = phases.get("data_fetch")
+        step = phases.get("train_step")
+        if not fetch or not step or step[1] <= 0:
+            continue
+        ratio = fetch[3] / step[1]  # fetch p99 / step p50
+        overlap = max(0.0, 1.0 - fetch[4] / step[4]) if step[4] else 0.0
+        rows.append((path, fetch, step, ratio, overlap))
+    if not rows:
+        return []
+    lines += ["| run | fetch p50 | fetch p99 | step p50 | "
+              "p99(fetch)/p50(step) | overlap |",
+              "|---|---|---|---|---|---|"]
+    for path, fetch, step, ratio, overlap in rows:
+        lines.append(
+            "| `{}` | {:.1f}ms | {:.1f}ms | {:.1f}ms | {:.1%} | {:.1%} |"
+            .format(path, fetch[1] * 1e3, fetch[3] * 1e3, step[1] * 1e3,
+                    ratio, overlap))
+    return lines
 
 
 def continuous_lines(rows):
@@ -278,7 +312,7 @@ def main() -> int:
             peak = (f" peak_device_bytes={peak_bytes / 1e9:.2f}G"
                     if peak_bytes else "")
             lines.append(f"- `{path}`:{peak}")
-            for name, (n, p50, p90, p99) in phases.items():
+            for name, (n, p50, p90, p99, _total) in phases.items():
                 lines.append(
                     f"  - {name}: n={n} p50={p50 * 1e3:.1f}ms "
                     f"p90={p90 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms")
@@ -290,6 +324,9 @@ def main() -> int:
                     f"(swaps={swaps})")
     else:
         lines.append("- none recorded")
+    # Input-pipeline health: did the loader ever sit on the step loop's
+    # critical path (data_fetch vs train_step, overlap ratio)?
+    lines += input_pipeline_lines(telem)
     text = "\n".join(lines) + "\n"
     print(text)
     if "--write" in sys.argv:
