@@ -762,6 +762,11 @@ def main():
     # but BENCH_r* rounds comparing loader changes need the label).
     result["data_backend"] = cfg.data.backend
     result["data_loader"] = cfg.data.loader
+    # Serving-precision / fused-step attribution (PR 8): the judged loop
+    # is the TRAIN step, but BENCH_r* rounds comparing serving-lane
+    # changes need every record to say what the config would deploy.
+    result["precision"] = cfg.serve.precision
+    result["fused_step"] = cfg.diffusion.fused_step
     if flops:
         # Peak table lives in obs/devmon.py (one home — the trainer's MFU
         # gauge reads the same numbers). Unknown kinds report raw

@@ -340,6 +340,17 @@ def cmd_serve(args, overrides: List[str]) -> int:
         "R2": pose0[None, :3, :3], "t2": pose0[None, :3, 3],
         "K": inst0.K[None],
     })
+    # int8-requires-registry-staging: a quantized deployment serves
+    # gate-probed registry versions only (the PSNR gate scores candidates
+    # AT the serving precision, so quantization loss is part of what the
+    # gate_margin_db admitted) — a raw checkpoint has no such lineage.
+    if cfg.serve.precision == "int8" and not args.registry:
+        raise SystemExit(
+            "serve.precision='int8' requires --registry: quantized "
+            "serving only deploys versions whose promotion gate probed "
+            "them at int8 (registry/gate.py) — serve a checkpoint at "
+            "'float32'/'bfloat16', or publish + promote it first "
+            "(nvs3d registry publish/promote)")
     # Weights: either a checkpoint (the pre-registry path) or a registry
     # channel subscription — the service then HOT-RELOADS whenever the
     # channel pointer moves (registry/watcher.py), with zero downtime.
@@ -758,7 +769,8 @@ def cmd_distill(args, overrides: List[str]) -> int:
             model, cfg.diffusion,
             _gate_probe_batch(cfg, args.folder),
             sample_steps=final.student_steps,
-            seed=cfg.registry.gate_seed)
+            seed=cfg.registry.gate_seed,
+            precision=cfg.serve.precision)
         try:
             gate = run_gate(store, final.version,
                             channel=args.promote_channel, probe_fn=probe,
@@ -915,11 +927,15 @@ def cmd_registry(args, overrides: List[str]) -> int:
             from novel_view_synthesis_3d_tpu.registry import (
                 make_psnr_probe)
 
+            # Probe AT the serving precision (serve.precision): a
+            # version promoted into a bf16/int8 deployment is gated on
+            # what that deployment actually computes with.
             probe = make_psnr_probe(
                 XUNet(cfg.model), cfg.diffusion,
                 _gate_probe_batch(cfg, args.folder),
                 sample_steps=cfg.registry.gate_sample_steps,
-                seed=cfg.registry.gate_seed)
+                seed=cfg.registry.gate_seed,
+                precision=cfg.serve.precision)
             try:
                 gate_result = run_gate(
                     store, vid, channel=channel, probe_fn=probe,

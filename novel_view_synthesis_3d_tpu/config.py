@@ -120,6 +120,19 @@ class DiffusionConfig:
     # (sample_timesteps 25–50 instead of 256+).
     sampler: str = "ddpm"
     ddim_eta: float = 0.0
+    # Fused Pallas denoise-step kernel (ops/fused_step.py): everything
+    # after the UNet forward — CFG combine, x̂₀ reconstruction + clip,
+    # the ddpm/ddim update, the noise add — runs as ONE kernel call per
+    # step instead of ~a dozen elementwise HLOs, consuming the per-row
+    # (B, K) schedule-coefficient matrix as device arguments. Honored by
+    # the serving samplers (sample/ddpm.make_request_sampler and
+    # make_slot_step_fn — both serve.scheduler values share it). "auto"
+    # enables it on TPU backends only; True forces it (interpret mode
+    # off-TPU: exact, slow — the tier-1 parity path); False keeps the
+    # unfused chain. dpm++ 2M cannot fuse (multistep history): True
+    # errors, 'auto' falls back to the unfused scan ('request'
+    # scheduler) / the first-order fallback fuses fine ('step').
+    fused_step: Any = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -315,6 +328,15 @@ class TrainConfig:
     # Rollback budget: after this many rollbacks the run aborts loudly
     # instead of thrashing between a poisoned basin and the checkpoint.
     max_rollbacks: int = 2
+    # Remat override for the TRAINING build of the model: '' (default) =
+    # inherit model.remat; otherwise one of model.remat's values
+    # (False/'none', True/'full', 'dots') applied to the XUNet blocks
+    # for the train step only. Lets one config train with
+    # rematerialization (activation memory bound) while sampling/serving
+    # build the same checkpoint-compatible model without it (forward-only
+    # paths gain nothing from remat) — the remat/donation tuning knob of
+    # ROADMAP item 5.
+    remat: Any = ""
     # --- hang/stall robustness (docs/DESIGN.md "Stall recovery") ---
     # Heartbeat watchdog over the run's phases (utils/watchdog.py).
     watchdog: WatchdogConfig = dataclasses.field(
@@ -366,6 +388,19 @@ class ServeConfig:
     # Respaced reverse-process steps for served requests; 0 = use
     # diffusion.sample_timesteps.
     sample_steps: int = 0
+    # Serving precision (sample/precision.py): what the service/watcher
+    # put ON DEVICE at weight-stage time. 'float32' = weights as
+    # published (exact, the default); 'bfloat16' = every float leaf cast
+    # to bf16 (half the HBM residency/transfer, flax promotes on-chip);
+    # 'int8' = per-channel symmetric weight-only int8 for conv/dense
+    # kernels with f32 scales, bf16 elsewhere — the sampler program
+    # dequantizes in-jit so weights REST quantized. The program-cache
+    # key folds precision in, and the registry gate probes candidates AT
+    # this precision so quantization loss counts against
+    # registry.gate_margin_db. int8 requires registry staging: the
+    # quantized deployment must serve gate-probed registry versions
+    # (`nvs3d serve --registry`), never raw checkpoints.
+    precision: str = "float32"
     # Where the service writes its events.csv (rejections, deadline
     # expiries) — same schema as the trainer's.
     results_folder: str = "./serve"
@@ -664,6 +699,12 @@ class Config:
             errors.append(
                 f"train.probe_dtype={t.probe_dtype!r} must be '' (param "
                 "dtype), 'float32', or 'bfloat16'")
+        if t.remat not in ("", False, True, "none", "full", "dots"):
+            errors.append(
+                f"train.remat={t.remat!r} must be '' (inherit "
+                "model.remat), False/'none', True/'full', or 'dots' — it "
+                "overrides the checkpoint policy over XUNet blocks for "
+                "the training build only")
         if t.ema_host and t.ema_decay <= 0:
             errors.append(
                 "train.ema_host=True is inert without train.ema_decay > 0")
@@ -740,6 +781,41 @@ class Config:
                 f"serve.sample_steps={sv.sample_steps} must be in "
                 f"[0, diffusion.timesteps={self.diffusion.timesteps}] "
                 "(0 = diffusion.sample_timesteps)")
+        if sv.precision not in ("float32", "bfloat16", "int8"):
+            # Mirrors the train.adam_mu_dtype style: enum membership with
+            # the semantics in the message (CLI overrides arrive as raw
+            # strings — a typo must fail loudly, not serve f32 silently).
+            errors.append(
+                f"serve.precision={sv.precision!r} must be 'float32' "
+                "(weights as published), 'bfloat16' (cast at stage "
+                "time), or 'int8' (per-channel symmetric weight-only "
+                "quantization, f32 scales, bf16 elsewhere)")
+        elif sv.precision == "int8" and not self.registry.dir:
+            # int8-requires-registry-staging: a quantized deployment must
+            # serve gate-probed registry versions (the gate probes AT the
+            # serving precision), never raw checkpoints with no
+            # quality-gate lineage. `nvs3d serve` enforces the --registry
+            # flag itself; this catches configs that disarm the registry
+            # entirely.
+            errors.append(
+                "serve.precision='int8' requires registry staging "
+                "(registry.dir must be set): quantized serving only "
+                "deploys versions whose PSNR gate probed them at int8 "
+                "(registry/gate.py), so quantization loss counts "
+                "against registry.gate_margin_db")
+        fs = self.diffusion.fused_step
+        if fs not in (True, False, "auto"):
+            errors.append(
+                f"diffusion.fused_step={fs!r} must be True, False, or "
+                "'auto' (the fused Pallas denoise-step kernel, "
+                "ops/fused_step.py; 'auto' = TPU backends only)")
+        elif fs is True and self.diffusion.sampler == "dpm++":
+            errors.append(
+                "diffusion.fused_step=True requires sampler 'ddpm' or "
+                "'ddim' — dpm++ 2M carries x̂₀ history across steps and "
+                "cannot run as one fused step (use 'auto' to fuse where "
+                "possible; the step scheduler's first-order dpm++ "
+                "fallback still fuses)")
         rg = self.registry
         if rg.publish_every < 0:
             errors.append(

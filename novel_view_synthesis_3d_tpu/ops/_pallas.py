@@ -1,0 +1,54 @@
+"""Shared Pallas-kernel plumbing for ops/.
+
+Every kernel in this package carries the same off-TPU contract: the
+IDENTICAL kernel code path runs through the Pallas interpreter on CPU
+(so tier-1 exercises the real kernel, not a shadow implementation), the
+config flag that enables it resolves 'auto' → TPU-only, and slab-sized
+kernels bound their VMEM residency and fall back to XLA above it. Those
+three pieces were duplicated between ops/flash_attention.py and
+ops/fused_groupnorm.py; this module is their one home, and new kernels
+(ops/fused_step.py) use it from day one.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Conservative per-program VMEM budget for a kernel's resident input
+# slab(s). v5e has ~16 MB VMEM/core and a kernel typically also holds an
+# f32 working copy (2-4x the slab), f32 intermediates, and the output —
+# a 3 MiB input slab bounds the worst case at ~12 MiB. Strict `<` in
+# fits_vmem so power-of-two slab sizes (every UNet level is one) can't
+# sit on a zero-headroom boundary.
+SLAB_LIMIT_BYTES = 3 * 1024 * 1024
+
+
+def use_interpret() -> bool:
+    """True off-TPU: run the kernel through the Pallas interpreter.
+
+    This is how tier-1 (JAX_PLATFORMS=cpu) executes the exact same
+    kernel code path the TPU compiles — correctness is proven on the
+    bits that ship, not on an XLA stand-in."""
+    return jax.default_backend() != "tpu"
+
+
+def resolve_flag(flag, field: str) -> bool:
+    """Resolve an 'auto' | bool kernel-enable config value.
+
+    'auto' → the Pallas kernel on TPU backends (where it is compiled
+    and fast), the XLA path elsewhere (interpreted Pallas on CPU is
+    correct but slow). Booleans pass through; anything else is an
+    error — CLI overrides arrive as raw strings, and silently coercing
+    a typo like 'False' to truthy would force interpret-mode Pallas on
+    CPU. `field` names the config knob in the error message."""
+    if flag == "auto":
+        return not use_interpret()
+    if isinstance(flag, bool):
+        return flag
+    raise ValueError(
+        f"{field} must be True, False, or 'auto'; got {flag!r}")
+
+
+def fits_vmem(nbytes: int, limit: int = SLAB_LIMIT_BYTES) -> bool:
+    """True if a per-program input slab of `nbytes` fits the budget."""
+    return nbytes < limit

@@ -40,6 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from novel_view_synthesis_3d_tpu.ops import _pallas
+
 try:  # pltpu only imports on TPU-capable jaxlibs; interpret mode needs pl only
     from jax.experimental.pallas import tpu as pltpu
     _VMEM = pltpu.VMEM
@@ -119,24 +121,13 @@ def _flash_fwd_padded(q, k, v, *, scale: float, kv_len: int, block_q: int,
 
 
 def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    return _pallas.use_interpret()
 
 
 def resolve_flash(flag) -> bool:
-    """Resolve a use_flash_attention config value.
-
-    'auto' → the Pallas kernel on TPU backends (where it's compiled and
-    faster), the XLA attention path elsewhere (where the kernel would run in
-    the interpreter). Booleans pass through; anything else is an error —
-    CLI overrides arrive as raw strings, and silently coercing a typo like
-    'False' to truthy would force interpret-mode Pallas on CPU.
-    """
-    if flag == "auto":
-        return not _use_interpret()
-    if isinstance(flag, bool):
-        return flag
-    raise ValueError(
-        f"use_flash_attention must be True, False, or 'auto'; got {flag!r}")
+    """Resolve a use_flash_attention config value ('auto' | bool);
+    see ops/_pallas.resolve_flag for the shared semantics."""
+    return _pallas.resolve_flag(flag, "use_flash_attention")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

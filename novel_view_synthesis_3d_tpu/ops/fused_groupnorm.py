@@ -33,38 +33,28 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-# Conservative per-program VMEM budget for the input slab. v5e has ~16 MB
-# VMEM/core and the kernel also holds the f32 working copy (2-4× the slab),
-# f32 intermediates, and the output: a 3 MiB input slab bounds the total at
-# ~12 MiB worst-case. Strict `<` so power-of-two slab sizes (every UNet
-# level is one) can't sit on a zero-headroom boundary: base128's top level
-# (128·128·128 bf16 = 4 MiB) falls back to XLA; its 64²·256 and lower
-# levels (≤2 MiB) fuse.
-_SLAB_LIMIT_BYTES = 3 * 1024 * 1024
+from novel_view_synthesis_3d_tpu.ops import _pallas
+
+# Shared per-program VMEM slab budget (ops/_pallas.py): a 3 MiB input
+# slab bounds the kernel's worst case at ~12 MiB on a ~16 MB/core part.
+# base128's top level (128·128·128 bf16 = 4 MiB) falls back to XLA; its
+# 64²·256 and lower levels (≤2 MiB) fuse.
+_SLAB_LIMIT_BYTES = _pallas.SLAB_LIMIT_BYTES
 
 
 def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    return _pallas.use_interpret()
 
 
 def resolve_fused_gn(flag) -> bool:
-    """Resolve a use_fused_groupnorm config value ('auto' | bool).
-
-    'auto' → the Pallas kernel on TPU backends, XLA elsewhere (interpreted
-    Pallas on CPU is correct but slow). Raw strings other than 'auto' are
-    an error — CLI overrides must not silently coerce.
-    """
-    if flag == "auto":
-        return not _use_interpret()
-    if isinstance(flag, bool):
-        return flag
-    raise ValueError(
-        f"use_fused_groupnorm must be True, False, or 'auto'; got {flag!r}")
+    """Resolve a use_fused_groupnorm config value ('auto' | bool);
+    see ops/_pallas.resolve_flag for the shared semantics."""
+    return _pallas.resolve_flag(flag, "use_fused_groupnorm")
 
 
 def fits_vmem(hw: int, c: int, dtype) -> bool:
     """True if one (H·W, C) slab fits the kernel's VMEM budget."""
-    return hw * c * jnp.dtype(dtype).itemsize < _SLAB_LIMIT_BYTES
+    return _pallas.fits_vmem(hw * c * jnp.dtype(dtype).itemsize)
 
 
 def _gn_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref,

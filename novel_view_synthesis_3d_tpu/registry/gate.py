@@ -14,6 +14,11 @@ The probe is a tripwire, not a benchmark: a handful of rows at a few
 reverse steps, sized to catch "the new checkpoint is broken" (NaN-poisoned
 EMA, truncated payload, wrong lineage), not half-dB quality drift — the
 full `eval` CLI remains the measurement instrument.
+
+The probe scores candidates AT THE SERVING PRECISION
+(`make_psnr_probe(precision=...)` = `serve.precision`): a bf16/int8
+deployment's quantization loss is part of what ships, so it counts
+against `registry.gate_margin_db` like any other regression.
 """
 
 from __future__ import annotations
@@ -68,13 +73,21 @@ def decide(candidate_psnr: float, incumbent_psnr: Optional[float],
 
 
 def make_psnr_probe(model, diffusion, batch: dict, *,
-                    sample_steps: int, seed: int = 0):
+                    sample_steps: int, seed: int = 0,
+                    precision: str = "float32"):
     """probe(params) -> mean PSNR (dB) of sampled vs ground-truth targets.
 
     One jitted sampler closure serves both the candidate and the
     incumbent (params are an argument, so scoring two versions costs zero
     extra compiles — the same property the serving hot-swap leans on),
-    and the fixed key means both see bit-identical noise."""
+    and the fixed key means both see bit-identical noise.
+
+    `precision` stages BOTH versions' weights exactly the way the
+    serving path would (sample/precision.py: bf16 cast / weight-only
+    int8 quantize→dequantize) before scoring, so quantization loss
+    counts against the gate margin — a candidate that only looks good
+    in f32 cannot be promoted into a bf16/int8 deployment. Pass the
+    deployment's `serve.precision` here (the CLI promote path does)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -82,8 +95,11 @@ def make_psnr_probe(model, diffusion, batch: dict, *,
     from novel_view_synthesis_3d_tpu.diffusion.schedules import (
         sampling_schedule)
     from novel_view_synthesis_3d_tpu.eval.metrics import psnr
+    from novel_view_synthesis_3d_tpu.sample import (
+        precision as precision_lib)
     from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
 
+    precision_lib.validate_precision(precision)
     sampler = make_sampler(model, sampling_schedule(diffusion, sample_steps),
                            diffusion)
     cond = {k: jnp.asarray(batch[k])
@@ -91,8 +107,19 @@ def make_psnr_probe(model, diffusion, batch: dict, *,
     truth = np.asarray(batch["target"])
     key = jax.random.PRNGKey(seed)
 
+    def stage(params):
+        staged = precision_lib.stage_params(params, precision)
+        if precision == "int8":
+            # Dequantize eagerly: the probe measures the NUMERICAL
+            # effect of serving at int8 (the dequantized bf16 weights
+            # are bit-identical to what the serving program computes
+            # with), not the memory layout.
+            staged = precision_lib.make_resolver("int8")(staged)
+        return staged
+
     def probe(params) -> float:
-        imgs = np.asarray(jax.device_get(sampler(params, key, cond)))
+        imgs = np.asarray(jax.device_get(
+            sampler(stage(params), key, cond)))
         return float(np.mean(np.asarray(psnr(imgs, truth))))
 
     return probe

@@ -3,11 +3,13 @@
 A serving process subscribes to one registry channel (`stable` in
 production). This thread polls the pointer every `registry.poll_s`
 seconds; when it moves, the new version is hash-VERIFIED, loaded to host,
-and handed to `SamplingService.swap_params`, which stages the tree on the
-mesh alongside the live one and flips between dispatches — requests in
-flight finish on the version they started on, warm sampler programs
-survive (the program cache is keyed on shapes, not params), and the old
-tree is freed after the flip.
+and handed to `SamplingService.swap_params`, which stages the tree AT THE
+SERVING PRECISION (sample/precision.py: the published f32 payload is cast
+to bf16 or weight-only-int8-quantized on host before upload, per
+`serve.precision`) on the mesh alongside the live one and flips between
+dispatches — requests in flight finish on the version they started on,
+warm sampler programs survive (the program cache is keyed on
+shapes/precision, not params), and the old tree is freed after the flip.
 
 Failure policy: a version that fails verification or staging is logged
 (`swap_fail` event) and BLACKLISTED until the pointer moves again — the

@@ -36,16 +36,16 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from novel_view_synthesis_3d_tpu.config import DiffusionConfig
 from novel_view_synthesis_3d_tpu.diffusion.schedules import DiffusionSchedule
 from novel_view_synthesis_3d_tpu.models.xunet import precompute_pose_embs
+from novel_view_synthesis_3d_tpu.ops import fused_step as fused_step_lib
 
 
-def _cfg_eps(model, params, model_batch: dict, w: float,
-             pose_embs=None):
-    """(guided, conditional) network outputs; CFG via one doubled-batch
-    forward. The conditional output rides along for cfg_rescale.
+def _raw_eps(model, params, model_batch: dict, pose_embs=None):
+    """(ε̂_cond, ε̂_uncond) network outputs via one doubled-batch forward.
 
     `pose_embs`: per-level pose embeddings already computed for the
     DOUBLED (cond+uncond) layout — injected after the doubling so they are
@@ -57,6 +57,15 @@ def _cfg_eps(model, params, model_batch: dict, w: float,
         doubled["pose_embs"] = pose_embs
     eps = model.apply({"params": params}, doubled, cond_mask=mask, train=False)
     eps_cond, eps_uncond = jnp.split(eps, 2, axis=0)
+    return eps_cond, eps_uncond
+
+
+def _cfg_eps(model, params, model_batch: dict, w: float,
+             pose_embs=None):
+    """(guided, conditional) network outputs; CFG combine applied here.
+    The conditional output rides along for cfg_rescale."""
+    eps_cond, eps_uncond = _raw_eps(model, params, model_batch,
+                                    pose_embs=pose_embs)
     return (1.0 + w) * eps_cond - w * eps_uncond, eps_cond
 
 
@@ -264,8 +273,65 @@ def make_sampler(model, schedule: DiffusionSchedule, config: DiffusionConfig,
     return sample
 
 
+# Why the serving samplers pin the update's inputs with
+# jax.lax.optimization_barrier before the per-step math: XLA is free to
+# fuse the UNet epilogue / RNG / gather producers INTO the elementwise
+# update chain, and its FMA-contraction choices there differ between
+# program shapes — which would put the fused and unfused programs (and
+# the two schedulers) a ulp apart before the update math even runs. The
+# barrier makes every producer subgraph identical across programs, so
+# one shared implementation (ops/fused_step.py: the Pallas kernel or
+# its unfused reference twin) yields BIT-identical samplers — asserted
+# in tier-1 (tests/test_fused_step.py). The Pallas call is a natural
+# materialization boundary anyway; the unfused side forgoes only
+# epilogue fusions whose producers materialize regardless. The
+# training-side `make_sampler` is untouched (golden bit-compat).
+
+
+def _resolve_request_fused(config: DiffusionConfig) -> bool:
+    """Resolve diffusion.fused_step for the whole-request sampler.
+
+    dpm++ 2M needs cross-step x̂₀ history, which a single fused step
+    cannot express: an explicit True is a loud error (config.validate
+    catches it earlier with the same message class), while 'auto'
+    silently keeps the unfused multistep scan."""
+    use = fused_step_lib.resolve_fused_step(config.fused_step)
+    if use and config.sampler == "dpm++":
+        if config.fused_step is True:
+            raise ValueError(
+                "diffusion.fused_step=True requires sampler 'ddpm' or "
+                "'ddim' — the dpm++ 2M multistep update carries x̂₀ "
+                "history across steps and is not expressible as one "
+                "fused step (use 'auto' to fuse where possible)")
+        return False
+    return use
+
+
+def _sched_coef_row(schedule: DiffusionSchedule, t) -> jnp.ndarray:
+    """(len(STEP_COEF_KEYS),) coefficient vector at traced timestep t.
+
+    Device-side gather of exactly the values the stepper's host-side
+    StepBank packs per row (sample/stepper.py) — the fused kernel
+    consumes one contract whether coefficients arrive from the host
+    bank (slot stepper) or from these on-device tables (scan sampler)."""
+    return jnp.stack([
+        schedule.logsnr(t),
+        jnp.take(schedule.sqrt_recip_alphas_cumprod, t),
+        jnp.take(schedule.sqrt_recipm1_alphas_cumprod, t),
+        jnp.take(schedule.sqrt_alphas_cumprod, t),
+        jnp.take(schedule.sqrt_one_minus_alphas_cumprod, t),
+        jnp.take(schedule.posterior_mean_coef1, t),
+        jnp.take(schedule.posterior_mean_coef2, t),
+        jnp.take(schedule.posterior_log_variance_clipped, t),
+        jnp.take(schedule.alphas_cumprod, t),
+        jnp.take(schedule.alphas_cumprod_prev, t),
+        (t > 0).astype(jnp.float32),
+    ])
+
+
 def make_request_sampler(model, schedule: DiffusionSchedule,
-                         config: DiffusionConfig):
+                         config: DiffusionConfig, *,
+                         param_transform=None):
     """Per-sample-keyed sampler for the serving micro-batcher
     (sample/service.py).
 
@@ -280,19 +346,46 @@ def make_request_sampler(model, schedule: DiffusionSchedule,
 
     The model forward, CFG doubling, and pose-embedding hoist are shared
     with `make_sampler`; only the RNG layout differs.
+
+    `diffusion.fused_step` routes the per-step update (CFG combine, x̂₀
+    reconstruction + clip, ddpm/ddim update, noise add) through the
+    fused Pallas kernel (ops/fused_step.py) — identical RNG stream and
+    operation order, one HBM pass instead of ~a dozen elementwise HLOs.
+    `param_transform` (optional) is applied to `params` INSIDE the jit —
+    the int8 serving path passes the dequantizer here so weights rest in
+    HBM quantized (sample/precision.py).
     """
     w = config.guidance_weight
-    update, init_aux = _make_update(schedule, config)
     T = schedule.num_timesteps
+    use_fused = _resolve_request_fused(config)
+    # ddpm/ddim run the shared per-step implementation (fused kernel or
+    # its unfused reference twin, ops/fused_step.py — the same code the
+    # slot stepper runs, so the two schedulers stay bit-aligned); dpm++
+    # keeps the _make_update multistep scan (never fused).
+    shared_impl = config.sampler in ("ddpm", "ddim")
+    if shared_impl:
+        update, init_aux = None, lambda z0: ()
+        impl_eta = config.ddim_eta if config.sampler == "ddim" else 0.0
+    else:
+        update, init_aux = _make_update(schedule, config)
+        impl_eta = 0.0
 
     @jax.jit
     def sample(params, keys, cond: dict) -> jnp.ndarray:
+        if param_transform is not None:
+            params = param_transform(params)
         z_shape = cond["x"].shape[-3:]  # (H, W, 3)
         both = jax.vmap(jax.random.split)(keys)       # (B, 2, 2)
         keys0, k_init = both[:, 0], both[:, 1]
         z0 = jax.vmap(lambda k: jax.random.normal(k, z_shape))(k_init)
         ts = jnp.arange(T - 1, -1, -1)
         pose_embs = _doubled_pose_embs(model, params, cond)
+        B = keys.shape[0]
+        # Per-shape fusion decision at trace time: rows past the VMEM
+        # slab budget keep the unfused chain (same policy as the fused
+        # GroupNorm's over-VMEM fallback).
+        fused = (shared_impl and use_fused
+                 and fused_step_lib.fits_vmem(int(np.prod(z_shape))))
 
         def body(carry, t):
             z, ks, aux = carry
@@ -300,8 +393,30 @@ def make_request_sampler(model, schedule: DiffusionSchedule,
             ks, k_step = both[:, 0], both[:, 1]
             batch = dict(cond, z=z,
                          logsnr=jnp.full((z.shape[0],), schedule.logsnr(t)))
+            if shared_impl:
+                ec, eu = _raw_eps(model, params, batch,
+                                  pose_embs=pose_embs)
+                # k_step is (B, 2): per-sample noise streams.
+                noise = _step_noise(k_step, z)
+                coefs = jnp.broadcast_to(
+                    _sched_coef_row(schedule, t),
+                    (B, len(STEP_COEF_KEYS)))
+                wvec = jnp.full((B,), w, jnp.float32)
+                # Pinned inputs + one shared implementation: the fused
+                # and unfused programs are bit-identical (see the
+                # barrier note above _resolve_request_fused).
+                z_in, ec, eu, noise, coefs, wvec = \
+                    jax.lax.optimization_barrier(
+                        (z, ec, eu, noise, coefs, wvec))
+                step_impl = (fused_step_lib.fused_denoise_step if fused
+                             else fused_step_lib.unfused_reference_step)
+                z = step_impl(
+                    z_in, ec, eu, noise, coefs, wvec,
+                    sampler=config.sampler, objective=config.objective,
+                    eta=impl_eta, cfg_rescale=config.cfg_rescale,
+                    clip_denoised=config.clip_denoised)
+                return (z, ks, aux), None
             outs = _cfg_eps(model, params, batch, w, pose_embs=pose_embs)
-            # k_step is (B, 2): _step_noise draws per-sample streams.
             z, aux = update(z, t, outs, k_step, aux)
             return (z, ks, aux), None
 
@@ -334,8 +449,14 @@ STEP_COEF_KEYS = (
     "nonzero",            # 1.0 while t > 0 (no noise at the final step)
 )
 
+# The fused kernel bakes these column indices in (ops/fused_step.py);
+# the two layouts must never drift.
+assert tuple(fused_step_lib._COEF_COLS) == STEP_COEF_KEYS
+assert fused_step_lib._W_COL == len(STEP_COEF_KEYS)
 
-def make_slot_step_fn(model, config: DiffusionConfig):
+
+def make_slot_step_fn(model, config: DiffusionConfig, *,
+                      param_transform=None):
     """ONE reverse-process step over a ring batch with per-row schedules.
 
     The serving stepper's device program (sample/service.py,
@@ -368,7 +489,15 @@ def make_slot_step_fn(model, config: DiffusionConfig):
     (history-free) update here — ring membership changes between steps,
     so multistep history is invalid, the same rule `_make_update` applies
     to stochastic conditioning; serve with serve.scheduler='request' for
-    exact 2M."""
+    exact 2M.
+
+    `diffusion.fused_step` routes everything after the UNet forward
+    (CFG combine → x̂₀ + clip → update → noise add) through the fused
+    Pallas kernel (ops/fused_step.py), consuming the SAME (B, K) coefs
+    matrix — one HBM pass per step instead of ~a dozen elementwise
+    HLOs, identical math and RNG stream. `param_transform` (optional)
+    is applied to `params` INSIDE the jit — the int8 serving path
+    passes the dequantizer here (sample/precision.py)."""
     phi = config.cfg_rescale
     if not 0.0 <= phi <= 1.0:
         raise ValueError(f"cfg_rescale must be in [0, 1], got {phi}")
@@ -382,24 +511,16 @@ def make_slot_step_fn(model, config: DiffusionConfig):
         sampler = "ddim"  # first-order fallback (see docstring)
     if sampler not in ("ddpm", "ddim"):
         raise ValueError(f"unknown sampler {config.sampler!r}")
+    # The stepper's dpm++ fallback is already first-order ddim, so the
+    # fused kernel serves every sampler the stepper does.
+    use_fused = fused_step_lib.resolve_fused_step(config.fused_step)
 
     logsnr_col = STEP_COEF_KEYS.index("logsnr")
 
-    def col(coefs, name, ndim):
-        c = coefs[:, STEP_COEF_KEYS.index(name)]
-        return c.reshape(c.shape + (1,) * (ndim - 1))
-
-    def to_x0(z, out, coefs):
-        if objective == "eps":
-            return (col(coefs, "sqrt_recip_acp", z.ndim) * z
-                    - col(coefs, "sqrt_recipm1_acp", z.ndim) * out)
-        if objective == "x0":
-            return out
-        return (col(coefs, "sqrt_acp", z.ndim) * z
-                - col(coefs, "sqrt_1macp", z.ndim) * out)
-
     @jax.jit
     def step(params, z, keys, first, cond, coefs, w):
+        if param_transform is not None:
+            params = param_transform(params)
         B = z.shape[0]
         # Rows entering the ring draw init noise from their own stream.
         both = jax.vmap(jax.random.split)(keys)
@@ -414,37 +535,22 @@ def make_slot_step_fn(model, config: DiffusionConfig):
 
         pose_embs = _doubled_pose_embs(model, params, cond)
         batch = dict(cond, z=z, logsnr=coefs[:, logsnr_col])
-        w_bcast = w.reshape((B,) + (1,) * (z.ndim - 1))
-        guided, cond_out = _cfg_eps(model, params, batch, w_bcast,
-                                    pose_embs=pose_embs)
-        x0 = to_x0(z, guided, coefs)
-        if phi > 0.0:
-            x0_c = to_x0(z, cond_out, coefs)
-            axes = tuple(range(1, x0.ndim))
-            std_c = jnp.std(x0_c, axis=axes, keepdims=True)
-            std_g = jnp.std(x0, axis=axes, keepdims=True)
-            rescaled = x0 * (std_c / jnp.maximum(std_g, 1e-8))
-            x0 = phi * rescaled + (1.0 - phi) * x0
-        if clip_denoised:
-            x0 = jnp.clip(x0, -1.0, 1.0)
-        nonzero = col(coefs, "nonzero", z.ndim)
+        ec, eu = _raw_eps(model, params, batch, pose_embs=pose_embs)
         noise = _step_noise(k_step, z)
-        if sampler == "ddpm":
-            mean = (col(coefs, "pm_coef1", z.ndim) * x0
-                    + col(coefs, "pm_coef2", z.ndim) * z)
-            z_next = mean + nonzero * jnp.exp(
-                0.5 * col(coefs, "post_log_var", z.ndim)) * noise
-        else:  # ddim (and the dpm++ first-order fallback at eta=0)
-            acp = col(coefs, "acp", z.ndim)
-            acp_prev = col(coefs, "acp_prev", z.ndim)
-            eps_hat = (col(coefs, "sqrt_recip_acp", z.ndim) * z - x0) \
-                / col(coefs, "sqrt_recipm1_acp", z.ndim)
-            sigma = (eta * jnp.sqrt((1.0 - acp_prev) / (1.0 - acp))
-                     * jnp.sqrt(jnp.maximum(1.0 - acp / acp_prev, 0.0)))
-            dir_zt = jnp.sqrt(
-                jnp.maximum(1.0 - acp_prev - sigma ** 2, 0.0)) * eps_hat
-            z_next = (jnp.sqrt(acp_prev) * x0 + dir_zt
-                      + nonzero * sigma * noise)
+        # Pin the update's inputs so both branches see identical bits
+        # (see the barrier note above _resolve_request_fused).
+        z_in, ec, eu, noise, coefs_in, w_in = jax.lax.optimization_barrier(
+            (z, ec, eu, noise, coefs, w))
+        fused = use_fused and fused_step_lib.fits_vmem(
+            int(np.prod(z.shape[1:])))
+        # Per-shape trace-time decision (over-VMEM rows keep the
+        # unfused chain, same policy as fused GroupNorm).
+        step_impl = (fused_step_lib.fused_denoise_step if fused
+                     else fused_step_lib.unfused_reference_step)
+        z_next = step_impl(
+            z_in, ec, eu, noise, coefs_in, w_in, sampler=sampler,
+            objective=objective, eta=eta, cfg_rescale=phi,
+            clip_denoised=clip_denoised)
         return z_next, keys_next
 
     return step

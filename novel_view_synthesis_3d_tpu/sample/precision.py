@@ -1,0 +1,153 @@
+"""Serving-precision staging: f32 / bf16 / weight-only int8 params.
+
+Every registry version stores full-f32 weights; what a serving process
+PUTS ON DEVICE is a deployment choice (`serve.precision`):
+
+  - 'float32'  — the weights as published (exact; the default).
+  - 'bfloat16' — every float leaf cast to bf16 at stage time. Halves
+    the weights' HBM residency and host→device transfer; flax promotes
+    them to the model compute dtype on-chip, so the bandwidth saving is
+    real (HBM reads move half the bytes) and no model code changes.
+  - 'int8'     — per-channel symmetric WEIGHT-ONLY int8 for conv/dense
+    kernels (flax leaves named 'kernel', rank ≥ 2; the output-channel
+    axis is last in both HWIO conv and (in, out) dense layouts) with
+    f32 scales, bf16 for everything else (norm scales/biases, embedding
+    tables — small, and int8 would cost real quality there). Quantized
+    leaves ride as QuantLeaf pytree nodes; the sampler program
+    dequantizes INSIDE the jitted step (`make_resolver`), so weights
+    rest in HBM at 1 byte/param and the f32 copy exists only as XLA
+    fusion-managed intermediates.
+
+The quality cost of a precision is charged where it matters: the
+registry gate probes candidates AT the serving precision
+(registry/gate.py make_psnr_probe(precision=...)), so quantization loss
+counts against `registry.gate_margin_db` and a version that only looks
+good in f32 cannot be promoted into an int8 deployment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PRECISIONS = ("float32", "bfloat16", "int8")
+
+
+def validate_precision(precision: str) -> str:
+    """Loud membership check (mirrors train.adam_mu_dtype style)."""
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"serve.precision={precision!r} must be one of "
+            f"{PRECISIONS} ('float32' = weights as published, "
+            "'bfloat16' = cast at stage time, 'int8' = per-channel "
+            "symmetric weight-only quantization with f32 scales)")
+    return precision
+
+
+@flax.struct.dataclass
+class QuantLeaf:
+    """One weight-only-quantized param leaf (a pytree node).
+
+    `q` int8 values, `scale` f32 per-output-channel scale shaped to
+    broadcast against q (all-but-last axes are 1). Rides through
+    device_put / jit like any array pair; `make_resolver` turns it back
+    into a compute-dtype tensor inside the program."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+def quantize_int8(w: np.ndarray) -> QuantLeaf:
+    """Per-channel symmetric int8 quantization over the LAST axis.
+
+    scale_c = max(|w[..., c]|) / 127 (1.0 where a channel is all-zero,
+    so dequantization is exact there); q = round(w / scale) clipped to
+    [-127, 127] — symmetric, zero-point-free, round-half-even (numpy
+    rint = the IEEE default, matching jnp.round). Roundtrip error is
+    bounded by scale/2 per element (tests/test_fused_step.py)."""
+    w = np.asarray(jax.device_get(w), np.float32)
+    axes = tuple(range(w.ndim - 1))
+    amax = np.max(np.abs(w), axis=axes, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return QuantLeaf(q=q, scale=scale)
+
+
+def dequantize_int8(leaf: QuantLeaf, dtype=jnp.float32) -> jnp.ndarray:
+    """scale · q in f32, cast to `dtype` (works on numpy or jnp)."""
+    return (leaf.q.astype(jnp.float32) * leaf.scale).astype(dtype)
+
+
+def _is_float_dtype(dtype) -> bool:
+    # ml_dtypes (bfloat16) are not numpy np.floating subtypes.
+    return np.issubdtype(dtype, np.floating) or dtype == jnp.bfloat16
+
+
+def _quantizable(path: tuple, leaf) -> bool:
+    """Conv/dense kernels only: flax names them 'kernel' and they are
+    rank >= 2 with output channels last. Everything else (GroupNorm
+    scale/bias, conv bias, learned embeddings) stays bf16."""
+    return (bool(path) and path[-1] == "kernel"
+            and getattr(leaf, "ndim", 0) >= 2
+            and _is_float_dtype(leaf.dtype))
+
+
+def _map_with_path(tree: Any, fn: Callable[[tuple, Any], Any],
+                   path: tuple = ()) -> Any:
+    if isinstance(tree, dict):
+        return {k: _map_with_path(v, fn, path + (k,))
+                for k, v in tree.items()}
+    return fn(path, tree)
+
+
+def stage_params(params, precision: str):
+    """Host-side staging of a param tree at `precision` (see module
+    docstring). Returns a NEW host tree for bf16/int8 (quantization and
+    casts run on host numpy, so the device upload ships the small
+    representation); float32 returns `params` unchanged — the legacy
+    path stays bit-exact, including buffer-ownership semantics."""
+    validate_precision(precision)
+    if precision == "float32":
+        return params
+
+    def cast_bf16(leaf):
+        a = np.asarray(jax.device_get(leaf))
+        if _is_float_dtype(a.dtype):
+            return a.astype(jnp.bfloat16)
+        return a
+
+    if precision == "bfloat16":
+        return jax.tree.map(cast_bf16, params)
+
+    def stage_leaf(path, leaf):
+        if _quantizable(path, leaf):
+            return quantize_int8(leaf)
+        return cast_bf16(leaf)
+
+    return _map_with_path(params, stage_leaf)
+
+
+def make_resolver(precision: str) -> Optional[Callable]:
+    """The in-program param transform for `precision`.
+
+    None for float32/bfloat16 (the staged tree feeds the model
+    directly — flax's promote_dtype handles bf16 on-chip). For int8, a
+    jit-traceable tree map dequantizing every QuantLeaf to bf16; it
+    runs INSIDE the sampler program, so the resting representation in
+    HBM stays int8 and the dequantized tensor is an XLA-managed
+    intermediate of each dispatch."""
+    validate_precision(precision)
+    if precision != "int8":
+        return None
+
+    def resolve(params):
+        return jax.tree.map(
+            lambda leaf: (dequantize_int8(leaf, jnp.bfloat16)
+                          if isinstance(leaf, QuantLeaf) else leaf),
+            params, is_leaf=lambda x: isinstance(x, QuantLeaf))
+
+    return resolve

@@ -60,6 +60,13 @@ Shared architecture (docs/DESIGN.md "Serving"):
   - instrumentation via `utils/profiling.ServiceStats`: per-request
     queue-wait / compile / device spans and a requests-per-second
     counter (tools/serve_bench.py reads these);
+  - SERVING PRECISION (docs/DESIGN.md "Serving precision & fused
+    kernels"): `serve.precision` decides what _stage_params puts on
+    device — f32 as published, bf16 cast, or weight-only int8 with
+    in-jit dequant (sample/precision.py) — for the initial weights AND
+    every hot swap; the program-cache keys fold (precision, fused_step)
+    in, and `diffusion.fused_step` routes the per-step update through
+    the fused Pallas kernel (ops/fused_step.py) in both schedulers;
   - ZERO-DOWNTIME HOT RELOAD (docs/DESIGN.md "Model lifecycle"):
     `swap_params` stages a new param tree on the same placement (mesh
     replication or default device) ALONGSIDE the live one, and the
@@ -88,6 +95,8 @@ from novel_view_synthesis_3d_tpu import obs
 from novel_view_synthesis_3d_tpu.config import DiffusionConfig, ServeConfig
 from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
 from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+from novel_view_synthesis_3d_tpu.ops.fused_step import resolve_fused_step
+from novel_view_synthesis_3d_tpu.sample import precision as precision_lib
 from novel_view_synthesis_3d_tpu.sample.ddpm import (
     make_request_sampler,
     make_slot_step_fn,
@@ -217,7 +226,8 @@ class SamplerProgramCache:
     """LRU of compiled request-sampler programs.
 
     Keyed by (bucket, H, W, steps, guidance, sampler, cfg_rescale,
-    ddim_eta, objective, schedule) — see `SamplingService._cache_key`:
+    ddim_eta, objective, schedule, precision, fused_step) — see
+    `SamplingService._cache_key`:
     everything that changes the XLA program a served batch runs.
     `builds` counts cache misses
     (each one is a retrace + compile); `jit_entries()` sums the live
@@ -298,6 +308,14 @@ class SamplingService:
         self.diffusion = diffusion
         self.serve = serve or ServeConfig()
         self.mesh = mesh
+        # Serving precision (sample/precision.py): how _stage_params
+        # representations weights on device (f32 as-published / bf16
+        # cast / weight-only int8 + in-jit dequant), folded into every
+        # program-cache key. One service serves ONE precision — mixing
+        # precisions means mixing model qualities mid-stream.
+        self.precision = precision_lib.validate_precision(
+            self.serve.precision)
+        self._param_transform = precision_lib.make_resolver(self.precision)
         self.stats = ServiceStats()
         # Unified telemetry (obs/): the serving pipeline's spans
         # (queue_wait → batch_form → compile/device → respond) flow into
@@ -405,13 +423,23 @@ class SamplingService:
         return self._live[1]
 
     def _stage_params(self, params):
-        """Place a param tree where dispatch needs it (mesh-replicated or
-        default device). Returns (staged_tree, owned_leaf_ids): only
-        buffers UPLOADED HERE from host (numpy) leaves count as service-
-        owned — the ones a later swap may free. A device-array input may
-        come back from device_put as a NEW wrapper over the SAME buffer,
-        so deleting by object identity would kill the caller's tree;
-        those leaves are left to garbage collection instead."""
+        """Stage a param tree at the serving precision and place it
+        where dispatch needs it (mesh-replicated or default device).
+
+        Precision staging happens ON HOST first (sample/precision.py):
+        bf16 casts / int8 quantization produce a fresh host tree, so the
+        device upload ships the small representation and the weights
+        REST on device at serving precision. float32 stages the caller's
+        tree unchanged (bit-exact legacy path).
+
+        Returns (staged_tree, owned_leaf_ids): only buffers UPLOADED
+        HERE from host (numpy) leaves count as service-owned — the ones
+        a later swap may free. A device-array input may come back from
+        device_put as a NEW wrapper over the SAME buffer, so deleting by
+        object identity would kill the caller's tree; those leaves are
+        left to garbage collection instead. (At bf16/int8 every staged
+        leaf is a derived host copy, so the service owns them all.)"""
+        params = precision_lib.stage_params(params, self.precision)
         if self.mesh is not None:
             staged = mesh_lib.replicate(self.mesh, params)
         else:
@@ -558,9 +586,14 @@ class SamplingService:
         return self._programs.counters()
 
     def summary(self) -> dict:
+        try:
+            fused = resolve_fused_step(self.diffusion.fused_step)
+        except ValueError:
+            fused = self.diffusion.fused_step
         return dict(self.stats.summary(), **self.compile_counters(),
                     model_version=self.model_version,
-                    model_swaps=self._swaps)
+                    model_swaps=self._swaps,
+                    precision=self.precision, fused_step=fused)
 
     def _log_event(self, request_id: int, kind: str, detail: str) -> None:
         """Event-log append via the obs bus, schema-compatible with the
@@ -748,17 +781,21 @@ class SamplingService:
 
     def _step_cache_key(self, bucket: int, H: int, W: int) -> tuple:
         """Stepper program identity: bucket SHAPE plus the DiffusionConfig
-        fields the compiled step bakes in. Deliberately NO steps, t, or
-        guidance weight — those are device arguments, which is what makes
-        a mixed 4/256-step warm sweep compile nothing (the PR 3 key
-        folded `steps` in, which under step-level scheduling would have
-        recompiled per step count)."""
+        fields the compiled step bakes in — including the serving
+        precision and the fused-step flag, which change the lowered
+        program (in-jit dequant / the Pallas kernel call). Deliberately
+        NO steps, t, or guidance weight — those are device arguments,
+        which is what makes a mixed 4/256-step warm sweep compile
+        nothing (the PR 3 key folded `steps` in, which under step-level
+        scheduling would have recompiled per step count)."""
         d = self.diffusion
         return (bucket, H, W, d.sampler, d.cfg_rescale, d.ddim_eta,
-                d.objective, d.clip_denoised, d.schedule, d.timesteps)
+                d.objective, d.clip_denoised, d.schedule, d.timesteps,
+                self.precision, d.fused_step)
 
     def _build_step_program(self):
-        return make_slot_step_fn(self.model, self.diffusion)
+        return make_slot_step_fn(self.model, self.diffusion,
+                                 param_transform=self._param_transform)
 
     def _ring_step(self, ring: List[_Slot],
                    carry: Optional[dict]) -> Optional[dict]:
@@ -944,10 +981,13 @@ class SamplingService:
         in (sampler, cfg_rescale, ddim_eta, objective, schedule). The
         config fields are constant for one service instance today, but
         keying on them keeps the cache correct if per-request overrides
-        are ever extended to cover them."""
+        are ever extended to cover them. Precision and the fused-step
+        flag fold in for the same reason (they change the lowered
+        program: in-jit dequant / the Pallas kernel call)."""
         d = self.diffusion
         return (bucket, H, W, steps, w, d.sampler, d.cfg_rescale,
-                d.ddim_eta, d.objective, d.schedule)
+                d.ddim_eta, d.objective, d.schedule,
+                self.precision, d.fused_step)
 
     def _build_program(self, steps: int, w: float):
         import dataclasses
@@ -956,7 +996,8 @@ class SamplingService:
         if w != dcfg.guidance_weight:
             dcfg = dataclasses.replace(dcfg, guidance_weight=w)
         schedule = sampling_schedule(dcfg, steps)
-        return make_request_sampler(self.model, schedule, dcfg)
+        return make_request_sampler(self.model, schedule, dcfg,
+                                    param_transform=self._param_transform)
 
     def _dispatch(self, group: List[_Request]) -> None:
         n = len(group)

@@ -15,6 +15,12 @@ warm sweep compiles NOTHING (asserted by tools/serve_bench.py and
 tests/test_stepper.py) — the fix for the PR 3 cache key folding `steps`
 into the program identity, which under step-level scheduling would have
 recompiled per step-count.
+
+The packed (B, K) matrix is ALSO the fused denoise-step kernel's
+row-parameter contract (ops/fused_step.py consumes these exact columns
+as device arguments; an import-time assert pins its baked indices to
+STEP_COEF_KEYS), so `diffusion.fused_step` changes the program BODY,
+never this host-side protocol or the cache-key shape.
 """
 
 from __future__ import annotations

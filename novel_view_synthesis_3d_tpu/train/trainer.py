@@ -274,8 +274,16 @@ class Trainer:
 
         # --- model / schedule / state ---
         self.schedule = make_schedule(config.diffusion)
+        # train.remat overrides the checkpoint policy for the TRAINING
+        # build only ('' = inherit model.remat): the param tree layout is
+        # remat-independent (models/xunet._named_remat), so checkpoints
+        # stay portable to samplers built without it.
+        model_cfg = config.model
+        if config.train.remat != "":
+            import dataclasses as _dc
+            model_cfg = _dc.replace(model_cfg, remat=config.train.remat)
         self.model = XUNet(
-            config.model,
+            model_cfg,
             mesh=self.mesh if config.model.sequence_parallel else None)
         first_batch = next(self.data_iter)
         self._held_batch = first_batch

@@ -1,0 +1,489 @@
+"""Fused Pallas denoise-step kernel + precision-lowered serving (PR 8).
+
+Parity contract (docs/DESIGN.md "Serving precision & fused kernels"):
+interpret mode runs the IDENTICAL kernel code path tier-1 ships to TPU,
+and the samplers pin the update's inputs (optimization_barrier) so the
+fused and unfused programs are BIT-identical for single-key sampling —
+across ddpm + ddim and both schedulers — and within the established
+1e-5 tolerance on the 8-device mesh. Precision: int8 roundtrip error
+bound, staging policy (kernels quantize, the rest bf16), the
+precision-carrying program-cache key with its zero-recompile warm
+sweep, the gate probing at serving precision, and the config
+validation for all of it.
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import (
+    Config,
+    DiffusionConfig,
+    ModelConfig,
+    RegistryConfig,
+    ServeConfig,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+from novel_view_synthesis_3d_tpu.ops import fused_step as fused_step_lib
+from novel_view_synthesis_3d_tpu.sample import precision as precision_lib
+from novel_view_synthesis_3d_tpu.sample.ddpm import (
+    STEP_COEF_KEYS,
+    make_request_sampler,
+    make_slot_step_fn,
+)
+from novel_view_synthesis_3d_tpu.sample.service import (
+    SamplingService,
+    request_cond_from_batch,
+)
+from novel_view_synthesis_3d_tpu.sample.stepper import ScheduleBank
+
+pytestmark = pytest.mark.smoke
+
+TINY = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                   attn_resolutions=(8,), dropout=0.0)
+T = 8
+S = 16
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T)
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=8, sidelength=S, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((8,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((8,)), train=False)["params"]
+    conds = [request_cond_from_batch(mb, i) for i in range(8)]
+    return model, params, dcfg, conds, batch
+
+
+# ---------------------------------------------------------------------------
+# kernel-level parity
+# ---------------------------------------------------------------------------
+def _kernel_inputs(shape=(4, 7, 9, 3), seed=0):
+    """Random update inputs at a deliberately lane-UNALIGNED size
+    (7·9·3 = 189 → one 64-element pad tail) so the padding path is
+    always exercised."""
+    rng = np.random.default_rng(seed)
+    B = shape[0]
+    mk = lambda: jnp.asarray(rng.normal(size=shape), jnp.float32)
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T)
+    bank = ScheduleBank(dcfg).get(T)
+    coefs = jnp.asarray(bank.table[rng.integers(0, bank.n, size=B)])
+    w = jnp.asarray(rng.uniform(0.0, 8.0, size=B), jnp.float32)
+    return mk(), mk(), mk(), mk(), coefs, w
+
+
+@pytest.mark.parametrize("sampler,objective,eta,phi,clip", [
+    ("ddpm", "eps", 0.0, 0.0, True),
+    ("ddpm", "v", 0.0, 0.0, False),
+    ("ddpm", "x0", 0.0, 0.0, True),
+    ("ddim", "eps", 0.0, 0.0, True),
+    ("ddim", "eps", 1.0, 0.0, True),
+    ("ddim", "v", 0.5, 0.0, True),
+])
+def test_kernel_bit_identical_to_reference(sampler, objective, eta, phi,
+                                           clip):
+    """The kernel and its unfused jnp twin produce the SAME BITS on the
+    same inputs (interpret mode = the identical code path tier-1 ships),
+    including lane-padding tails, for every sampler/objective/eta the
+    serving path can configure."""
+    z, ec, eu, nz, coefs, w = _kernel_inputs()
+    kw = dict(sampler=sampler, objective=objective, eta=eta,
+              cfg_rescale=phi, clip_denoised=clip)
+    fused = jax.jit(lambda *a: fused_step_lib.fused_denoise_step(*a, **kw))
+    ref = jax.jit(lambda *a: fused_step_lib.unfused_reference_step(
+        *a, **kw))
+    out = np.asarray(fused(z, ec, eu, nz, coefs, w))
+    expect = np.asarray(ref(z, ec, eu, nz, coefs, w))
+    assert np.isfinite(out).all()
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_kernel_cfg_rescale_close_to_reference():
+    """cfg_rescale's row-std runs as a masked two-pass reduction in the
+    kernel vs jnp.std in the reference — mathematically identical, but
+    the summation order differs over padded slabs, so this one is a
+    tolerance (not bit) assertion."""
+    z, ec, eu, nz, coefs, w = _kernel_inputs(seed=5)
+    kw = dict(sampler="ddpm", objective="eps", cfg_rescale=0.7)
+    out = fused_step_lib.fused_denoise_step(z, ec, eu, nz, coefs, w, **kw)
+    expect = fused_step_lib.unfused_reference_step(
+        z, ec, eu, nz, coefs, w, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_kernel_rejects_dpmpp_and_bad_objective():
+    z, ec, eu, nz, coefs, w = _kernel_inputs()
+    with pytest.raises(ValueError, match="dpm"):
+        fused_step_lib.fused_denoise_step(
+            z, ec, eu, nz, coefs, w, sampler="dpm++", objective="eps")
+    with pytest.raises(ValueError, match="objective"):
+        fused_step_lib.fused_denoise_step(
+            z, ec, eu, nz, coefs, w, sampler="ddpm", objective="score")
+
+
+def test_coef_layout_shared_with_stepper():
+    """The kernel's baked column indices, the host ScheduleBank packing,
+    and STEP_COEF_KEYS are one layout (drift would silently mis-scale
+    every step)."""
+    assert tuple(fused_step_lib._COEF_COLS) == STEP_COEF_KEYS
+    assert fused_step_lib._W_COL == len(STEP_COEF_KEYS)
+
+
+# ---------------------------------------------------------------------------
+# sampler-level parity (both schedulers)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("sampler_name", ["ddpm", "ddim"])
+def test_request_sampler_fused_bit_identical(setup, sampler_name):
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        sampling_schedule)
+
+    model, params, _, conds, batch = setup
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T,
+                           sampler=sampler_name)
+    sched = sampling_schedule(dcfg, T)
+    cond = {k: jnp.asarray(np.stack([c[k] for c in conds[:4]]))
+            for k in conds[0]}
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(4)])
+    ref = make_request_sampler(model, sched, dcfg)(params, keys, cond)
+    out = make_request_sampler(
+        model, sched, dataclasses.replace(dcfg, fused_step=True))(
+            params, keys, cond)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("sampler_name", ["ddpm", "ddim"])
+def test_slot_step_fused_bit_identical(setup, sampler_name):
+    model, params, _, conds, _ = setup
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T,
+                           sampler=sampler_name)
+    bank = ScheduleBank(dcfg).get(4)
+    B = 4
+    cond = {k: jnp.asarray(np.stack([c[k] for c in conds[:B]]))
+            for k in conds[0]}
+    z = jnp.asarray(np.random.default_rng(0).normal(size=(B, S, S, 3)),
+                    jnp.float32)
+    keys = jnp.asarray(np.stack([np.asarray(jax.random.PRNGKey(i + 10))
+                                 for i in range(B)]))
+    first = jnp.asarray([True, False, True, False])
+    coefs = jnp.asarray(np.stack([bank.table[2]] * B))
+    w = jnp.asarray([3.0, 1.5, 0.0, 7.0], jnp.float32)
+    zu, ku = make_slot_step_fn(model, dcfg)(
+        params, z, keys, first, cond, coefs, w)
+    zf, kf = make_slot_step_fn(
+        model, dataclasses.replace(dcfg, fused_step=True))(
+            params, z, keys, first, cond, coefs, w)
+    np.testing.assert_array_equal(np.asarray(zu), np.asarray(zf))
+    np.testing.assert_array_equal(np.asarray(ku), np.asarray(kf))
+
+
+def test_fused_ring_composition_invariance(setup, tmp_path):
+    """Ring-composition invariance survives the kernel: a request's
+    image is bit-identical solo vs interleaved with mid-flight joiners,
+    with the fused step ON (interpret mode)."""
+    model, params, dcfg, conds, _ = setup
+    svc = SamplingService(
+        model, params, dataclasses.replace(dcfg, fused_step=True),
+        ServeConfig(scheduler="step", max_batch=4, flush_timeout_ms=30.0,
+                    queue_depth=32),
+        results_folder=str(tmp_path))
+    try:
+        a_solo = svc.submit(conds[0], seed=11,
+                            sample_steps=T).result(timeout=300)
+        b_solo = svc.submit(conds[1], seed=22,
+                            sample_steps=2).result(timeout=300)
+        before = svc.stats.span_summary("ring_step").get("count", 0)
+        a = svc.submit(conds[0], seed=11, sample_steps=T)
+        deadline = time.monotonic() + 60
+        while (svc.stats.span_summary("ring_step").get("count", 0)
+               <= before and time.monotonic() < deadline):
+            time.sleep(0.002)
+        b = svc.submit(conds[1], seed=22, sample_steps=2)
+        np.testing.assert_array_equal(a.result(timeout=300), a_solo)
+        np.testing.assert_array_equal(b.result(timeout=300), b_solo)
+        assert b.timing["batch_n"] >= 2  # really joined mid-flight
+    finally:
+        svc.stop()
+
+
+def test_fused_matches_unfused_service_on_mesh(setup, tmp_path):
+    """Fused-vs-unfused service images agree at the established 1e-5
+    mesh tolerance when dispatch shards over the 8-device mesh."""
+    from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+
+    model, params, dcfg, conds, _ = setup
+    mesh = mesh_lib.make_mesh()
+    imgs = {}
+    for name, flag in (("unfused", False), ("fused", True)):
+        svc = SamplingService(
+            model, params, dataclasses.replace(dcfg, fused_step=flag),
+            ServeConfig(scheduler="step", max_batch=8,
+                        flush_timeout_ms=200.0, queue_depth=32),
+            mesh=mesh, results_folder=str(tmp_path / name))
+        try:
+            tickets = [svc.submit(conds[i], seed=60 + i, sample_steps=4)
+                       for i in range(8)]
+            imgs[name] = [t.result(timeout=600) for t in tickets]
+            assert tickets[0].timing["bucket"] == 8  # sharded dispatch
+        finally:
+            svc.stop()
+    for a, b in zip(imgs["unfused"], imgs["fused"]):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# precision: quantization units
+# ---------------------------------------------------------------------------
+def test_int8_roundtrip_error_bound():
+    """Per-channel symmetric int8: |w − dequant(quant(w))| ≤ scale/2
+    per element (round-half-even), scale = per-channel absmax / 127."""
+    rng = np.random.default_rng(0)
+    # Mixed magnitudes per channel so per-CHANNEL scaling is what makes
+    # the bound tight (a per-tensor scale would blow it on channel 0).
+    w = (rng.normal(size=(3, 3, 16, 8)).astype(np.float32)
+         * (10.0 ** rng.uniform(-3, 1, size=8)).astype(np.float32))
+    leaf = precision_lib.quantize_int8(w)
+    assert leaf.q.dtype == np.int8
+    assert leaf.scale.shape == (1, 1, 1, 8)
+    dq = np.asarray(precision_lib.dequantize_int8(leaf))
+    bound = np.broadcast_to(np.asarray(leaf.scale) / 2.0, w.shape)
+    assert (np.abs(w - dq) <= bound + 1e-9).all()
+    # Exactness where exactness is cheap: zeros and the per-channel max.
+    assert precision_lib.quantize_int8(np.zeros((4, 4), np.float32)
+                                       ).scale.min() == 1.0
+    amax = np.abs(w).max(axis=(0, 1, 2))
+    np.testing.assert_allclose(np.abs(dq).max(axis=(0, 1, 2)), amax,
+                               rtol=1e-6)
+
+
+def test_stage_params_policy():
+    """int8 staging quantizes conv/dense kernels ONLY; biases/scales go
+    bf16; float32 staging is the identity (same objects — the legacy
+    bit-exact path)."""
+    params = {
+        "Conv_0": {"kernel": np.random.default_rng(0).normal(
+            size=(3, 3, 4, 8)).astype(np.float32),
+            "bias": np.zeros(8, np.float32)},
+        "GroupNorm_0": {"scale": np.ones(8, np.float32),
+                        "bias": np.zeros(8, np.float32)},
+    }
+    assert precision_lib.stage_params(params, "float32") is params
+    bf16 = precision_lib.stage_params(params, "bfloat16")
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(bf16))
+    q = precision_lib.stage_params(params, "int8")
+    assert isinstance(q["Conv_0"]["kernel"], precision_lib.QuantLeaf)
+    assert q["Conv_0"]["bias"].dtype == jnp.bfloat16
+    assert q["GroupNorm_0"]["scale"].dtype == jnp.bfloat16
+    # The resolver dequantizes QuantLeafs (to bf16) and passes the rest.
+    resolved = precision_lib.make_resolver("int8")(q)
+    assert resolved["Conv_0"]["kernel"].dtype == jnp.bfloat16
+    assert resolved["Conv_0"]["kernel"].shape == (3, 3, 4, 8)
+    assert precision_lib.make_resolver("float32") is None
+    assert precision_lib.make_resolver("bfloat16") is None
+
+
+# ---------------------------------------------------------------------------
+# precision: serving end-to-end
+# ---------------------------------------------------------------------------
+def test_precision_in_cache_key_and_zero_recompile(setup, tmp_path):
+    """The program-cache key folds precision in (two services at
+    different precisions never share a program identity), and a warm
+    bf16 service recompiles NOTHING across a mixed-step sweep — the
+    zero-warm-recompile contract survives precision lowering."""
+    model, params, dcfg, conds, _ = setup
+    svc32 = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=4, precision="float32"),
+        results_folder=str(tmp_path), start=False)
+    svc16 = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=4, precision="bfloat16"),
+        results_folder=str(tmp_path), start=False)
+    assert (svc32._step_cache_key(4, S, S)
+            != svc16._step_cache_key(4, S, S))
+    assert (svc32._cache_key(4, S, S, 4, 3.0)
+            != svc16._cache_key(4, S, S, 4, 3.0))
+    svc32.stop(), svc16.stop()
+
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=4, flush_timeout_ms=30.0,
+                    queue_depth=32, precision="bfloat16"),
+        results_folder=str(tmp_path))
+    try:
+        seed = 700
+        for b in (1, 2, 4):
+            tickets = [svc.submit(conds[j], seed=seed + j, sample_steps=T)
+                       for j in range(b)]
+            seed += b
+            for t in tickets:
+                t.result(timeout=300)
+        before = svc.compile_counters()
+        for st, w in ((2, 0.0), (4, 5.0), (T, 3.0)):
+            svc.submit(conds[st % 8], seed=seed, sample_steps=st,
+                       guidance_weight=w).result(timeout=300)
+            seed += 1
+        after = svc.compile_counters()
+        assert after["programs_built"] == before["programs_built"]
+        assert after["jit_cache_entries"] == before["jit_cache_entries"]
+        assert svc.summary()["precision"] == "bfloat16"
+    finally:
+        svc.stop()
+
+
+def test_int8_service_serves_finite_images_near_f32(setup, tmp_path):
+    """An int8+fused service serves end-to-end: finite images in range,
+    close to the f32 service's output (weight-only quantization of a
+    random tiny model moves the 2-step image by a bounded amount)."""
+    model, params, dcfg, conds, _ = setup
+    ref_svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=2),
+        results_folder=str(tmp_path / "f32"))
+    q_svc = SamplingService(
+        model, params, dataclasses.replace(dcfg, fused_step=True),
+        ServeConfig(scheduler="step", max_batch=2, precision="int8"),
+        results_folder=str(tmp_path / "int8"))
+    try:
+        ref = ref_svc.submit(conds[0], seed=1,
+                             sample_steps=2).result(timeout=300)
+        img = q_svc.submit(conds[0], seed=1,
+                           sample_steps=2).result(timeout=300)
+        assert np.isfinite(img).all()
+        assert np.abs(img).max() <= 1.0 + 1e-5
+        # The same picture within int8 weight noise (~0.4% relative);
+        # the random 2-step image saturates at the ±1 clip over most
+        # pixels, so "close" is the strongest image-level claim here —
+        # that quantization actually ENGAGED is asserted on the staged
+        # tree itself (int8 buffers on device).
+        assert np.abs(img - ref).mean() < 0.15
+        kernels = [l for path, l in _iter_paths(q_svc.params)
+                   if path and path[-1] == "q"]
+        assert kernels and all(l.dtype == jnp.int8 for l in kernels)
+    finally:
+        ref_svc.stop()
+        q_svc.stop()
+
+
+def test_swap_params_stages_at_precision(setup, tmp_path):
+    """Hot swaps ride the same precision staging: after a swap the live
+    tree still holds QuantLeaf int8 buffers (the watcher path hands host
+    f32 params to swap_params)."""
+    model, params, dcfg, conds, _ = setup
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=2, precision="int8"),
+        results_folder=str(tmp_path), model_version="v1")
+    try:
+        v2 = jax.tree.map(lambda p: np.asarray(p) * 1.01,
+                          jax.device_get(params))
+        applied = svc.swap_params(v2, "v2", step=2)
+        assert applied.wait(60)
+        assert svc.model_version == "v2"
+        kernels = [l for path, l in _iter_paths(svc.params)
+                   if path and path[-1] == "q"]
+        assert kernels and all(l.dtype == jnp.int8 for l in kernels)
+        img = svc.submit(conds[0], seed=5,
+                         sample_steps=2).result(timeout=300)
+        assert np.isfinite(img).all()
+    finally:
+        svc.stop()
+
+
+def _iter_paths(tree, path=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _iter_paths(v, path + (k,))
+    elif isinstance(tree, precision_lib.QuantLeaf):
+        yield from _iter_paths({"q": tree.q, "scale": tree.scale}, path)
+    else:
+        yield path, tree
+
+
+# ---------------------------------------------------------------------------
+# gate at serving precision
+# ---------------------------------------------------------------------------
+def test_gate_probe_at_serving_precision(setup):
+    """The PSNR probe staged at bf16/int8 runs the same fixed-seed
+    comparison the f32 probe does; bf16's weight rounding moves the
+    probe well under the default gate margin, and int8's shift is the
+    quantization loss the gate now charges (nonzero, finite)."""
+    from novel_view_synthesis_3d_tpu.registry.gate import make_psnr_probe
+
+    model, params, dcfg, _, batch = setup
+    host = jax.tree.map(np.asarray, jax.device_get(params))
+    scores = {}
+    for prec in ("float32", "bfloat16", "int8"):
+        probe = make_psnr_probe(model, dcfg, batch, sample_steps=2,
+                                seed=0, precision=prec)
+        scores[prec] = probe(host)
+        assert np.isfinite(scores[prec])
+    assert abs(scores["bfloat16"] - scores["float32"]) \
+        <= RegistryConfig().gate_margin_db
+    # Quantization is actually applied to what the probe scores: the
+    # staged int8 weights differ from the f32 originals. (The probe
+    # SCORES can coincide — the tiny random model's 2-step images
+    # saturate at the ±1 clip — so the image-level delta is not the
+    # right assertion here.)
+    staged = precision_lib.make_resolver("int8")(
+        precision_lib.stage_params(host, "int8"))
+    diffs = [float(np.abs(np.asarray(a, np.float32)
+                          - np.asarray(b, np.float32)).max())
+             for a, b in zip(jax.tree.leaves(staged),
+                             jax.tree.leaves(host))]
+    assert max(diffs) > 0.0
+    with pytest.raises(ValueError, match="precision"):
+        make_psnr_probe(model, dcfg, batch, sample_steps=2,
+                        precision="fp4")
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+def test_config_validation_precision_and_fused_step():
+    with pytest.raises(ValueError, match="serve.precision"):
+        Config(serve=ServeConfig(precision="fp16")).validate()
+    with pytest.raises(ValueError, match="int8"):
+        Config(serve=ServeConfig(precision="int8"),
+               registry=RegistryConfig(dir="")).validate()
+    Config(serve=ServeConfig(precision="int8")).validate()  # dir default
+    with pytest.raises(ValueError, match="fused_step"):
+        Config(diffusion=DiffusionConfig(fused_step="yes")).validate()
+    with pytest.raises(ValueError, match="dpm"):
+        Config(diffusion=DiffusionConfig(sampler="dpm++",
+                                         fused_step=True)).validate()
+    # 'auto' + dpm++ is fine (the request sampler skips fusion).
+    Config(diffusion=DiffusionConfig(sampler="dpm++",
+                                     fused_step="auto")).validate()
+    for flag in (True, False, "auto"):
+        Config(diffusion=DiffusionConfig(fused_step=flag)).validate()
+    for prec in ("float32", "bfloat16", "int8"):
+        Config(serve=ServeConfig(precision=prec)).validate()
+
+
+def test_request_sampler_rejects_forced_fused_dpmpp(setup):
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        sampling_schedule)
+
+    model, _, _, _, _ = setup
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T,
+                           sampler="dpm++", fused_step=True)
+    with pytest.raises(ValueError, match="dpm"):
+        make_request_sampler(model, sampling_schedule(dcfg, T), dcfg)
+    # 'auto' silently keeps the unfused multistep scan.
+    dcfg = dataclasses.replace(dcfg, fused_step="auto")
+    make_request_sampler(model, sampling_schedule(dcfg, T), dcfg)

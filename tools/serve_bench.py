@@ -517,6 +517,202 @@ def continuous_bench(model, params, cfg, conds, args) -> dict:
     return result
 
 
+# ---------------------------------------------------------------------------
+# --precision-sweep: f32/bf16/int8 × fused-step on/off on ONE trace
+# ---------------------------------------------------------------------------
+PRECISION_LANES = (
+    # (serve.precision, diffusion.fused_step) — lane 0 is the baseline
+    # the headline compares against; f32+fused isolates the kernel
+    # (fused on/off A/B at identical numerics-precision), bf16+fused is
+    # the intended TPU serving deployment, int8+fused the quantized one.
+    ("float32", False),
+    ("float32", True),
+    ("bfloat16", True),
+    ("int8", True),
+)
+
+
+def precision_sweep_bench(model, params, cfg, conds, args) -> dict:
+    """The judged --precision-sweep scenario.
+
+    ONE deterministic Poisson trace (mixed step classes, rate calibrated
+    to ~85% of the f32-unfused lane's measured row-step capacity) is
+    replayed open-loop against four services that differ ONLY in
+    (serve.precision, diffusion.fused_step). Open-loop replay measures
+    the serving system under fixed demand — the deployment question —
+    so the assertions are delivery-shaped: the bf16+fused lane must
+    serve at least the f32-unfused lane's RPS (2% replay-jitter
+    tolerance, both numbers in the JSON) with zero expiries and zero
+    recompiles after its warmup, and its fixed-seed PSNR probe
+    (registry/gate.py, staged AT the lane's precision) must sit within
+    registry.gate_margin_db of the f32 probe — the same margin the
+    promotion gate enforces. int8 numbers ride along unasserted (its
+    gate runs at promotion time, against real weights).
+
+    Note for CPU-lane readers: off-TPU the kernel runs in Pallas
+    interpret mode and bf16 weights cost an upcast per use, so the
+    per-step timings in each lane's spans UNDERSTATE the TPU win —
+    the lane exists to prove the precision plumbing end-to-end and to
+    keep the trajectory's numbers labeled, not to project TPU speedups.
+    """
+    import dataclasses
+
+    from novel_view_synthesis_3d_tpu.config import ServeConfig
+    from novel_view_synthesis_3d_tpu.sample.service import SamplingService
+
+    mix = parse_class_map(args.sweep_mix, "--sweep-mix")
+    slo = parse_class_map(args.sweep_slo_ms, "--sweep-slo-ms")
+    max_batch = args.cont_max_batch
+    buckets = []
+    b = 1
+    while b <= max_batch:
+        buckets.append(b)
+        b *= 2
+    few = min(mix)
+    probs = {c: p / sum(mix.values()) for c, p in mix.items()}
+    mean_steps = sum(c * p for c, p in probs.items())
+
+    def make_service(precision: str, fused) -> SamplingService:
+        dcfg = dataclasses.replace(cfg.diffusion, fused_step=fused)
+        return SamplingService(
+            model, params, dcfg,
+            ServeConfig(scheduler="step", max_batch=max_batch,
+                        flush_timeout_ms=args.flush_timeout_ms,
+                        queue_depth=max(64, 2 * args.sweep_requests),
+                        precision=precision,
+                        results_folder="/tmp/nvs3d_serve_bench"),
+            results_folder="/tmp/nvs3d_serve_bench")
+
+    def warm(svc):
+        seed = 90_000
+        for b in buckets:
+            tickets = [svc.submit(conds[j % len(conds)], seed=seed + j,
+                                  sample_steps=few) for j in range(b)]
+            seed += b
+            for t in tickets:
+                t.result(timeout=600)
+
+    trace = None
+    lanes = []
+    for precision, fused in PRECISION_LANES:
+        svc = make_service(precision, fused)
+        try:
+            warm(svc)
+            if trace is None:
+                # Rate calibration on the BASELINE lane only: every lane
+                # then faces the identical demand.
+                t0 = time.perf_counter()
+                cal = 3
+                for j in range(cal):
+                    svc.submit(conds[j % len(conds)], seed=70_000 + j,
+                               sample_steps=few).result(timeout=600)
+                t_row = (time.perf_counter() - t0) / (cal * few)
+                rate = args.cont_rate or round(
+                    0.85 / (mean_steps * t_row), 3)
+                trace = poisson_trace(args.sweep_requests, rate, mix,
+                                      slo, args.cont_seed)
+            before = svc.compile_counters()
+            records, window = replay_trace(svc, conds, trace)
+            after = svc.compile_counters()
+            lane = summarize_replay(records, window)
+            lane.update(
+                precision=precision, fused_step=bool(fused),
+                programs_built_delta=(after["programs_built"]
+                                      - before["programs_built"]),
+                jit_cache_entries_delta=(after["jit_cache_entries"]
+                                         - before["jit_cache_entries"]),
+                ring_step=svc.stats.span_summary("ring_step"),
+                expired=sum(1 for r in records
+                            if r["status"] == "expired"),
+                failed=sum(1 for r in records
+                           if r["status"] in ("failed", "rejected")))
+            lanes.append(lane)
+        finally:
+            svc.stop()
+
+    # Fixed-seed PSNR probe per precision (registry/gate.py): the same
+    # staging the gate and the serving path use, so the reported deltas
+    # ARE what the promotion gate would charge each deployment.
+    from novel_view_synthesis_3d_tpu.data.synthetic import (
+        make_example_batch)
+    from novel_view_synthesis_3d_tpu.registry.gate import make_psnr_probe
+
+    probe_batch = make_example_batch(batch_size=4,
+                                     sidelength=args.sidelength, seed=3)
+    host_params = jax.tree.map(np.asarray, jax.device_get(params))
+    psnr_by_precision = {}
+    for precision in ("float32", "bfloat16", "int8"):
+        probe = make_psnr_probe(
+            model, cfg.diffusion, probe_batch,
+            sample_steps=cfg.registry.gate_sample_steps,
+            seed=cfg.registry.gate_seed, precision=precision)
+        psnr_by_precision[precision] = round(probe(host_params), 4)
+    for lane in lanes:
+        lane["probe_psnr_db"] = psnr_by_precision[lane["precision"]]
+        lane["probe_delta_db"] = round(
+            psnr_by_precision[lane["precision"]]
+            - psnr_by_precision["float32"], 4)
+
+    base = next(l for l in lanes if l["precision"] == "float32"
+                and not l["fused_step"])
+    headline = next(l for l in lanes if l["precision"] == "bfloat16"
+                    and l["fused_step"])
+    return {
+        "trace": {
+            "requests": args.sweep_requests, "rate_per_s": rate,
+            "row_step_s": round(t_row, 4),
+            "mix": {str(k): v for k, v in mix.items()},
+            "slo_ms": {str(k): v for k, v in slo.items()},
+            "seed": args.cont_seed, "max_batch": max_batch,
+        },
+        "lanes": lanes,
+        "psnr_by_precision": psnr_by_precision,
+        "gate_margin_db": cfg.registry.gate_margin_db,
+        "baseline_lane": "float32 unfused",
+        "headline_lane": "bfloat16 fused",
+        "rps_f32_unfused": base["rps_served"],
+        "rps_bf16_fused": headline["rps_served"],
+        "bf16_vs_f32_rps": round(
+            headline["rps_served"] / max(base["rps_served"], 1e-9), 3),
+        "bf16_psnr_delta_db": headline["probe_delta_db"],
+    }
+
+
+def check_precision_sweep(sweep: dict) -> int:
+    """rc=1 on any violated sweep contract (printed to stderr)."""
+    rc = 0
+    headline = next(l for l in sweep["lanes"]
+                    if l["precision"] == "bfloat16" and l["fused_step"])
+    if sweep["bf16_vs_f32_rps"] < 0.98:
+        print("error: bf16+fused served "
+              f"{sweep['rps_bf16_fused']} req/s < f32-unfused "
+              f"{sweep['rps_f32_unfused']} req/s (beyond the 2% "
+              "replay-jitter tolerance) — the precision-lowered fused "
+              "path must not regress delivery", file=sys.stderr)
+        rc = 1
+    if headline["expired"] or headline["failed"]:
+        print(f"error: bf16+fused lane expired {headline['expired']} / "
+              f"failed {headline['failed']} requests under the "
+              "calibrated trace", file=sys.stderr)
+        rc = 1
+    if abs(sweep["bf16_psnr_delta_db"]) > sweep["gate_margin_db"]:
+        print("error: bf16 probe PSNR delta "
+              f"{sweep['bf16_psnr_delta_db']} dB exceeds "
+              f"registry.gate_margin_db={sweep['gate_margin_db']} — the "
+              "promotion gate would refuse this deployment",
+              file=sys.stderr)
+        rc = 1
+    for lane in sweep["lanes"]:
+        if lane["programs_built_delta"] or lane["jit_cache_entries_delta"]:
+            print(f"error: lane {lane['precision']}/fused="
+                  f"{lane['fused_step']} compiled "
+                  f"{lane['programs_built_delta']} program(s) during the "
+                  "warm trace — precision rides the cache key; warm "
+                  "traffic must not recompile", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def hot_swap_bench(service, conds, params, concurrency: int,
                    per_phase: int) -> dict:
     """Publish a new version mid-load and measure the swap's cost.
@@ -678,6 +874,25 @@ def main() -> int:
                          "bounds CONCURRENCY, not throughput, under "
                          "processor sharing")
     ap.add_argument("--cont-seed", type=int, default=0)
+    ap.add_argument("--precision-sweep", action="store_true",
+                    help="judged precision/fused-step scenario: one "
+                         "Poisson trace replayed against f32-unfused, "
+                         "f32-fused, bf16-fused, and int8-fused "
+                         "services, with per-precision PSNR probes and "
+                         "zero-recompile asserts (rc=1 on violation)")
+    ap.add_argument("--sweep-requests", type=int, default=40,
+                    help="trace length for --precision-sweep (4 lanes "
+                         "replay it, so it is sized below --cont-requests)")
+    ap.add_argument("--sweep-mix", default="4:0.85,16:0.15",
+                    help="step-class mix for --precision-sweep")
+    ap.add_argument("--sweep-slo-ms", default="4:8000,16:30000",
+                    help="per-class SLO/deadline ms for --precision-sweep")
+    ap.add_argument("--precision", default=None,
+                    choices=("float32", "bfloat16", "int8"),
+                    help="serve.precision for the classic bench path")
+    ap.add_argument("--fused-step", default=None,
+                    choices=("auto", "on", "off"),
+                    help="diffusion.fused_step for the classic bench path")
     ap.add_argument("--teacher-steps", type=int, default=256,
                     help="step count of the pre-distillation teacher "
                          "(the PR 3 deployment baseline serves everything "
@@ -692,6 +907,34 @@ def main() -> int:
 
     cfg, model, params, conds = build(args.preset, args.sidelength,
                                       args.steps)
+
+    if args.precision_sweep:
+        # Same light backbone as --continuous (a separate metric lane,
+        # never compared to the classic serve_rps numbers); full-depth
+        # timesteps so every step class in the mix fits.
+        cfg, model, params, conds = build(
+            args.preset, args.sidelength, args.steps,
+            extra_overrides=[("model.num_res_blocks", 1),
+                             ("model.attn_resolutions", [8]),
+                             ("diffusion.sample_timesteps",
+                              get_default_timesteps(args.preset))])
+        sweep = precision_sweep_bench(model, params, cfg, conds, args)
+        result = {
+            "metric": f"serve_precision_sweep_{args.preset}",
+            "value": sweep["rps_bf16_fused"],
+            "unit": "req/s",
+            "precision": "bfloat16",
+            "fused_step": True,
+            "vs_baseline": sweep["bf16_vs_f32_rps"],
+            "baseline_value": sweep["rps_f32_unfused"],
+            "baseline": "same trace, serve.precision=float32, "
+                        "diffusion.fused_step=False",
+            "sidelength": args.sidelength,
+            "precision_sweep": sweep,
+            "platform": jax.default_backend(),
+        }
+        print(json.dumps(result))
+        return check_precision_sweep(sweep)
 
     if args.continuous:
         # The continuous scenario runs its own model variant: the preset
@@ -722,6 +965,8 @@ def main() -> int:
             "vs_whole_request_same_trace":
                 cont["vs_whole_request_same_trace"],
             "sidelength": args.sidelength,
+            "precision": cfg.serve.precision,
+            "fused_step": cfg.diffusion.fused_step,
             "continuous": cont,
             "platform": jax.default_backend(),
         }
@@ -738,7 +983,15 @@ def main() -> int:
     scfg = ServeConfig(scheduler=args.scheduler, max_batch=args.max_batch,
                        flush_timeout_ms=args.flush_timeout_ms,
                        queue_depth=max(64, 2 * args.requests),
+                       precision=args.precision or "float32",
                        results_folder="/tmp/nvs3d_serve_bench")
+    dcfg = cfg.diffusion
+    if args.fused_step is not None:
+        import dataclasses as _dc
+        dcfg = _dc.replace(
+            cfg.diffusion,
+            fused_step={"auto": "auto", "on": True,
+                        "off": False}[args.fused_step])
     buckets = []
     b = 1
     while b <= args.max_batch:
@@ -748,7 +1001,7 @@ def main() -> int:
         raise SystemExit("--max-batch must be >= 4 so the warm sweep "
                          "covers >= 3 bucket sizes")
 
-    service = SamplingService(model, params, cfg.diffusion, scfg)
+    service = SamplingService(model, params, dcfg, scfg)
     try:
         warm_service(service, conds, buckets)
 
@@ -784,6 +1037,8 @@ def main() -> int:
             "requests": args.requests,
             "sample_steps": args.steps,
             "sidelength": args.sidelength,
+            "precision": scfg.precision,
+            "fused_step": service.summary()["fused_step"],
             "buckets": buckets,
             "queue_wait": stats.span_summary("queue_wait"),
             "device": stats.span_summary("device"),

@@ -236,19 +236,62 @@ def cpu_lane_lines(repo_root: str):
             rows.append((os.path.basename(path), d.get("rc"),
                          parsed.get("lane", parsed.get("platform", "?")),
                          parsed.get("metric"), parsed.get("value"),
-                         parsed.get("vs_baseline")))
+                         parsed.get("vs_baseline"),
+                         parsed.get("precision", "-"),
+                         parsed.get("fused_step", "-")))
         else:
             rows.append((os.path.basename(path), d.get("rc"), "-",
-                         "(no parsed datapoint)", None, None))
+                         "(no parsed datapoint)", None, None, "-", "-"))
     if not rows:
         return []
-    lines += ["| round | rc | lane | metric | value | vs_baseline |",
-              "|---|---|---|---|---|---|"]
-    for name, rc, lane, metric, value, vsb in rows:
-        lines.append("| {} | {} | {} | {} | {} | {} |".format(
+    # precision / fused_step columns (PR 8): the trajectory must record
+    # what was measured — a bf16+fused number next to an f32 one is a
+    # different deployment, not a regression/improvement of the same.
+    lines += ["| round | rc | lane | metric | value | vs_baseline | "
+              "precision | fused_step |",
+              "|---|---|---|---|---|---|---|---|"]
+    for name, rc, lane, metric, value, vsb, prec, fused in rows:
+        lines.append("| {} | {} | {} | {} | {} | {} | {} | {} |".format(
             name, rc, lane, metric,
             fmt(value) if value is not None else "null",
-            fmt(vsb) if vsb is not None else ""))
+            fmt(vsb) if vsb is not None else "", prec, fused))
+    return lines
+
+
+def precision_sweep_lines(rows):
+    """Per-lane tables for serve_bench --precision-sweep artifacts:
+    precision/fused-step delivery + the per-precision PSNR probe deltas
+    the promotion gate would charge each deployment."""
+    lines = []
+    for name, d in rows:
+        sweep = d.get("precision_sweep")
+        if not isinstance(sweep, dict):
+            continue
+        lines += ["", f"## Precision sweep — {name}", ""]
+        tr = sweep.get("trace", {})
+        lines.append(
+            f"- trace: {tr.get('requests')} req @ "
+            f"{tr.get('rate_per_s')}/s, mix {tr.get('mix')}; gate "
+            f"margin {sweep.get('gate_margin_db')} dB")
+        lines.append(
+            f"- headline: bf16+fused {sweep.get('rps_bf16_fused')} req/s "
+            f"vs f32-unfused {sweep.get('rps_f32_unfused')} req/s "
+            f"({sweep.get('bf16_vs_f32_rps')}×), probe delta "
+            f"{sweep.get('bf16_psnr_delta_db')} dB")
+        lines += ["",
+                  "| precision | fused | rps | goodput | expired | "
+                  "built | probe PSNR (dB) | Δ vs f32 (dB) |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for lane in sweep.get("lanes", []):
+            lines.append(
+                "| {} | {} | {} | {} | {} | {} | {} | {} |".format(
+                    lane.get("precision"), lane.get("fused_step"),
+                    fmt(lane.get("rps_served", 0.0)),
+                    fmt(lane.get("rps_goodput", 0.0)),
+                    lane.get("expired", 0),
+                    lane.get("programs_built_delta", 0),
+                    fmt(lane.get("probe_psnr_db", 0.0)),
+                    fmt(lane.get("probe_delta_db", 0.0))))
     return lines
 
 
@@ -257,19 +300,21 @@ def main() -> int:
     out_dir = args[0] if args else os.path.join("results", "tpu_r04")
     lines = [
         f"# Bench summary — {out_dir}", "",
-        "| entry | metric | value | unit | vs_baseline | platform | mfu |",
-        "|---|---|---|---|---|---|---|",
+        "| entry | metric | value | unit | vs_baseline | platform | mfu "
+        "| precision | fused_step |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     rows = load_rows(out_dir)
     for name, d in rows:
         lines.append(
-            "| {} | {} | {} | {} | {} | {} | {} |".format(
+            "| {} | {} | {} | {} | {} | {} | {} | {} | {} |".format(
                 name, d.get("metric", "?"), fmt(d.get("value", "?")),
                 d.get("unit", ""), fmt(d.get("vs_baseline", "")),
                 d.get("platform", "?"),
-                fmt(d.get("mfu", "")) if d.get("mfu") else ""))
+                fmt(d.get("mfu", "")) if d.get("mfu") else "",
+                d.get("precision", ""), d.get("fused_step", "")))
     if not rows:
-        lines.append("| (no artifacts yet) | | | | | | |")
+        lines.append("| (no artifacts yet) | | | | | | | | |")
     # Quality summaries live in sibling dirs; pull their headline if there.
     for qdir in sorted(d for d in os.listdir("results")
                        if d.startswith("quality_tpu")):
@@ -284,6 +329,8 @@ def main() -> int:
     # Per-step-class latency tables for any serve_bench --continuous
     # artifacts in the dir (the step-level continuous-batching scenario).
     lines += continuous_lines(rows)
+    # Precision/fused-step lanes for any --precision-sweep artifacts.
+    lines += precision_sweep_lines(rows)
     # The restored CPU-lane trajectory from the repo-root BENCH archives.
     lines += cpu_lane_lines(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
