@@ -182,6 +182,12 @@ def cmd_sample(args, overrides: List[str]) -> int:
     if args.stochastic and args.denoise_gif:
         # Fail fast — before dataset IO and checkpoint restore.
         raise SystemExit("--denoise-gif is not supported with --stochastic")
+    if args.trajectory and (args.stochastic or args.denoise_gif):
+        raise SystemExit(
+            "--trajectory is the serving-grade device-resident orbit "
+            "path (stepper ring + frame bank); it does not combine with "
+            "--stochastic (the offline autoregressive sampler) or "
+            "--denoise-gif")
     if args.pool_views < 1:
         # Unconditional: with --stochastic, 0/negative would silently
         # behave as 1 (the seeding branch only fires for pool_views > 1).
@@ -191,6 +197,8 @@ def cmd_sample(args, overrides: List[str]) -> int:
                          "stochastic-conditioning pool)")
     cfg = build_config(args, overrides)
     dcfg = cfg.diffusion
+    if args.trajectory:
+        args.num_views = args.trajectory
     ds = SRNDataset(args.folder or cfg.data.root_dir,
                     img_sidelength=cfg.data.img_sidelength)
     inst = ds.instances[args.instance % ds.num_instances]
@@ -232,7 +240,46 @@ def cmd_sample(args, overrides: List[str]) -> int:
     schedule = sampling_schedule(dcfg, args.sample_steps)
     key = jax.random.PRNGKey(args.seed)
 
-    if args.stochastic:
+    if args.trajectory:
+        # Serving-grade orbit: ONE TrajectoryRequest through the stepper
+        # ring — the frame bank stays device-resident, each denoise step
+        # conditions stochastically on it, frames stream back as they
+        # finish (docs/DESIGN.md "Trajectory serving & stochastic
+        # conditioning"). The offline twin of `nvs3d serve --trajectory`.
+        import dataclasses
+
+        from novel_view_synthesis_3d_tpu.sample.service import (
+            SamplingService)
+        from novel_view_synthesis_3d_tpu.utils.images import (
+            save_image_strip)
+
+        scfg = cfg.serve
+        if scfg.scheduler != "step" or scfg.k_max < 1:
+            scfg = dataclasses.replace(scfg, scheduler="step",
+                                       k_max=max(8, scfg.k_max))
+            print(f"note: --trajectory enables serve.scheduler='step', "
+                  f"serve.k_max={scfg.k_max} (set serve.k_max to size "
+                  "the conditioning window)")
+        os.makedirs(args.out, exist_ok=True)
+        service = SamplingService(model, params, dcfg, scfg,
+                                  results_folder=args.out,
+                                  model_version=f"ckpt:{step}")
+        try:
+            ticket = service.submit_trajectory(
+                {"x": x, "R1": pose1[:3, :3], "t1": pose1[:3, 3],
+                 "K": inst.K},
+                poses=poses2, seed=args.seed,
+                sample_steps=args.sample_steps)
+            frames = []
+            for i, img in ticket.frames(timeout=600):
+                frames.append(img)
+                print(json.dumps({"frame_index": i,
+                                  "model_version": ticket.model_version}))
+            imgs = np.stack(frames)
+        finally:
+            service.stop()
+        save_image_strip(imgs, os.path.join(args.out, "orbit_strip.png"))
+    elif args.stochastic:
         # Autoregressive 3DiM sampling: each generated view joins the
         # conditioning pool for the next (sample/ddpm.py). --pool-views
         # seeds the pool with that many REAL dataset views (cond_view
@@ -385,24 +432,60 @@ def cmd_serve(args, overrides: List[str]) -> int:
 
         mesh = mesh_lib.fit_local_mesh(cfg.mesh)
 
-    def build_request(spec: dict) -> dict:
+    def build_request(spec: dict) -> tuple:
+        """(cond, poses): poses is None for single-frame specs, an
+        (N, 4, 4) stack for trajectory specs (`poses` = explicit pose
+        matrices, `orbit` = N synthetic orbit poses at the conditioning
+        camera's radius)."""
+        from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+
         inst = ds.instances[int(spec.get("instance", 0)) % ds.num_instances]
         cx, cpose = inst.view(int(spec.get("cond_view", 0)) % len(inst))
+        poses = None
+        if spec.get("poses") is not None:
+            poses = np.asarray(spec["poses"], np.float32)
+        elif spec.get("orbit"):
+            poses = orbit_poses(
+                int(spec["orbit"]),
+                radius=float(np.linalg.norm(cpose[:3, 3])),
+                elevation=float(spec.get("elevation", 0.3)))
+        if poses is not None:
+            return {"x": cx, "R1": cpose[:3, :3], "t1": cpose[:3, 3],
+                    "K": inst.K}, poses
         _, tpose = inst.view(int(spec.get("target_view", 1)) % len(inst))
         return {
             "x": cx, "R1": cpose[:3, :3], "t1": cpose[:3, 3],
             "R2": tpose[:3, :3], "t2": tpose[:3, 3], "K": inst.K,
-        }
+        }, None
 
     if args.requests:
         with open(args.requests) as fh:
             specs = [json.loads(ln) for ln in fh if ln.strip()]
+    elif args.trajectory:
+        # Trajectory demo sweep: each request is an N-frame orbit; the
+        # frames stream back per request and land as an orbit PNG strip.
+        specs = [{"instance": args.instance + i,
+                  "cond_view": args.cond_view, "orbit": args.trajectory,
+                  "seed": args.seed + i}
+                 for i in range(args.num_requests)]
     else:
         specs = [{"instance": args.instance, "cond_view": args.cond_view,
                   "target_view": i + 1, "seed": args.seed + i}
                  for i in range(args.num_requests)]
     if not specs:
         raise SystemExit("no requests (empty --requests file)")
+    wants_traj = any(s.get("poses") is not None or s.get("orbit")
+                     for s in specs)
+    if wants_traj and (cfg.serve.k_max < 1
+                       or cfg.serve.scheduler != "step"):
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, serve=dataclasses.replace(
+            cfg.serve, scheduler="step",
+            k_max=max(8, cfg.serve.k_max)))
+        print(f"note: trajectory requests enable serve.scheduler='step',"
+              f" serve.k_max={cfg.serve.k_max} (set serve.k_max to size "
+              "the frame bank)")
 
     os.makedirs(args.out, exist_ok=True)
     # Unified telemetry (obs/): the service's pipeline spans (queue_wait →
@@ -427,20 +510,59 @@ def cmd_serve(args, overrides: List[str]) -> int:
                 s, kind, detail, model_version=version,
                 echo="[registry]"))
     try:
+        from novel_view_synthesis_3d_tpu.utils.images import (
+            save_image_strip)
+
         tickets = []
         for i, spec in enumerate(specs):
             try:
-                tickets.append((i, service.submit(
-                    build_request(spec),
-                    seed=int(spec.get("seed", args.seed + i)),
-                    sample_steps=spec.get("sample_steps",
-                                          args.sample_steps),
-                    guidance_weight=spec.get("guidance_weight"),
-                    deadline_ms=spec.get("deadline_ms"))))
+                cond, poses = build_request(spec)
+                if poses is not None:
+                    tickets.append((i, service.submit_trajectory(
+                        cond, poses=poses,
+                        seed=int(spec.get("seed", args.seed + i)),
+                        sample_steps=spec.get("sample_steps",
+                                              args.sample_steps),
+                        guidance_weight=spec.get("guidance_weight"),
+                        deadline_ms=spec.get("deadline_ms"),
+                        k_max=spec.get("k_max"))))
+                else:
+                    tickets.append((i, service.submit(
+                        cond,
+                        seed=int(spec.get("seed", args.seed + i)),
+                        sample_steps=spec.get("sample_steps",
+                                              args.sample_steps),
+                        guidance_weight=spec.get("guidance_weight"),
+                        deadline_ms=spec.get("deadline_ms"))))
             except Rejected as e:
                 print(f"request {i}: rejected ({e})")
         served = 0
+        orbits = 0
         for i, ticket in tickets:
+            if hasattr(ticket, "frames"):  # TrajectoryTicket: stream
+                frames = []
+                try:
+                    # Per-frame streaming: each response line carries
+                    # frame_index + model_version the moment the frame
+                    # finishes — clients render while the rest of the
+                    # orbit is still on device.
+                    for j, img in ticket.frames(timeout=args.timeout):
+                        save_image(img, os.path.join(
+                            args.out, f"request_{i:04d}_frame_{j:03d}.png"))
+                        print(json.dumps({
+                            "request": i, "frame_index": j,
+                            "model_version": ticket.model_version}))
+                        frames.append(img)
+                        served += 1
+                except Exception as e:
+                    print(f"request {i}: failed after {len(frames)} "
+                          f"frame(s) ({e})")
+                if frames:
+                    save_image_strip(np.stack(frames), os.path.join(
+                        args.out, f"request_{i:04d}_orbit.png"))
+                if len(frames) == ticket.num_frames:
+                    orbits += 1
+                continue
             try:
                 # Bounded wait: a dispatch wedged on the device must
                 # surface as a per-request TimeoutError, not an eternal
@@ -456,9 +578,11 @@ def cmd_serve(args, overrides: List[str]) -> int:
             watcher.stop()
         service.stop()
         telemetry.finalize()  # trace.json + gauges flushed into --out
-    print(json.dumps(dict(service.summary(), served=served,
-                          submitted=len(specs),
-                          checkpoint_step=step)))
+    summary = dict(service.summary(), served=served,
+                   submitted=len(specs), checkpoint_step=step)
+    if wants_traj:
+        summary["orbits_completed"] = orbits
+    print(json.dumps(summary))
     return 0
 
 
@@ -720,7 +844,7 @@ def cmd_distill(args, overrides: List[str]) -> int:
 
     from novel_view_synthesis_3d_tpu.models.xunet import XUNet
     from novel_view_synthesis_3d_tpu.registry import (
-        RegistryError, RegistryStore, make_psnr_probe, promote, run_gate)
+        RegistryError, RegistryStore, promote)
     from novel_view_synthesis_3d_tpu.train.distill import run_distill
 
     cfg = build_config(args, overrides)
@@ -765,27 +889,19 @@ def cmd_distill(args, overrides: List[str]) -> int:
         print(json.dumps(dict(r.to_dict(), teacher=vid)))
     final = results[-1]
     if args.promote_channel:
-        probe = make_psnr_probe(
-            model, cfg.diffusion,
-            _gate_probe_batch(cfg, args.folder),
-            sample_steps=final.student_steps,
-            seed=cfg.registry.gate_seed,
-            precision=cfg.serve.precision)
+        # The gate probes AT the student's serving step count; with
+        # registry.gate_trajectory_frames set, the multi-view
+        # consistency gate ALSO runs — a few-step student whose orbit
+        # drifts is refused even when its single frames gate clean.
         try:
-            gate = run_gate(store, final.version,
-                            channel=args.promote_channel, probe_fn=probe,
-                            margin_db=cfg.registry.gate_margin_db,
-                            event_cb=event_cb)
+            passed, gate = _run_gates(
+                cfg, model, store, final.version, args.promote_channel,
+                _gate_probe_batch(cfg, args.folder),
+                psnr_sample_steps=final.student_steps,
+                event_cb=event_cb)
         except RegistryError as e:
             raise SystemExit(f"gate error: {e}")
-        print(json.dumps({
-            "candidate": gate.candidate, "incumbent": gate.incumbent,
-            "candidate_psnr": round(gate.candidate_psnr, 3),
-            "incumbent_psnr": (None if gate.incumbent_psnr is None
-                               else round(gate.incumbent_psnr, 3)),
-            "gate_sample_steps": final.student_steps,
-            "passed": gate.passed, "reason": gate.reason}))
-        if not gate.passed:
+        if not passed:
             print(f"promotion REFUSED: {gate.reason} (channel "
                   f"{args.promote_channel} untouched)")
             return 1
@@ -840,6 +956,51 @@ def _gate_probe_batch(cfg, folder: Optional[str]) -> dict:
     return make_example_batch(batch_size=rcfg.gate_batch,
                               sidelength=cfg.data.img_sidelength,
                               seed=rcfg.gate_seed)
+
+
+def _run_gates(cfg, model, store, vid: str, channel: str, batch: dict,
+               *, psnr_sample_steps: int, event_cb, folder=None):
+    """Run every configured promotion gate for one candidate.
+
+    Always the fixed-seed single-frame PSNR probe; additionally, when
+    registry.gate_trajectory_frames > 0, the multi-view CONSISTENCY
+    probe (adjacent-frame PSNR over a fixed stochastic-conditioning
+    orbit, registry/gate.make_trajectory_probe) under the SAME
+    gate_margin_db — so distilled/quantized candidates are judged on
+    trajectory quality, not just single-frame fidelity. Prints one JSON
+    line per gate; returns (all_passed, gate_result_for_promote)."""
+    del folder
+    from novel_view_synthesis_3d_tpu.registry import (
+        make_psnr_probe, make_trajectory_probe, run_gate)
+
+    rcfg = cfg.registry
+    probes = [("psnr", make_psnr_probe(
+        model, cfg.diffusion, batch, sample_steps=psnr_sample_steps,
+        seed=rcfg.gate_seed, precision=cfg.serve.precision))]
+    if rcfg.gate_trajectory_frames:
+        probes.append(("trajectory_consistency", make_trajectory_probe(
+            model, cfg.diffusion, batch,
+            frames=rcfg.gate_trajectory_frames,
+            sample_steps=rcfg.gate_sample_steps, seed=rcfg.gate_seed,
+            precision=cfg.serve.precision,
+            k_max=cfg.serve.k_max or None)))
+    last = None
+    for metric, probe in probes:
+        gate = run_gate(store, vid, channel=channel, probe_fn=probe,
+                        margin_db=rcfg.gate_margin_db,
+                        event_cb=event_cb, metric=metric)
+        print(json.dumps({
+            "metric": metric,
+            "candidate": gate.candidate, "incumbent": gate.incumbent,
+            "candidate_psnr": round(gate.candidate_psnr, 3),
+            "incumbent_psnr": (None if gate.incumbent_psnr is None
+                               else round(gate.incumbent_psnr, 3)),
+            "margin_db": gate.margin_db,
+            "passed": gate.passed, "reason": gate.reason}))
+        last = gate
+        if not gate.passed:
+            return False, gate
+    return True, last
 
 
 def cmd_registry(args, overrides: List[str]) -> int:
@@ -911,8 +1072,7 @@ def cmd_registry(args, overrides: List[str]) -> int:
         return 0
 
     if sub == "promote":
-        from novel_view_synthesis_3d_tpu.registry import (
-            promote, run_gate)
+        from novel_view_synthesis_3d_tpu.registry import promote
 
         cfg = build_config(args, overrides)
         channel = args.channel or cfg.registry.channel
@@ -924,36 +1084,21 @@ def cmd_registry(args, overrides: List[str]) -> int:
         gate_result = None
         if not args.force:
             from novel_view_synthesis_3d_tpu.models.xunet import XUNet
-            from novel_view_synthesis_3d_tpu.registry import (
-                make_psnr_probe)
 
             # Probe AT the serving precision (serve.precision): a
             # version promoted into a bf16/int8 deployment is gated on
-            # what that deployment actually computes with.
-            probe = make_psnr_probe(
-                XUNet(cfg.model), cfg.diffusion,
-                _gate_probe_batch(cfg, args.folder),
-                sample_steps=cfg.registry.gate_sample_steps,
-                seed=cfg.registry.gate_seed,
-                precision=cfg.serve.precision)
+            # what that deployment actually computes with. With
+            # registry.gate_trajectory_frames set, the multi-view
+            # consistency gate runs too (same margin).
             try:
-                gate_result = run_gate(
-                    store, vid, channel=channel, probe_fn=probe,
-                    margin_db=cfg.registry.gate_margin_db,
+                passed, gate_result = _run_gates(
+                    cfg, XUNet(cfg.model), store, vid, channel,
+                    _gate_probe_batch(cfg, args.folder),
+                    psnr_sample_steps=cfg.registry.gate_sample_steps,
                     event_cb=event_cb)
             except RegistryError as e:
                 raise SystemExit(f"gate error: {e}")
-            print(json.dumps({
-                "candidate": gate_result.candidate,
-                "incumbent": gate_result.incumbent,
-                "candidate_psnr": round(gate_result.candidate_psnr, 3),
-                "incumbent_psnr": (
-                    None if gate_result.incumbent_psnr is None
-                    else round(gate_result.incumbent_psnr, 3)),
-                "margin_db": gate_result.margin_db,
-                "passed": gate_result.passed,
-                "reason": gate_result.reason}))
-            if not gate_result.passed:
+            if not passed:
                 print(f"promotion REFUSED: {gate_result.reason} "
                       f"(channel {channel} still -> "
                       f"{store.read_channel(channel)})")
@@ -1044,6 +1189,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="orbit elevation (radians), --poses orbit only")
     p.add_argument("--stochastic", action="store_true",
                    help="3DiM autoregressive stochastic conditioning")
+    p.add_argument("--trajectory", type=int, default=0, metavar="N",
+                   help="serving-grade N-frame orbit: one "
+                        "TrajectoryRequest through the stepper ring — "
+                        "device-resident frame bank, stochastic "
+                        "conditioning per step, frames streamed as they "
+                        "finish; writes orbit_strip.png beside the views")
     p.add_argument("--sample-steps", type=int, default=None,
                    help="respaced DDPM steps (default: config)")
     p.add_argument("--step", type=int, default=None,
@@ -1072,8 +1223,16 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--requests", default=None, metavar="JSONL",
                    help="JSON-lines request file (fields: instance, "
                         "cond_view, target_view, seed, sample_steps, "
-                        "guidance_weight, deadline_ms); default: a "
-                        "--num-requests demo sweep")
+                        "guidance_weight, deadline_ms; trajectory "
+                        "requests add poses=[[4x4],...] or orbit=N plus "
+                        "optional k_max — responses then stream one "
+                        "line per frame with frame_index/model_version);"
+                        " default: a --num-requests demo sweep")
+    p.add_argument("--trajectory", type=int, default=0, metavar="N",
+                   help="demo sweep serves N-frame ORBITS instead of "
+                        "single views: --num-requests trajectories "
+                        "stream per-frame responses and write per-"
+                        "request orbit PNG strips")
     p.add_argument("--num-requests", type=int, default=8)
     p.add_argument("--instance", type=int, default=0)
     p.add_argument("--cond-view", type=int, default=0)

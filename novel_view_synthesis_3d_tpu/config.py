@@ -133,6 +133,18 @@ class DiffusionConfig:
     # errors, 'auto' falls back to the unfused scan ('request'
     # scheduler) / the first-order fallback fuses fine ('step').
     fused_step: Any = False
+    # Stochastic multi-view conditioning for trajectory serving
+    # (3DiM §3.2; docs/DESIGN.md "Trajectory serving & stochastic
+    # conditioning"). True (default): each denoise step of a trajectory
+    # row draws its conditioning view UNIFORMLY from the row's frame
+    # bank with the slot's PRNG carry — the paper's protocol, what makes
+    # a k=1 model render consistent orbits. False: condition every step
+    # on the MOST RECENT bank frame (deterministic; an ablation/debug
+    # mode, not the paper protocol). Changes the compiled step program
+    # body, so it rides the stepper program-cache key; the bank gather
+    # happens BEFORE the UNet forward either way, so diffusion.fused_step
+    # kernels (ops/fused_step.py) fuse unchanged.
+    stochastic_cond: Any = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -401,6 +413,24 @@ class ServeConfig:
     # quantized deployment must serve gate-probed registry versions
     # (`nvs3d serve --registry`), never raw checkpoints.
     precision: str = "float32"
+    # Trajectory serving (docs/DESIGN.md "Trajectory serving & stochastic
+    # conditioning"): per-ring-slot FRAME BANK capacity — the device-
+    # resident (k_max, H, W, C) buffer of clean frames each trajectory
+    # request conditions on (a random bank view per denoise step, the
+    # 3DiM stochastic-conditioning protocol, drawn in-jit from the
+    # slot's PRNG carry). 0 (default) disables trajectory serving
+    # entirely: the stepper runs the exact pre-bank program, so
+    # single-shot serving is bit-identical to a build without this
+    # feature (zero-cost when unused). > 0 requires scheduler='step'
+    # (the whole-request dispatcher has no ring for frames to re-enter).
+    # k_max is part of the stepper program SHAPE, so one service serves
+    # one k_max — mixed single-shot + trajectory traffic still compiles
+    # one program per bucket (per-request banks smaller than k_max ride
+    # the same arrays with a lower effective window).
+    k_max: int = 0
+    # Upper bound on poses per TrajectoryRequest (backpressure for
+    # orbit-sized requests: a 10k-frame request is a typo, not a load).
+    max_frames: int = 64
     # Where the service writes its events.csv (rejections, deadline
     # expiries) — same schema as the trainer's.
     results_folder: str = "./serve"
@@ -470,6 +500,15 @@ class RegistryConfig:
     gate_sample_steps: int = 8
     # Probe batch rows scored by the gate.
     gate_batch: int = 4
+    # Multi-view consistency gate (eval/metrics.multi_view_consistency):
+    # when > 0, `nvs3d registry promote` and the distill auto-promote
+    # ALSO probe adjacent-frame PSNR over a fixed autoregressive orbit
+    # of this many frames (stochastic conditioning, fixed seed), and a
+    # candidate regressing that metric beyond gate_margin_db is refused
+    # — distilled/quantized models are gated on TRAJECTORY quality, not
+    # just single-frame PSNR. 0 (default) = single-frame gate only.
+    # Needs >= 2 frames for an adjacent pair.
+    gate_trajectory_frames: int = 0
     # Fixed probe seed: candidate and incumbent see identical noise.
     gate_seed: int = 0
     # `registry gc` retention: keep the newest K versions (channel-pinned
@@ -803,6 +842,29 @@ class Config:
                 "deploys versions whose PSNR gate probed them at int8 "
                 "(registry/gate.py), so quantization loss counts "
                 "against registry.gate_margin_db")
+        if sv.k_max < 0:
+            errors.append(
+                f"serve.k_max={sv.k_max} must be >= 0 (0 disables "
+                "trajectory serving; > 0 sizes each ring slot's device-"
+                "resident frame bank)")
+        elif sv.k_max > 0 and sv.scheduler != "step":
+            errors.append(
+                f"serve.k_max={sv.k_max} requires serve.scheduler='step' "
+                "— trajectory frames re-enter the stepper RING between "
+                "denoise steps; the whole-request dispatcher has no ring "
+                "for them to re-enter (set serve.scheduler='step' or "
+                "serve.k_max=0)")
+        if sv.max_frames < 1:
+            errors.append(
+                f"serve.max_frames={sv.max_frames} must be >= 1 (it "
+                "bounds the poses per trajectory request)")
+        sc = self.diffusion.stochastic_cond
+        if sc not in (True, False):
+            errors.append(
+                f"diffusion.stochastic_cond={sc!r} must be True (draw a "
+                "random frame-bank view per denoise step — the 3DiM "
+                "protocol) or False (condition on the most recent bank "
+                "frame; deterministic ablation mode)")
         fs = self.diffusion.fused_step
         if fs not in (True, False, "auto"):
             errors.append(
@@ -854,6 +916,12 @@ class Config:
         if rg.gate_batch < 1:
             errors.append(
                 f"registry.gate_batch={rg.gate_batch} must be >= 1")
+        if rg.gate_trajectory_frames < 0 or rg.gate_trajectory_frames == 1:
+            errors.append(
+                f"registry.gate_trajectory_frames="
+                f"{rg.gate_trajectory_frames} must be 0 (single-frame "
+                "gate only) or >= 2 (adjacent-frame consistency needs at "
+                "least one frame pair)")
         if rg.keep < 1:
             errors.append(
                 f"registry.keep={rg.keep} must be >= 1 (gc must retain at "

@@ -273,10 +273,16 @@ def _tables_from_betas(betas: np.ndarray) -> dict:
         betas * (1.0 - alphas_cumprod_prev) / (1.0 - alphas_cumprod)
     )
     # log clipped: t=0 posterior variance is 0, replace with t=1's value
-    # (standard DDPM practice; matches reference sampling.py:37-38).
-    posterior_log_variance_clipped = np.log(
-        np.append(posterior_variance[1], posterior_variance[1:])
-    )
+    # (standard DDPM practice; matches reference sampling.py:37-38). A
+    # SINGLE-step ladder (progressive distillation's endpoint; respaced
+    # steps=1) has no t=1: floor the lone value instead — the final
+    # step adds no noise (the t>0 mask zeroes the term), so the floored
+    # log-variance is never read, it just must not be log(0) = -inf.
+    if len(posterior_variance) > 1:
+        clipped = np.append(posterior_variance[1], posterior_variance[1:])
+    else:
+        clipped = np.maximum(posterior_variance, 1e-20)
+    posterior_log_variance_clipped = np.log(clipped)
     return dict(
         betas=betas,
         alphas_cumprod=alphas_cumprod,

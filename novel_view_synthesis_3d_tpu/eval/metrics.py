@@ -71,6 +71,44 @@ def ssim(pred: jnp.ndarray, target: jnp.ndarray, data_range: float = 2.0,
 
 
 # ---------------------------------------------------------------------------
+# Multi-view consistency (trajectory serving / stochastic conditioning)
+# ---------------------------------------------------------------------------
+def adjacent_psnr(frames: jnp.ndarray,
+                  data_range: float = 2.0) -> jnp.ndarray:
+    """PSNR (dB) between each adjacent frame pair of an ordered orbit.
+
+    frames: (N, H, W, C) with N >= 2 (or (B, N, H, W, C); the pair axis
+    is -4 either way). On a smooth orbit, adjacent views overlap almost
+    entirely, so adjacent-frame PSNR is a geometry-free proxy for 3D
+    consistency: a model whose autoregressive frames drift (the failure
+    mode stochastic conditioning exists to prevent, 3DiM §3.2) scores
+    low even when each frame is individually plausible — which is why
+    the registry gate can use it to judge TRAJECTORY quality where
+    single-frame PSNR sees nothing wrong.
+    """
+    if frames.shape[-4] < 2:
+        raise ValueError(
+            f"adjacent_psnr needs >= 2 frames, got {frames.shape[-4]}")
+    a = jnp.moveaxis(frames, -4, 0)
+    return psnr(a[:-1], a[1:], data_range=data_range)
+
+
+def multi_view_consistency(frames: jnp.ndarray,
+                           data_range: float = 2.0) -> dict:
+    """Orbit consistency summary: {'mean_db', 'min_db', 'per_pair'}.
+
+    `mean_db` is the gate/eval headline (average adjacent-frame PSNR);
+    `min_db` flags a single catastrophic frame a mean would smooth over.
+    """
+    pairs = adjacent_psnr(frames, data_range=data_range)
+    return {
+        "mean_db": float(jnp.mean(pairs)),
+        "min_db": float(jnp.min(pairs)),
+        "per_pair": np.asarray(pairs),
+    }
+
+
+# ---------------------------------------------------------------------------
 # FID (Fréchet distance between feature distributions)
 # ---------------------------------------------------------------------------
 #

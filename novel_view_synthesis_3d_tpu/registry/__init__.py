@@ -17,6 +17,7 @@ from novel_view_synthesis_3d_tpu.registry.gate import (  # noqa: F401
     GateResult,
     decide,
     make_psnr_probe,
+    make_trajectory_probe,
     promote,
     rollback,
     run_gate,

@@ -125,13 +125,87 @@ def make_psnr_probe(model, diffusion, batch: dict, *,
     return probe
 
 
+def make_trajectory_probe(model, diffusion, batch: dict, *,
+                          frames: int, sample_steps: int, seed: int = 0,
+                          precision: str = "float32",
+                          k_max: Optional[int] = None):
+    """probe(params) -> mean adjacent-frame PSNR (dB) over a fixed orbit.
+
+    The multi-view CONSISTENCY tripwire
+    (eval/metrics.multi_view_consistency): the candidate autoregressively
+    renders a fixed-seed orbit with stochastic conditioning — each frame
+    conditions on a random previously generated view, exactly the
+    trajectory-serving workload — and is scored on how well adjacent
+    frames agree. A distilled or quantized model whose SINGLE frames
+    look fine but whose orbit drifts (the failure mode few-step
+    students are prone to) regresses here, so pairing this probe with
+    `make_psnr_probe` under the same `registry.gate_margin_db` gates
+    promotions on trajectory quality, not just single-frame PSNR.
+    Deterministic: fixed key, fixed orbit poses (camera radius taken
+    from the probe batch), identical noise for candidate and incumbent.
+    `precision` stages weights exactly like the serving path, as in
+    `make_psnr_probe`."""
+    import jax
+    import numpy as np
+
+    from novel_view_synthesis_3d_tpu.diffusion.schedules import (
+        sampling_schedule)
+    from novel_view_synthesis_3d_tpu.eval.metrics import adjacent_psnr
+    from novel_view_synthesis_3d_tpu.sample import (
+        precision as precision_lib)
+    from novel_view_synthesis_3d_tpu.sample.ddpm import (
+        autoregressive_generate, make_stochastic_sampler)
+    from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+
+    if frames < 2:
+        raise ValueError(
+            f"trajectory probe needs frames >= 2 (adjacent pairs), "
+            f"got {frames}")
+    precision_lib.validate_precision(precision)
+    schedule = sampling_schedule(diffusion, sample_steps)
+    first_view = {
+        "x": np.asarray(batch["x"])[:1],
+        "R1": np.asarray(batch["R1"])[:1],
+        "t1": np.asarray(batch["t1"])[:1],
+        "K": np.asarray(batch["K"])[:1],
+    }
+    radius = float(np.linalg.norm(first_view["t1"][0]))
+    orbit = orbit_poses(frames, radius=radius or 1.0, elevation=0.3)
+    target_poses = {
+        "R2": np.asarray(orbit[None, :, :3, :3]),
+        "t2": np.asarray(orbit[None, :, :3, 3]),
+    }
+    pool = max(2, k_max or (frames + 1))
+    sampler = make_stochastic_sampler(model, schedule, diffusion,
+                                      max_pool=pool)
+    key = jax.random.PRNGKey(seed)
+
+    def stage(params):
+        staged = precision_lib.stage_params(params, precision)
+        if precision == "int8":
+            staged = precision_lib.make_resolver("int8")(staged)
+        return staged
+
+    def probe(params) -> float:
+        imgs = autoregressive_generate(
+            model, schedule, diffusion, stage(params), key, first_view,
+            target_poses, max_pool=pool, sampler=sampler)
+        imgs = np.asarray(jax.device_get(imgs))[0]  # (N, H, W, 3)
+        return float(np.mean(np.asarray(adjacent_psnr(imgs))))
+
+    return probe
+
+
 def run_gate(store: RegistryStore, candidate_vid: str, *, channel: str,
              probe_fn: Callable, margin_db: float,
-             event_cb: Optional[EventCb] = None) -> GateResult:
+             event_cb: Optional[EventCb] = None,
+             metric: str = "psnr") -> GateResult:
     """Score candidate vs the channel's incumbent; never moves pointers.
 
     The candidate payload is hash-verified on load, so a tampered or torn
-    version fails here (IntegrityError) before any PSNR is computed."""
+    version fails here (IntegrityError) before any PSNR is computed.
+    `metric` names the probe in the audit event (the trajectory-
+    consistency gate runs through here too, with its own probe_fn)."""
     incumbent_vid = store.read_channel(channel)
     cand_manifest = store.verify(candidate_vid)
     candidate_params = store.load_params(candidate_vid, verify=False)
@@ -152,8 +226,8 @@ def run_gate(store: RegistryStore, candidate_vid: str, *, channel: str,
                else "")
         event_cb(cand_manifest.step,
                  "gate_pass" if passed else "gate_fail",
-                 f"channel {channel}: candidate {candidate_psnr:.2f} dB"
-                 f"{inc}; {reason}", candidate_vid)
+                 f"channel {channel} [{metric}]: candidate "
+                 f"{candidate_psnr:.2f} dB{inc}; {reason}", candidate_vid)
     return result
 
 

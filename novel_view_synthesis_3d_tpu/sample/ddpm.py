@@ -556,6 +556,166 @@ def make_slot_step_fn(model, config: DiffusionConfig, *,
     return step
 
 
+def make_bank_step_fn(model, config: DiffusionConfig, k_max: int, *,
+                      param_transform=None):
+    """`make_slot_step_fn` with an optional per-row FRAME BANK — the
+    trajectory-serving stepper program (sample/service.py; docs/DESIGN.md
+    "Trajectory serving & stochastic conditioning").
+
+      step(params, z, keys, first, cond, coefs, w, R2, t2,
+           bank_x, bank_R, bank_t, bank_state) -> (z_next, keys_next)
+
+    On top of the slot-step contract: `bank_x` (B, k_max, H, W, C) holds
+    each row's clean conditioning frames (the request's source view plus
+    every frame it has generated so far, committed in-jit by
+    `make_bank_commit_fn`), `bank_R`/`bank_t` their poses, and
+    `bank_state` a (B, 2) int32 of [count, latest]. Rows with count > 0
+    are TRAJECTORY rows: their conditioning view is drawn from the bank
+    — uniformly over the first `count` entries with a third per-row PRNG
+    split when `diffusion.stochastic_cond` is True (the 3DiM protocol),
+    or the `latest` entry when False — and their target pose comes from
+    the per-step (B, 3, 3)/(B, 3) `R2`/`t2` device arguments (the host
+    uploads the CURRENT frame's pose each step, like the schedule
+    coefficients, so advancing to the next orbit pose never rebuilds the
+    ring). Rows with count == 0 are SINGLE-SHOT rows: they read their
+    conditioning from `cond` exactly like `make_slot_step_fn`, and —
+    crucially — consume the IDENTICAL per-row RNG stream (the pick split
+    is computed for every row but single-shot rows select the two-way
+    split results), so a single-shot request is BIT-identical whether it
+    rides this program next to trajectory rows or the bank-free program
+    of a service with serve.k_max=0 (tests/test_trajectory.py).
+
+    The bank gather happens BEFORE the UNet forward, so
+    `diffusion.fused_step` routes the post-forward update through the
+    same fused Pallas kernel unchanged. k_max is part of the program
+    SHAPE (one service = one k_max); everything per-request — step
+    count, guidance, pose, bank fill — is a device argument, so the
+    program identity stays bucket/shape-only and mixed single-shot +
+    trajectory traffic compiles nothing after warmup.
+    """
+    if k_max < 1:
+        raise ValueError(
+            f"make_bank_step_fn: k_max={k_max} must be >= 1 (a bank-less "
+            "stepper is make_slot_step_fn)")
+    stochastic = config.stochastic_cond
+    if stochastic not in (True, False):
+        raise ValueError(
+            f"diffusion.stochastic_cond={stochastic!r} must be True "
+            "(random bank view per step) or False (most recent frame)")
+    phi = config.cfg_rescale
+    if not 0.0 <= phi <= 1.0:
+        raise ValueError(f"cfg_rescale must be in [0, 1], got {phi}")
+    clip_denoised = config.clip_denoised
+    objective = config.objective
+    if objective not in ("eps", "x0", "v"):
+        raise ValueError(f"unknown objective {objective!r}")
+    sampler = config.sampler
+    eta = config.ddim_eta if sampler == "ddim" else 0.0
+    if sampler == "dpm++":
+        sampler = "ddim"  # first-order fallback, as in make_slot_step_fn
+    if sampler not in ("ddpm", "ddim"):
+        raise ValueError(f"unknown sampler {config.sampler!r}")
+    use_fused = fused_step_lib.resolve_fused_step(config.fused_step)
+    logsnr_col = STEP_COEF_KEYS.index("logsnr")
+
+    @jax.jit
+    def step(params, z, keys, first, cond, coefs, w, R2, t2,
+             bank_x, bank_R, bank_t, bank_state):
+        if param_transform is not None:
+            params = param_transform(params)
+        B = z.shape[0]
+        count, latest = bank_state[:, 0], bank_state[:, 1]
+        traj = count > 0
+        # Init-noise draw for rows entering the ring: identical split
+        # layout to make_slot_step_fn (and make_request_sampler).
+        both = jax.vmap(jax.random.split)(keys)
+        k_carry, k_init = both[:, 0], both[:, 1]
+        z0 = jax.vmap(lambda k: jax.random.normal(k, z.shape[1:]))(k_init)
+        fmask = first.reshape((B,) + (1,) * (z.ndim - 1))
+        z = jnp.where(fmask, z0.astype(z.dtype), z)
+        keys = jnp.where(first[:, None], k_carry, keys)
+        # Per-step draw. Trajectory rows need a THIRD stream for the
+        # stochastic-conditioning pick; single-shot rows must consume
+        # the exact two-way split of the bank-free program, so both
+        # splits are computed and selected per row — never assume
+        # split(k, 3)[:2] == split(k, 2).
+        two = jax.vmap(jax.random.split)(keys)
+        if stochastic:
+            three = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
+            keys_next = jnp.where(traj[:, None], three[:, 0], two[:, 0])
+            k_step = jnp.where(traj[:, None], three[:, 1], two[:, 1])
+            idx = jax.vmap(
+                lambda k, n: jax.random.randint(k, (), 0, n))(
+                    three[:, 2], jnp.maximum(count, 1))
+        else:
+            keys_next, k_step = two[:, 0], two[:, 1]
+            idx = latest
+        # Bank gather, then per-row select against the request cond.
+        take = lambda bank: jax.vmap(  # noqa: E731
+            lambda b, i: jax.lax.dynamic_index_in_dim(
+                b, i, 0, keepdims=False))(bank, idx)
+        x_eff = jnp.where(traj.reshape((B, 1, 1, 1)),
+                          take(bank_x), cond["x"])
+        R1_eff = jnp.where(traj.reshape((B, 1, 1)),
+                           take(bank_R), cond["R1"])
+        t1_eff = jnp.where(traj.reshape((B, 1)),
+                           take(bank_t), cond["t1"])
+        # Pin the effective conditioning: the forward must see
+        # materialized inputs, exactly like the bank-free program's cond
+        # PARAMETERS, so XLA cannot fuse the gather/select producers
+        # into the UNet and drift single-shot rows a ulp apart (the
+        # same rationale as the update barrier below).
+        x_eff, R1_eff, t1_eff, R2_in, t2_in = jax.lax.optimization_barrier(
+            (x_eff, R1_eff, t1_eff, R2, t2))
+        eff = {"x": x_eff, "R1": R1_eff, "t1": t1_eff,
+               "R2": R2_in, "t2": t2_in, "K": cond["K"]}
+        pose_embs = _doubled_pose_embs(model, params, eff)
+        batch = dict(eff, z=z, logsnr=coefs[:, logsnr_col])
+        ec, eu = _raw_eps(model, params, batch, pose_embs=pose_embs)
+        noise = _step_noise(k_step, z)
+        z_in, ec, eu, noise, coefs_in, w_in = jax.lax.optimization_barrier(
+            (z, ec, eu, noise, coefs, w))
+        fused = use_fused and fused_step_lib.fits_vmem(
+            int(np.prod(z.shape[1:])))
+        step_impl = (fused_step_lib.fused_denoise_step if fused
+                     else fused_step_lib.unfused_reference_step)
+        z_next = step_impl(
+            z_in, ec, eu, noise, coefs_in, w_in, sampler=sampler,
+            objective=objective, eta=eta, cfg_rescale=phi,
+            clip_denoised=clip_denoised)
+        return z_next, keys_next
+
+    return step
+
+
+def make_bank_commit_fn():
+    """In-jit frame-bank writeback for the trajectory stepper.
+
+      commit(bank_x, bank_R, bank_t, frame, pos, R2, t2)
+        -> (bank_x, bank_R, bank_t)
+
+    Writes `frame` — the device-resident row of the stepper latent that
+    just finished denoising — into position `pos` of ONE slot's bank
+    ((k_max, H, W, C) arrays, sample/stepper.FrameBank), with the pose
+    it was generated at: the finished frame joins its own conditioning
+    pool WITHOUT a host round-trip, so the next frame's stochastic
+    conditioning reads it straight from HBM. `pos` is a traced scalar —
+    one compiled program per (k_max, H, W) shape serves every slot,
+    every ring bucket, and every sliding-window position."""
+
+    @jax.jit
+    def commit(bank_x, bank_R, bank_t, frame, pos, R2, t2):
+        bank_x = jax.lax.dynamic_update_slice(
+            bank_x, frame[None].astype(bank_x.dtype), (pos, 0, 0, 0))
+        bank_R = jax.lax.dynamic_update_slice(
+            bank_R, R2[None].astype(bank_R.dtype), (pos, 0, 0))
+        bank_t = jax.lax.dynamic_update_slice(
+            bank_t, t2[None].astype(bank_t.dtype), (pos, 0))
+        return bank_x, bank_R, bank_t
+
+    return commit
+
+
 def make_stochastic_sampler(model, schedule: DiffusionSchedule,
                             config: DiffusionConfig, max_pool: int,
                             precompute_pose: Optional[bool] = None):

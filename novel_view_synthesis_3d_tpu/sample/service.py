@@ -29,6 +29,26 @@ Two schedulers share the front-end (serve.scheduler):
     invariance, tests/test_stepper.py). A pending hot swap DRAINS the
     ring first: in-flight requests finish on their start version, queued
     arrivals ride the new one.
+
+    TRAJECTORY SERVING rides the stepper (serve.k_max > 0; docs/DESIGN.md
+    "Trajectory serving & stochastic conditioning"): `submit_trajectory`
+    takes a source view plus an N-pose orbit, and the slot carries a
+    device-resident FRAME BANK — (k_max, H, W, C) clean frames + poses.
+    Each denoise step draws the row's conditioning view from its bank
+    with the slot's PRNG carry (stochastic conditioning as an in-jit
+    gather, 3DiM §3.2), a finished frame streams to the client AND is
+    committed back into its own bank in-jit, and the next frame re-enters
+    the ring without a host round-trip (fresh init noise via the `first`
+    flag; the next pose rides the per-step device arguments). Because
+    bank fill, pose, schedule, and guidance are all device arguments,
+    mixed single-shot + trajectory traffic runs ONE program per bucket —
+    and with serve.k_max=0 the stepper compiles the exact bank-free
+    program, so single-shot serving is bit-identical to a build without
+    trajectory support (zero-cost when unused). Hot swaps still drain
+    the ring: an in-flight orbit finishes ALL frames on its start
+    version (orbit consistency beats swap latency); the orbit deadline
+    is re-checked at each frame's admission, and a mid-orbit expiry
+    returns the completed frames in a structured TrajectoryExpired.
   - 'request': the PR 3 whole-request dispatcher (one lax.scan per
     coalesced same-program group), kept as the serve_bench baseline and
     for exact dpm++ 2M serving.
@@ -98,13 +118,18 @@ from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
 from novel_view_synthesis_3d_tpu.ops.fused_step import resolve_fused_step
 from novel_view_synthesis_3d_tpu.sample import precision as precision_lib
 from novel_view_synthesis_3d_tpu.sample.ddpm import (
+    make_bank_commit_fn,
+    make_bank_step_fn,
     make_request_sampler,
     make_slot_step_fn,
 )
-from novel_view_synthesis_3d_tpu.sample.stepper import ScheduleBank
+from novel_view_synthesis_3d_tpu.sample.stepper import FrameBank, ScheduleBank
 from novel_view_synthesis_3d_tpu.utils.profiling import ServiceStats
 
 COND_KEYS = ("x", "R1", "t1", "R2", "t2", "K")
+# Conditioning a trajectory request must supply (its frames' target
+# poses come from the pose list, not the cond dict).
+TRAJ_COND_KEYS = ("x", "R1", "t1", "K")
 
 
 class ServeError(RuntimeError):
@@ -117,6 +142,42 @@ class Rejected(ServeError):
 
 class DeadlineExceeded(ServeError):
     """Request expired in the queue before dispatch."""
+
+
+class TrajectoryExpired(DeadlineExceeded):
+    """A trajectory request's deadline passed mid-orbit.
+
+    Expiry is checked at each FRAME's admission (the frame boundary):
+    frames already denoised were delivered on the ticket's stream and
+    ride along here — the structured partial result — while
+    `frame_index` names the first frame that was NOT generated."""
+
+    def __init__(self, message: str, *, frames: List[np.ndarray],
+                 frame_index: int):
+        super().__init__(message)
+        self.frames = frames
+        self.frame_index = frame_index
+
+
+def _normalize_poses(poses) -> tuple:
+    """Trajectory pose list → ((N, 3, 3) R2, (N, 3) t2), loudly."""
+    if isinstance(poses, dict):
+        R = np.asarray(poses.get("R2"), np.float32)
+        t = np.asarray(poses.get("t2"), np.float32)
+    else:
+        arr = np.asarray(poses, np.float32)
+        if arr.ndim != 3 or arr.shape[-2:] != (4, 4):
+            raise Rejected(
+                "trajectory poses must be an (N, 4, 4) cam→world stack "
+                f"or {{'R2': (N, 3, 3), 't2': (N, 3)}}; got shape "
+                f"{arr.shape}")
+        R, t = arr[:, :3, :3], arr[:, :3, 3]
+    if (R.ndim != 3 or R.shape[-2:] != (3, 3)
+            or t.shape != (R.shape[0], 3)):
+        raise Rejected(
+            f"trajectory poses malformed: R2 {R.shape}, t2 {t.shape} "
+            "(want (N, 3, 3) and (N, 3))")
+    return np.ascontiguousarray(R), np.ascontiguousarray(t)
 
 
 def bucket_for(n: int, max_batch: int) -> int:
@@ -168,6 +229,104 @@ class Ticket:
         self._done.set()
 
 
+class TrajectoryTicket:
+    """Handle for one trajectory request: frames STREAM as they complete.
+
+    `frames()` yields (frame_index, image) in order, blocking until each
+    is denoised — the client renders the orbit while later frames are
+    still on device. `result()` blocks for the whole orbit and returns
+    the stacked (N, H, W, 3) array. A mid-orbit deadline expiry raises
+    `TrajectoryExpired` from both, carrying every completed frame."""
+
+    def __init__(self, request_id: int, num_frames: int):
+        self.request_id = request_id
+        self.num_frames = num_frames
+        self.timing: dict = {}
+        self.model_version: str = ""
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._frames: List[np.ndarray] = []
+        self._frame_timing: List[dict] = []
+        self._waiters: List[threading.Event] = []
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def frames_completed(self) -> int:
+        with self._lock:
+            return len(self._frames)
+
+    def frames(self, timeout: Optional[float] = None):
+        """Yield (frame_index, image) as each frame completes."""
+        i = 0
+        while i < self.num_frames:
+            img = self._wait_frame(i, timeout)
+            yield i, img
+            i += 1
+
+    def next_frame(self, index: int,
+                   timeout: Optional[float] = None) -> np.ndarray:
+        return self._wait_frame(index, timeout)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"trajectory {self.request_id} not finished within "
+                f"{timeout}s ({self.frames_completed()}/"
+                f"{self.num_frames} frames)")
+        if self._error is not None:
+            raise self._error
+        with self._lock:
+            return np.stack(self._frames)
+
+    # -- internals -----------------------------------------------------
+    def _wait_frame(self, index: int, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if index < len(self._frames):
+                    return self._frames[index]
+                if self._error is not None:
+                    raise self._error
+                if self._done.is_set():
+                    raise ServeError(
+                        f"trajectory {self.request_id} finished without "
+                        f"frame {index}")
+                ev = threading.Event()
+                self._waiters.append(ev)
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            if not ev.wait(left):
+                raise TimeoutError(
+                    f"frame {index} of trajectory {self.request_id} not "
+                    f"served within {timeout}s")
+
+    def _notify(self) -> None:
+        for ev in self._waiters:
+            ev.set()
+        self._waiters.clear()
+
+    # -- resolution (worker thread) ------------------------------------
+    def _deliver(self, image: np.ndarray, timing: dict) -> None:
+        with self._lock:
+            self._frames.append(image)
+            self._frame_timing.append(timing)
+            self._notify()
+
+    def _complete(self, timing: dict) -> None:
+        self.timing.update(timing)
+        with self._lock:
+            self._notify()
+        self._done.set()
+
+    def _fail(self, error: BaseException) -> None:
+        with self._lock:
+            self._error = error
+            self._notify()
+        self._done.set()
+
+
 class _Request:
     __slots__ = ("ticket", "cond", "key", "program_key", "t_submit",
                  "deadline_s")
@@ -186,6 +345,33 @@ class _Request:
     def shape(self) -> tuple:
         return tuple(self.cond["x"].shape[:2])
 
+    @property
+    def is_traj(self) -> bool:
+        return False
+
+
+class _TrajRequest(_Request):
+    """A trajectory request: N target poses, one frame bank, one slot."""
+
+    __slots__ = ("poses_R", "poses_t", "k_cap")
+
+    def __init__(self, ticket: TrajectoryTicket, cond, key, program_key,
+                 t_submit, deadline_s, poses_R: np.ndarray,
+                 poses_t: np.ndarray, k_cap: int):
+        super().__init__(ticket, cond, key, program_key, t_submit,
+                         deadline_s)
+        self.poses_R = poses_R  # (N, 3, 3)
+        self.poses_t = poses_t  # (N, 3)
+        self.k_cap = k_cap
+
+    @property
+    def is_traj(self) -> bool:
+        return True
+
+    @property
+    def num_frames(self) -> int:
+        return int(self.poses_R.shape[0])
+
 
 class _Slot:
     """One active request's ring state (step scheduler).
@@ -199,9 +385,10 @@ class _Slot:
 
     __slots__ = ("req", "bank", "w", "z", "keys", "first", "t", "version",
                  "t_admit", "device_s", "compile_s", "steps_done",
-                 "bucket0", "batch0")
+                 "bucket0", "batch0", "fbank", "frame_index", "frame_t0")
 
-    def __init__(self, req: _Request, bank, version: str, t_admit: float):
+    def __init__(self, req: _Request, bank, version: str, t_admit: float,
+                 fbank: Optional[FrameBank] = None):
         self.req = req
         self.bank = bank
         self.w = float(req.program_key[3])
@@ -216,10 +403,26 @@ class _Slot:
         self.steps_done = 0
         self.bucket0 = 0
         self.batch0 = 0
+        # Trajectory state: the device-resident frame bank (None for
+        # single-shot rows) and the index of the frame being denoised.
+        self.fbank = fbank
+        self.frame_index = 0
+        self.frame_t0 = t_admit
 
     @property
     def shape(self) -> tuple:
         return self.req.shape
+
+    @property
+    def is_traj(self) -> bool:
+        return self.fbank is not None
+
+    def target_pose(self) -> tuple:
+        """(R2, t2) of the frame this slot is currently denoising."""
+        if self.is_traj:
+            return (self.req.poses_R[self.frame_index],
+                    self.req.poses_t[self.frame_index])
+        return self.req.cond["R2"], self.req.cond["t2"]
 
 
 class SamplerProgramCache:
@@ -336,6 +539,20 @@ class SamplingService:
         self._model_version_gauge = obs.get_registry().gauge(
             "nvs3d_model_version",
             "live model version (label) and its training step (value)")
+        # Trajectory serving gauges (docs/DESIGN.md "Trajectory serving
+        # & stochastic conditioning").
+        self._frames_total = obs.get_registry().counter(
+            "nvs3d_frames_total",
+            "trajectory frames denoised and streamed to clients")
+        self._frames_per_sec = obs.get_registry().gauge(
+            "nvs3d_frames_per_sec",
+            "trajectory frame delivery rate since the first frame")
+        self._traj_active = obs.get_registry().gauge(
+            "nvs3d_trajectories_active",
+            "trajectory requests currently holding a ring slot")
+        self._frames_count = 0
+        self._frames_t0: Optional[float] = None
+        self._traj_in_ring = 0
         self._results_folder = results_folder or self.serve.results_folder
         self._events_lock = threading.Lock()
         # Live (params, model_version) pair — ONE attribute so readers
@@ -357,6 +574,19 @@ class SamplingService:
         while b <= self.serve.max_batch:
             self._buckets.append(b)
             b *= 2
+        # Trajectory serving (serve.k_max > 0): the stepper runs the
+        # bank-enabled program so ring slots may carry a device-resident
+        # frame bank. 0 keeps the EXACT bank-free program — trajectory
+        # support is zero-cost (and bit-identical) when unused.
+        self._k_max = int(self.serve.k_max)
+        if self._k_max < 0:
+            raise ValueError(f"serve.k_max={self.serve.k_max} must be >= 0")
+        if self._k_max > 0 and self.serve.scheduler != "step":
+            raise ValueError(
+                f"serve.k_max={self._k_max} requires serve.scheduler="
+                "'step' — trajectory frames re-enter the stepper ring "
+                "between denoise steps (config.validate names the same "
+                "constraint)")
         if self.serve.scheduler == "step":
             # Stepper programs depend on bucket/shape ONLY (t, steps and
             # guidance ride as device args); the host-side coefficient
@@ -367,6 +597,12 @@ class SamplingService:
             # Per-bucket all-False `first` vectors, staged once: the
             # carry fast path reuses them instead of re-uploading.
             self._false_cache: Dict[int, object] = {}
+            # Zero frame banks for single-shot rows riding a bank-
+            # enabled ring, staged once per (H, W) shape.
+            self._zero_bank_cache: Dict[tuple, tuple] = {}
+            # The in-jit frame commit program (one jitted callable;
+            # XLA caches one executable per (k_max, H, W) shape).
+            self._commit_fn = make_bank_commit_fn() if self._k_max else None
         else:
             self._programs = SamplerProgramCache(
                 self._build_program, self.serve.program_cache_entries)
@@ -576,6 +812,89 @@ class SamplingService:
             self._queue_cv.notify_all()
         return ticket
 
+    def submit_trajectory(self, cond: Dict[str, np.ndarray], *,
+                          poses, seed: int = 0,
+                          sample_steps: Optional[int] = None,
+                          guidance_weight: Optional[float] = None,
+                          deadline_ms: Optional[float] = None,
+                          k_max: Optional[int] = None) -> TrajectoryTicket:
+        """Enqueue one N-frame trajectory; returns a streaming ticket.
+
+        `cond` holds the UNBATCHED source view: x (H, W, 3), R1 (3, 3),
+        t1 (3,), K (3, 3). `poses` is the orbit — an (N, 4, 4) cam→world
+        pose stack or a dict {"R2": (N, 3, 3), "t2": (N, 3)}. Each frame
+        runs `sample_steps` denoise steps; every step conditions on a
+        bank view per diffusion.stochastic_cond, and each finished frame
+        is committed into the bank in-jit before the next re-enters the
+        ring — the whole orbit stays device-resident. `k_max` bounds
+        this request's sliding conditioning window (default, and upper
+        bound: serve.k_max). `deadline_ms` covers the WHOLE orbit and is
+        re-checked at each frame's admission; a mid-orbit expiry
+        delivers the completed frames inside a TrajectoryExpired."""
+        if self.serve.scheduler != "step" or self._k_max < 1:
+            raise Rejected(
+                "trajectory serving is disabled: it needs serve."
+                "scheduler='step' and serve.k_max > 0 (got scheduler="
+                f"{self.serve.scheduler!r}, k_max={self.serve.k_max}) — "
+                "the frame bank is sized at service construction")
+        missing = [k for k in TRAJ_COND_KEYS if k not in cond]
+        if missing:
+            raise Rejected(
+                f"trajectory request missing conditioning keys {missing}")
+        x = np.asarray(cond["x"])
+        if x.ndim != 3:
+            raise Rejected(
+                f"cond['x'] must be unbatched (H, W, 3); got {x.shape}")
+        poses_R, poses_t = _normalize_poses(poses)
+        n_frames = poses_R.shape[0]
+        if not 1 <= n_frames <= self.serve.max_frames:
+            raise Rejected(
+                f"trajectory has {n_frames} poses; serve.max_frames="
+                f"{self.serve.max_frames} bounds a request (split the "
+                "orbit, or raise serve.max_frames)")
+        cap = self._k_max if k_max is None else int(k_max)
+        if not 1 <= cap <= self._k_max:
+            raise Rejected(
+                f"k_max={k_max} outside [1, serve.k_max={self._k_max}] — "
+                "the service's bank arrays are sized once; per-request "
+                "windows can only shrink")
+        steps = sample_steps or self.serve.sample_steps or \
+            self.diffusion.sample_timesteps
+        if not 1 <= int(steps) <= self.diffusion.timesteps:
+            raise Rejected(
+                f"sample_steps={steps} outside [1, diffusion.timesteps="
+                f"{self.diffusion.timesteps}]")
+        w = (self.diffusion.guidance_weight
+             if guidance_weight is None else float(guidance_weight))
+        if deadline_ms is None:
+            deadline_ms = self.serve.default_deadline_ms
+        program_key = (int(x.shape[0]), int(x.shape[1]), int(steps), w)
+        ticket = TrajectoryTicket(self._claim_id(), n_frames)
+        full_cond = {k: np.asarray(cond[k]) for k in TRAJ_COND_KEYS}
+        # R2/t2 ride as zeros so trajectory rows stack uniformly with
+        # single-shot rows; the step program takes the CURRENT frame's
+        # pose from the per-step device arguments instead.
+        full_cond["R2"] = np.zeros((3, 3), np.float32)
+        full_cond["t2"] = np.zeros((3,), np.float32)
+        req = _TrajRequest(
+            ticket, full_cond, np.asarray(jax.random.PRNGKey(seed)),
+            program_key, time.monotonic(),
+            float(deadline_ms) / 1000.0 if deadline_ms else 0.0,
+            poses_R, poses_t, cap)
+        with self._queue_cv:
+            if self._stop.is_set():
+                raise Rejected("service stopped")
+            if len(self._queue) >= self.serve.queue_depth:
+                self._log_event(
+                    ticket.request_id, "reject",
+                    f"queue full (depth {self.serve.queue_depth})")
+                raise Rejected(
+                    f"queue full (serve.queue_depth="
+                    f"{self.serve.queue_depth}); retry with backoff")
+            self._queue.append(req)
+            self._queue_cv.notify_all()
+        return ticket
+
     def _claim_id(self) -> int:
         with self._lock:
             self._next_id += 1
@@ -583,7 +902,16 @@ class SamplingService:
 
     # -- observability -------------------------------------------------
     def compile_counters(self) -> dict:
-        return self._programs.counters()
+        counters = self._programs.counters()
+        commit_fn = getattr(self, "_commit_fn", None)
+        if commit_fn is not None:
+            # The in-jit bank-commit program compiles once per
+            # (k_max, H, W) shape; its executables count here so the
+            # zero-recompile asserts cover the trajectory path too.
+            size = getattr(commit_fn, "_cache_size", None)
+            counters["commit_jit_entries"] = (
+                int(size()) if callable(size) else 0)
+        return counters
 
     def summary(self) -> dict:
         try:
@@ -668,11 +996,15 @@ class SamplingService:
                     for slot in ring:
                         slot.req.ticket._fail(
                             ServeError(f"ring step failed: {exc!r}"))
+                        if slot.is_traj:
+                            self._traj_exit()
                     ring.clear()
                     carry = None
         finally:
             for slot in ring:
                 slot.req.ticket._fail(Rejected("service stopped"))
+                if slot.is_traj:
+                    self._traj_exit()
 
     def _admit(self, ring: List[_Slot]) -> bool:
         """Move queued requests into free ring slots; True if the ring
@@ -731,16 +1063,39 @@ class SamplingService:
                 r.ticket.request_id, "deadline",
                 f"queued {waited * 1e3:.1f}ms > deadline "
                 f"{r.deadline_s * 1e3:.0f}ms")
-            r.ticket._fail(DeadlineExceeded(
-                f"request waited {waited * 1e3:.1f}ms, deadline was "
-                f"{r.deadline_s * 1e3:.0f}ms"))
+            msg = (f"request waited {waited * 1e3:.1f}ms, deadline was "
+                   f"{r.deadline_s * 1e3:.0f}ms")
+            r.ticket._fail(
+                TrajectoryExpired(msg, frames=[], frame_index=0)
+                if r.is_traj else DeadlineExceeded(msg))
         if not admitted:
             return False
         now = time.monotonic()
         version = self._live[1]
         for r in admitted:
             steps = int(r.program_key[2])
-            slot = _Slot(r, self._banks.get(steps), version, now)
+            try:
+                bank = self._banks.get(steps)
+                fbank = None
+                if r.is_traj:
+                    # One conditioning upload per ORBIT (here), not per
+                    # frame: the bank seeds with the source view and
+                    # grows on device as frames commit in-jit.
+                    fbank = FrameBank(self._k_max, r.k_cap, r.cond["x"],
+                                      r.cond["R1"], r.cond["t1"])
+            except Exception as exc:
+                # A request the schedule/bank math cannot serve (e.g. a
+                # step count respace() rejects) fails ITS ticket — an
+                # admission error must never kill the worker thread and
+                # wedge every later request behind it.
+                r.ticket._fail(Rejected(
+                    f"admission failed for request "
+                    f"{r.ticket.request_id}: {exc!r}"))
+                continue
+            if r.is_traj:
+                self._traj_in_ring += 1
+                self._traj_active.set(float(self._traj_in_ring))
+            slot = _Slot(r, bank, version, now, fbank=fbank)
             ring.append(slot)
             # step_wait: submit → ring admission (the stepper's analogue
             # of queue_wait; bounded by steps in flight, not by whole
@@ -787,27 +1142,82 @@ class SamplingService:
         NO steps, t, or guidance weight — those are device arguments,
         which is what makes a mixed 4/256-step warm sweep compile
         nothing (the PR 3 key folded `steps` in, which under step-level
-        scheduling would have recompiled per step count)."""
+        scheduling would have recompiled per step count). k_max and
+        stochastic_cond ride along but are SERVICE constants (they size
+        the bank arrays / pick the gather), so mixed single-shot and
+        trajectory traffic still shares one program per bucket."""
         d = self.diffusion
         return (bucket, H, W, d.sampler, d.cfg_rescale, d.ddim_eta,
                 d.objective, d.clip_denoised, d.schedule, d.timesteps,
-                self.precision, d.fused_step)
+                self.precision, d.fused_step, self._k_max,
+                d.stochastic_cond)
 
     def _build_step_program(self):
+        if self._k_max > 0:
+            return make_bank_step_fn(
+                self.model, self.diffusion, self._k_max,
+                param_transform=self._param_transform)
         return make_slot_step_fn(self.model, self.diffusion,
                                  param_transform=self._param_transform)
+
+    def _zero_bank(self, H: int, W: int) -> tuple:
+        """Staged-once zero bank arrays for single-shot rows riding a
+        bank-enabled ring (their count=0 row never reads them)."""
+        import jax.numpy as jnp
+
+        zb = self._zero_bank_cache.get((H, W))
+        if zb is None:
+            zb = (jnp.zeros((self._k_max, H, W, 3), jnp.float32),
+                  jnp.zeros((self._k_max, 3, 3), jnp.float32),
+                  jnp.zeros((self._k_max, 3), jnp.float32))
+            self._zero_bank_cache[(H, W)] = zb
+        return zb
+
+    def _bank_sig(self, ring: List[_Slot]) -> tuple:
+        """Identity of the ring's stacked bank content: any commit bumps
+        a slot's total, forcing a device-side restack next dispatch."""
+        return tuple((id(s), s.fbank.total) if s.is_traj else None
+                     for s in ring)
+
+    def _stack_banks(self, ring: List[_Slot], bucket: int,
+                     H: int, W: int) -> tuple:
+        """Stack per-slot bank arrays into the (bucket, k_max, …) step
+        arguments — a DEVICE-side stack (the per-slot banks are already
+        device-resident), placed like every other ring tensor."""
+        import jax.numpy as jnp
+
+        zx, zR, zt = self._zero_bank(H, W)
+        pad = bucket - len(ring)
+        xs = [s.fbank.x if s.is_traj else zx for s in ring] + [zx] * pad
+        Rs = [s.fbank.R if s.is_traj else zR for s in ring] + [zR] * pad
+        ts = [s.fbank.t if s.is_traj else zt for s in ring] + [zt] * pad
+        return (self._place(jnp.stack(xs), bucket),
+                self._place(jnp.stack(Rs), bucket),
+                self._place(jnp.stack(ts), bucket))
+
+    def _traj_exit(self) -> None:
+        self._traj_in_ring = max(0, self._traj_in_ring - 1)
+        self._traj_active.set(float(self._traj_in_ring))
 
     def _ring_step(self, ring: List[_Slot],
                    carry: Optional[dict]) -> Optional[dict]:
         """One denoise step over the whole ring. Returns the device-
         resident carry for the next iteration, or None when rows exited
-        (the composition changed, so the next dispatch rebuilds)."""
+        (the composition changed, so the next dispatch rebuilds).
+
+        Trajectory frame boundaries are NOT composition changes: a slot
+        whose frame finished streams it to the client, commits it into
+        its device bank in-jit, and re-arms for the next pose while the
+        carry (z, keys, cond, banks) stays on device — only an expiry or
+        the orbit's LAST frame makes the slot exit the ring."""
         n = len(ring)
         bucket = bucket_for(n, self.serve.max_batch)
         H, W = ring[0].shape
         params, _ = self._live
         pad = bucket - n
         sig = (tuple(id(s) for s in ring), bucket)
+        bank_mode = self._k_max > 0
+        bank_dev = bank_sig = None
         with self.tracer.span("batch_form", bucket=bucket, batch_n=n):
             if carry is not None and carry["sig"] != sig:
                 self._materialize(carry)
@@ -836,7 +1246,8 @@ class SamplingService:
             # rows repeat the last real row's coefficients so their
             # (discarded) math stays finite. `first`/`w` only change
             # when the ring composition does, so the carry fast path
-            # re-uploads nothing but the coefficient matrix.
+            # re-uploads nothing but the coefficient matrix (plus, in
+            # bank mode, the tiny per-step pose/fill vectors).
             last = ring[-1]
             coefs = np.stack(
                 [s.bank.table[s.t] for s in ring]
@@ -849,12 +1260,53 @@ class SamplingService:
                 first_dev = self._place(first, bucket)
                 w_dev = self._place(w, bucket)
             else:
-                first_dev, w_dev = carry["first"], carry["w"]
+                w_dev = carry["w"]
+                if any(s.first for s in ring):
+                    # Trajectory re-arms flipped `first` back on mid-
+                    # carry: one (bucket,) bool upload re-draws ONLY
+                    # those rows' init noise.
+                    first_dev = self._place(
+                        np.asarray([s.first for s in ring]
+                                   + [False] * pad), bucket)
+                else:
+                    first_dev = carry["first"]
+            if bank_mode:
+                # The current frame's target pose and the bank fill ride
+                # as DEVICE ARGUMENTS (like the coefficients), so
+                # advancing a trajectory to its next orbit pose never
+                # rebuilds the ring or touches the program identity —
+                # but they only CHANGE at frame boundaries, so the carry
+                # fast path reuses the staged vectors between them.
+                bank_sig = self._bank_sig(ring)
+                if carry is not None and carry.get("bank_sig") == bank_sig:
+                    R2_dev, t2_dev, state_dev = carry["pose"]
+                    bank_dev = carry["bank"]
+                else:
+                    tp = [s.target_pose() for s in ring]
+                    R2s = np.stack([p[0] for p in tp] + [tp[-1][0]] * pad
+                                   ).astype(np.float32)
+                    t2s = np.stack([p[1] for p in tp] + [tp[-1][1]] * pad
+                                   ).astype(np.float32)
+                    state = np.asarray(
+                        [[s.fbank.count, s.fbank.latest] if s.is_traj
+                         else [0, 0] for s in ring] + [[0, 0]] * pad,
+                        np.int32)
+                    R2_dev = self._place(R2s, bucket)
+                    t2_dev = self._place(t2s, bucket)
+                    state_dev = self._place(state, bucket)
+                    bank_dev = self._stack_banks(ring, bucket, H, W)
             entry = self._programs.get(self._step_cache_key(bucket, H, W))
         cold = not entry["warm"]
         t0 = time.perf_counter()
-        z_next, keys_next = entry["fn"](params, z_dev, keys_dev, first_dev,
-                                        cond_dev, coefs_dev, w_dev)
+        if bank_mode:
+            z_next, keys_next = entry["fn"](
+                params, z_dev, keys_dev, first_dev, cond_dev, coefs_dev,
+                w_dev, R2_dev, t2_dev, bank_dev[0], bank_dev[1],
+                bank_dev[2], state_dev)
+        else:
+            z_next, keys_next = entry["fn"](
+                params, z_dev, keys_dev, first_dev, cond_dev, coefs_dev,
+                w_dev)
         jax.block_until_ready(z_next)
         elapsed = time.perf_counter() - t0
         entry["warm"] = True
@@ -862,6 +1314,7 @@ class SamplingService:
                              bucket=bucket, batch_n=n)
         self.stats.record_span("ring_step", elapsed)
         finished: List[_Slot] = []
+        rearm: List[_Slot] = []
         for s in ring:
             if s.first:
                 s.bucket0, s.batch0 = bucket, n
@@ -875,27 +1328,158 @@ class SamplingService:
             s.steps_done += 1
             s.t -= 1
             if s.t < 0:
-                finished.append(s)
-        if not finished:
+                if s.is_traj and s.frame_index + 1 < s.req.num_frames:
+                    rearm.append(s)
+                else:
+                    finished.append(s)
+        if not finished and not rearm:
             # Every continuing row has now taken its first step, so the
             # carried `first` is the cached all-False vector (reusing
             # this dispatch's `first_dev` would re-draw init noise).
             return {"z": z_next, "keys": keys_next, "cond": cond_dev,
                     "first": self._false_rows(bucket), "w": w_dev,
-                    "sig": sig, "slots": list(ring)}
-        z_host = np.asarray(jax.device_get(z_next))
-        k_host = np.asarray(jax.device_get(keys_next))
-        with self.tracer.span("respond", batch_n=len(finished)):
+                    "sig": sig, "slots": list(ring),
+                    "bank": bank_dev, "bank_sig": bank_sig,
+                    "pose": ((R2_dev, t2_dev, state_dev) if bank_mode
+                             else None)}
+        fin_ids = {id(s) for s in finished}
+        rearm_ids = {id(s) for s in rearm}
+        z_host = k_host = None
+        if finished:
+            z_host = np.asarray(jax.device_get(z_next))
+            k_host = np.asarray(jax.device_get(keys_next))
+        expired: List[_Slot] = []
+        with self.tracer.span("respond",
+                              batch_n=len(finished) + len(rearm)):
+            for i, s in enumerate(ring):
+                if id(s) in rearm_ids:
+                    # Frame boundary: deliver + in-jit bank commit +
+                    # re-arm (or expire at this frame's admission).
+                    frame_dev = z_next[i]
+                    frame = (z_host[i] if z_host is not None
+                             else np.asarray(jax.device_get(frame_dev)))
+                    if not self._frame_boundary(s, frame, frame_dev):
+                        expired.append(s)
+                elif id(s) in fin_ids:
+                    if s.is_traj:
+                        self._finish_trajectory(s, z_host[i])
+                    else:
+                        self._resolve_slot(s, z_host[i])
+            if not finished and not expired:
+                # Pure frame boundary: the ring composition is
+                # unchanged, the carry stays device-resident. The stale
+                # bank_sig forces a device-side restack next dispatch
+                # (the re-armed slots' banks just grew).
+                return {"z": z_next, "keys": keys_next, "cond": cond_dev,
+                        "first": self._false_rows(bucket), "w": w_dev,
+                        "sig": sig, "slots": list(ring),
+                        "bank": bank_dev, "bank_sig": bank_sig,
+                        "pose": (R2_dev, t2_dev, state_dev)}
+            # Rows exited: rebuild next dispatch from host state.
+            if z_host is None:
+                z_host = np.asarray(jax.device_get(z_next))
+                k_host = np.asarray(jax.device_get(keys_next))
+            exit_ids = fin_ids | {id(s) for s in expired}
             keep: List[_Slot] = []
             for i, s in enumerate(ring):
-                if s.t < 0:
-                    self._resolve_slot(s, z_host[i])
-                else:
-                    s.z = z_host[i]
-                    s.keys = k_host[i]
-                    keep.append(s)
+                if id(s) in exit_ids:
+                    continue
+                s.z = z_host[i]
+                s.keys = k_host[i]
+                keep.append(s)
             ring[:] = keep
         return None
+
+    def _frame_boundary(self, slot: _Slot, frame: np.ndarray,
+                        frame_dev) -> bool:
+        """One finished (non-final) trajectory frame: stream it, commit
+        it into the slot's device bank in-jit, check the request
+        deadline AT THIS FRAME'S ADMISSION, and re-arm the slot for the
+        next pose. Returns False when the deadline expired (the slot
+        must leave the ring; completed frames ride the error)."""
+        req = slot.req
+        now = time.monotonic()
+        self._stream_frame(slot, frame, now)
+        R2, t2 = slot.target_pose()
+        slot.fbank.commit(self._commit_fn, frame_dev, R2, t2)
+        slot.frame_index += 1
+        waited = now - req.t_submit
+        if req.deadline_s and waited > req.deadline_s:
+            self._log_event(
+                req.ticket.request_id, "deadline",
+                f"trajectory expired at frame {slot.frame_index}/"
+                f"{req.num_frames} admission: {waited * 1e3:.1f}ms > "
+                f"deadline {req.deadline_s * 1e3:.0f}ms")
+            with req.ticket._lock:
+                done_frames = list(req.ticket._frames)
+            req.ticket._fail(TrajectoryExpired(
+                f"trajectory deadline ({req.deadline_s * 1e3:.0f}ms) "
+                f"passed after {slot.frame_index} of {req.num_frames} "
+                f"frames ({waited * 1e3:.1f}ms elapsed); completed "
+                "frames attached",
+                frames=done_frames, frame_index=slot.frame_index))
+            self._traj_exit()
+            return False
+        slot.t = slot.bank.n - 1
+        slot.first = True  # next frame draws fresh init noise in-jit
+        slot.frame_t0 = now
+        return True
+
+    def _stream_frame(self, slot: _Slot, frame: np.ndarray,
+                      now: float) -> None:
+        """Deliver one completed frame on the trajectory ticket and
+        account it (span + gauges + per-frame telemetry row)."""
+        req = slot.req
+        dur = max(0.0, now - slot.frame_t0)
+        timing = {"frame_index": slot.frame_index, "frame_s": dur,
+                  "steps": slot.bank.n, "model_version": slot.version}
+        req.ticket.model_version = slot.version
+        req.ticket._deliver(frame, timing)
+        # Per-frame telemetry: a `trajectory_frame` span row (child of
+        # the ring_step stream) lands in telemetry.jsonl with the
+        # request id + frame index via the bus-wired tracer.
+        self.tracer.add_span("trajectory_frame", dur,
+                             request_id=req.ticket.request_id,
+                             frame_index=slot.frame_index,
+                             steps=slot.bank.n,
+                             model_version=slot.version)
+        self.stats.record_span("trajectory_frame", dur)
+        self._frames_count += 1
+        self._frames_total.inc()
+        if self._frames_t0 is None:
+            self._frames_t0 = time.perf_counter()
+        elapsed = time.perf_counter() - self._frames_t0
+        if elapsed > 0:
+            self._frames_per_sec.set(self._frames_count / elapsed)
+
+    def _finish_trajectory(self, slot: _Slot, frame: np.ndarray) -> None:
+        """The orbit's LAST frame: deliver it and complete the ticket."""
+        req = slot.req
+        now = time.monotonic()
+        self._stream_frame(slot, frame, now)
+        qw = max(0.0, slot.t_admit - req.t_submit)
+        timing = {
+            "queue_wait_s": qw,
+            "device_s": slot.device_s,
+            "bucket": slot.bucket0,
+            "batch_n": slot.batch0,
+            "steps": slot.steps_done,
+            "frames": req.num_frames,
+            "model_version": slot.version,
+        }
+        if slot.compile_s:
+            timing["compile_s"] = slot.compile_s
+        req.ticket.model_version = slot.version
+        self.stats.record_span("queue_wait", qw)
+        self.stats.record_span("device", slot.device_s)
+        if slot.compile_s:
+            self.stats.record_span("compile", slot.compile_s)
+        self.tracer.add_span("queue_wait", qw,
+                             request_id=req.ticket.request_id)
+        req.ticket._complete(timing)
+        self.stats.count_requests(1)
+        self._requests_total.inc(1)
+        self._traj_exit()
 
     def _resolve_slot(self, slot: _Slot, image: np.ndarray) -> None:
         req = slot.req

@@ -84,6 +84,77 @@ class StepBank:
             k: self.table[:, i] for i, k in enumerate(STEP_COEF_KEYS)}
 
 
+class FrameBank:
+    """One trajectory request's DEVICE-RESIDENT frame bank.
+
+    `x`/`R`/`t` are jax device arrays of shape (k_max, H, W, C) /
+    (k_max, 3, 3) / (k_max, 3) holding the request's clean conditioning
+    views: the source view at seed time, then every generated frame,
+    committed in-jit by `sample/ddpm.make_bank_commit_fn` straight from
+    the stepper's batched latent — a finished frame joins its own
+    conditioning pool without touching the host. The serving stepper
+    stacks the ring's banks (a device-side jnp.stack) into the
+    (B, k_max, …) tensors `make_bank_step_fn` gathers from; because the
+    per-slot arrays are the authoritative copy, a ring rebuild restacks
+    bit-identically to what the previous carry held — trajectory rows
+    stay ring-composition invariant.
+
+    Overflow policy: SLIDING WINDOW over the most recent `cap` views
+    (ring-buffer writes at total % cap, count saturates at cap). Chosen
+    over reservoir sampling because it is deterministic — same request,
+    same bank content, bit-identical orbit — and recency is what keeps
+    long orbits locally consistent; the tradeoff (the original real
+    view eventually leaves the window on orbits longer than cap) is
+    deliberate and tested (tests/test_trajectory.py). `cap` may be
+    smaller than the service-wide array size `k_max`: the program shape
+    never changes per request, only the effective window."""
+
+    __slots__ = ("k_max", "cap", "x", "R", "t", "count", "total")
+
+    def __init__(self, k_max: int, cap: int, x0: np.ndarray,
+                 R0: np.ndarray, t0: np.ndarray):
+        if not 1 <= cap <= k_max:
+            raise ValueError(
+                f"FrameBank cap={cap} must be in [1, k_max={k_max}]")
+        import jax as _jax
+
+        self.k_max = int(k_max)
+        self.cap = int(cap)
+        H, W, C = np.asarray(x0).shape
+        x = np.zeros((k_max, H, W, C), np.float32)
+        R = np.zeros((k_max, 3, 3), np.float32)
+        t = np.zeros((k_max, 3), np.float32)
+        x[0], R[0], t[0] = x0, R0, t0
+        # One upload per trajectory — the request's whole conditioning
+        # lifetime happens on device after this. device_put COMMITS the
+        # arrays, matching the placement of the jitted commit outputs
+        # that replace them, so the commit program compiles exactly once
+        # per (k_max, H, W) shape.
+        self.x, self.R, self.t = _jax.device_put(
+            (x, R, t), _jax.devices()[0])
+        self.count = 1  # valid entries (saturates at cap)
+        self.total = 1  # views ever written (window position source)
+
+    def commit(self, commit_fn, frame_dev, R2: np.ndarray,
+               t2: np.ndarray) -> int:
+        """Write one finished frame (a device array row of the stepper's
+        latent) at the sliding-window position via the jitted commit
+        program; returns the position written."""
+        pos = self.total % self.cap
+        self.x, self.R, self.t = commit_fn(
+            self.x, self.R, self.t, frame_dev,
+            np.int32(pos), np.asarray(R2, np.float32),
+            np.asarray(t2, np.float32))
+        self.total += 1
+        self.count = min(self.total, self.cap)
+        return pos
+
+    @property
+    def latest(self) -> int:
+        """Position of the most recent entry (stochastic_cond=False)."""
+        return (self.total - 1) % self.cap
+
+
 class ScheduleBank:
     """Thread-safe cache of StepBanks keyed by requested step count.
 

@@ -57,6 +57,13 @@ def save_animation(imgs: np.ndarray, path: str, fps: float = 8.0) -> None:
                    duration=max(1, int(round(1000.0 / fps))), loop=0)
 
 
+def save_image_strip(imgs: np.ndarray, path: str) -> None:
+    """(N, H, W, 3) in [-1, 1] → one horizontal strip PNG — the orbit
+    contact sheet the trajectory-serving demo writes (frame order reads
+    left to right)."""
+    save_image_grid(imgs, path, cols=np.asarray(imgs).shape[0])
+
+
 def save_image_grid(imgs: np.ndarray, path: str, cols: int = 4) -> None:
     """(N, H, W, 3) in [-1, 1] → one tiled PNG."""
     imgs = np.asarray(imgs)

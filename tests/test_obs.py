@@ -329,6 +329,47 @@ def test_registry_event_writers_route_through_bus():
     assert {"gate_pass", "gate_fail", "model_publish"} <= found_kinds
 
 
+def test_trajectory_frame_writer_routes_through_bus():
+    """The trajectory-serving per-frame telemetry (PR 9) is a NEW writer
+    surface: every module that emits the `trajectory_frame` span or the
+    frame gauges must route through the tracer/bus — no private csv
+    writer, no direct telemetry-file path (the walk above already bans
+    the literals; this pins the span's existence and its bus-routed
+    emission point)."""
+    import novel_view_synthesis_3d_tpu.sample as sample_pkg
+
+    sample_dir = os.path.dirname(os.path.abspath(sample_pkg.__file__))
+    emitters = []
+    for fn in sorted(os.listdir(sample_dir)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(sample_dir, fn)) as fh:
+            src = fh.read()
+        tree = ast.parse(src, filename=fn)
+        names_frame = False
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value in ("trajectory_frame",
+                                       "nvs3d_frames_total",
+                                       "nvs3d_frames_per_sec",
+                                       "nvs3d_trajectories_active")):
+                names_frame = True
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod = getattr(node, "module", None) or ""
+                imported = [a.name for a in node.names]
+                assert "csv" not in imported and mod != "csv", (
+                    f"sample/{fn} imports csv — telemetry writes belong "
+                    "to obs.bus only")
+        if names_frame:
+            emitters.append(fn)
+            assert "tracer" in src and "obs." in src, (
+                f"sample/{fn} names per-frame telemetry but has no "
+                "bus-routed tracer path")
+    # The per-frame writer the DESIGN doc promises actually exists.
+    assert "service.py" in emitters
+
+
 # ---------------------------------------------------------------------------
 # Device monitor / MFU
 # ---------------------------------------------------------------------------

@@ -220,28 +220,43 @@ def continuous_lines(rows):
 def cpu_lane_lines(repo_root: str):
     """The restored CPU-lane trajectory: every BENCH_r*.json archive at
     the repo root, with its lane/platform/value — four rc=3 rounds with
-    'parsed: null' (BENCH_r03-r05) is the blindness this replaces."""
+    'parsed: null' (BENCH_r03-r05) is the blindness this replaces.
+
+    Bad rounds (rc!=0, parsed null, malformed JSON) are SKIPPED LOUDLY:
+    they appear in the table and in the skip note, but never silence the
+    value trajectory line — earlier builds rendered an empty trajectory
+    whenever the glob hit only rc=3 archives."""
     import glob
 
     lines = ["", "## Bench-lane trajectory (BENCH_r*.json)", ""]
     rows = []
+    good = []   # (round name, lane, metric, value) — plottable points
+    skipped = []  # (round name, reason) — named, not silenced
     for path in sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json"))):
+        name = os.path.basename(path)
         try:
             with open(path) as fh:
                 d = json.load(fh)
-        except (OSError, json.JSONDecodeError):
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append((name, "?", "-", "(malformed archive)",
+                         None, None, "-", "-"))
+            skipped.append((name, f"malformed: {type(e).__name__}"))
             continue
         parsed = d.get("parsed")
-        if isinstance(parsed, dict):
-            rows.append((os.path.basename(path), d.get("rc"),
-                         parsed.get("lane", parsed.get("platform", "?")),
+        if isinstance(parsed, dict) and parsed.get("value") is not None:
+            lane = parsed.get("lane", parsed.get("platform", "?"))
+            rows.append((name, d.get("rc"), lane,
                          parsed.get("metric"), parsed.get("value"),
                          parsed.get("vs_baseline"),
                          parsed.get("precision", "-"),
                          parsed.get("fused_step", "-")))
+            good.append((name, lane, parsed.get("metric"),
+                         parsed.get("value")))
         else:
-            rows.append((os.path.basename(path), d.get("rc"), "-",
+            rows.append((name, d.get("rc"), "-",
                          "(no parsed datapoint)", None, None, "-", "-"))
+            skipped.append((name, f"rc={d.get('rc')}, no parsed "
+                                  "datapoint"))
     if not rows:
         return []
     # precision / fused_step columns (PR 8): the trajectory must record
@@ -255,6 +270,64 @@ def cpu_lane_lines(repo_root: str):
             name, rc, lane, metric,
             fmt(value) if value is not None else "null",
             fmt(vsb) if vsb is not None else "", prec, fused))
+    lines.append("")
+    if good:
+        by_lane = {}
+        for name, lane, metric, value in good:
+            by_lane.setdefault(lane, []).append(
+                f"{name.replace('BENCH_', '').replace('.json', '')} "
+                f"{fmt(value)}")
+        for lane, pts in sorted(by_lane.items()):
+            lines.append(f"- {lane} lane trajectory: "
+                         + " -> ".join(pts))
+    else:
+        lines.append("- lane trajectory: NO parsed datapoints in any "
+                     "round")
+    if skipped:
+        lines.append("- skipped rounds (no datapoint): "
+                     + "; ".join(f"{n} ({r})" for n, r in skipped))
+    return lines
+
+
+def trajectory_serving_lines(rows):
+    """Tables for serve_bench --trajectory artifacts: ring-native orbit
+    generation vs the naive per-frame client loop, with the delivery /
+    zero-recompile contract columns."""
+    lines = []
+    for name, d in rows:
+        traj = d.get("trajectory")
+        if not isinstance(traj, dict):
+            continue
+        lines += ["", f"## Trajectory serving — {name}", ""]
+        tr = traj.get("trace", {})
+        lines.append(
+            f"- trace: {tr.get('orbits')} orbit(s) × "
+            f"{tr.get('frames_per_orbit')} frames × "
+            f"{tr.get('reps')} rep(s) at {tr.get('steps_per_frame')} "
+            f"step(s)/frame, k_max {tr.get('k_max')}, flush "
+            f"{tr.get('flush_timeout_ms')}ms")
+        lines.append(
+            f"- ring-native vs naive per-frame loop: "
+            f"**{traj.get('ring_vs_naive')}×** "
+            f"({traj.get('fps_ring')} vs {traj.get('fps_naive')} "
+            "frames/s)")
+        ring = traj.get("ring", {})
+        lines += ["",
+                  "| lane | frames | window (s) | frames/s | built | "
+                  "jit Δ | commit Δ | delivery |",
+                  "|---|---|---|---|---|---|---|---|"]
+        lines.append("| ring | {} | {} | {} | {} | {} | {} | {} |".format(
+            ring.get("frames_delivered"), fmt(ring.get("window_s", 0.0)),
+            fmt(ring.get("frames_per_sec", 0.0)),
+            ring.get("programs_built_delta"),
+            ring.get("jit_cache_entries_delta"),
+            ring.get("commit_jit_entries_delta"),
+            "ok" if ring.get("delivery_ok") else "INCOMPLETE"))
+        naive = traj.get("naive", {})
+        lines.append("| naive | {} | {} | {} | | | | |".format(
+            naive.get("frames_delivered"),
+            fmt(naive.get("window_s", 0.0)),
+            fmt(naive.get("frames_per_sec", 0.0))))
     return lines
 
 
@@ -331,6 +404,8 @@ def main() -> int:
     lines += continuous_lines(rows)
     # Precision/fused-step lanes for any --precision-sweep artifacts.
     lines += precision_sweep_lines(rows)
+    # Ring-native vs naive orbit serving for --trajectory artifacts.
+    lines += trajectory_serving_lines(rows)
     # The restored CPU-lane trajectory from the repo-root BENCH archives.
     lines += cpu_lane_lines(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
