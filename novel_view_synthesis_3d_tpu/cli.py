@@ -350,6 +350,37 @@ def cmd_sample(args, overrides: List[str]) -> int:
 # ---------------------------------------------------------------------------
 # serve
 # ---------------------------------------------------------------------------
+def submit_with_retry(submit, *, retries: int = 4, sleep=None, rng=None):
+    """Call `submit` (a zero-arg closure over service.submit/
+    submit_trajectory), honoring the service's structured rejections.
+
+    A rejection with `retryable=True` carries `retry_after_s` — the
+    server's own estimate of when capacity returns (brownout shed,
+    drain-for-restart, queue full). The client waits that long plus up
+    to 50% jitter (so a herd of rejected clients doesn't re-arrive in
+    lockstep) and retries, at most `retries` more times; a non-retryable
+    rejection or an exhausted budget re-raises the last error.
+
+    `sleep`/`rng` are injection points for tests (real time.sleep and a
+    fresh random.Random by default).
+    """
+    import random
+    import time
+
+    sleep = sleep if sleep is not None else time.sleep
+    rng = rng if rng is not None else random.Random()
+    for attempt in range(retries + 1):
+        try:
+            return submit()
+        except Exception as e:
+            if not getattr(e, "retryable", False) or attempt == retries:
+                raise
+            base = float(getattr(e, "retry_after_s", 0.0) or 0.0)
+            if base <= 0.0:
+                base = 0.05 * (2 ** attempt)
+            sleep(base * (1.0 + 0.5 * rng.random()))
+
+
 def cmd_serve(args, overrides: List[str]) -> int:
     """Micro-batched sampling service (sample/service.py).
 
@@ -509,31 +540,61 @@ def cmd_serve(args, overrides: List[str]) -> int:
             event_cb=lambda s, kind, detail, version: bus.event(
                 s, kind, detail, model_version=version,
                 echo="[registry]"))
+    # Rolling-restart contract: SIGTERM/SIGINT flips the service into
+    # drain mode — new admissions get a retryable reject (clients fail
+    # over to a peer), in-flight and queued work finishes, telemetry
+    # flushes, and the process exits 0 so the orchestrator's restart
+    # counts as clean.
+    import signal
+    import threading
+
+    drain_requested = threading.Event()
+
+    def _on_term(signum, frame):
+        drain_requested.set()
+        service.begin_drain(reason=signal.Signals(signum).name)
+
+    prev_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[sig] = signal.signal(sig, _on_term)
+        except ValueError:
+            pass  # non-main thread (embedded use): no signal hooks
     try:
         from novel_view_synthesis_3d_tpu.utils.images import (
             save_image_strip)
 
         tickets = []
         for i, spec in enumerate(specs):
+            if drain_requested.is_set():
+                print(f"draining: requests {i}..{len(specs) - 1} not "
+                      "submitted")
+                break
             try:
                 cond, poses = build_request(spec)
                 if poses is not None:
-                    tickets.append((i, service.submit_trajectory(
-                        cond, poses=poses,
-                        seed=int(spec.get("seed", args.seed + i)),
-                        sample_steps=spec.get("sample_steps",
-                                              args.sample_steps),
-                        guidance_weight=spec.get("guidance_weight"),
-                        deadline_ms=spec.get("deadline_ms"),
-                        k_max=spec.get("k_max"))))
+                    def _submit(cond=cond, poses=poses, spec=spec, i=i):
+                        return service.submit_trajectory(
+                            cond, poses=poses,
+                            seed=int(spec.get("seed", args.seed + i)),
+                            sample_steps=spec.get("sample_steps",
+                                                  args.sample_steps),
+                            guidance_weight=spec.get("guidance_weight"),
+                            deadline_ms=spec.get("deadline_ms"),
+                            k_max=spec.get("k_max"))
                 else:
-                    tickets.append((i, service.submit(
-                        cond,
-                        seed=int(spec.get("seed", args.seed + i)),
-                        sample_steps=spec.get("sample_steps",
-                                              args.sample_steps),
-                        guidance_weight=spec.get("guidance_weight"),
-                        deadline_ms=spec.get("deadline_ms"))))
+                    def _submit(cond=cond, spec=spec, i=i):
+                        return service.submit(
+                            cond,
+                            seed=int(spec.get("seed", args.seed + i)),
+                            sample_steps=spec.get("sample_steps",
+                                                  args.sample_steps),
+                            guidance_weight=spec.get("guidance_weight"),
+                            deadline_ms=spec.get("deadline_ms"))
+                # Brownout/queue-full rejects are retryable with a
+                # server-suggested retry_after_s; honor it before giving
+                # up on the request.
+                tickets.append((i, submit_with_retry(_submit)))
             except Rejected as e:
                 print(f"request {i}: rejected ({e})")
         served = 0
@@ -576,7 +637,16 @@ def cmd_serve(args, overrides: List[str]) -> int:
     finally:
         if watcher is not None:
             watcher.stop()
-        service.stop()
+        if drain_requested.is_set():
+            # Drain already rejected new admissions; wait (bounded by
+            # serve.drain_timeout_s) for the in-flight tail, then stop.
+            clean = service.drain(reason="signal")
+            print(f"drain {'complete' if clean else 'TIMED OUT'}; "
+                  "exiting 0")
+        else:
+            service.stop()
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
         telemetry.finalize()  # trace.json + gauges flushed into --out
     summary = dict(service.summary(), served=served,
                    submitted=len(specs), checkpoint_step=step)

@@ -361,6 +361,44 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class BrownoutConfig:
+    """Serving brownout ladder (docs/DESIGN.md "Serving survivability").
+
+    Two pressure signals — queue depth (queued, undispatched requests)
+    and step debt (denoise steps still owed to the ring + queue) — drive
+    a three-level ladder evaluated at admission time:
+
+      level 0 (serving)  admit normally;
+      level 1 (degraded) admit, but cap trajectory requests' bank window
+                         at `k_cap` and their frame count at
+                         `max_frames_cap` (cheaper orbits, full refusal
+                         not yet needed);
+      level 2 (shedding) reject with a structured retryable reason
+                         (`Rejected.retryable=True`, `retry_after_s`)
+                         BEFORE the hard queue-full backstop.
+
+    A threshold of 0 disables that signal/level; all four at 0 (the
+    default) disables the ladder entirely. Transitions are logged
+    (events.csv `brownout` rows) and exported as the
+    `nvs3d_brownout_level` gauge."""
+
+    # Level-1 (degrade) thresholds: queued requests / owed denoise steps.
+    queue_soft: int = 0
+    debt_soft: int = 0
+    # Level-2 (shed) thresholds. Must be >= the soft ones when both set.
+    queue_hard: int = 0
+    debt_hard: int = 0
+    # Degraded-admission caps for trajectory requests (0 = leave as
+    # requested). Applied at admission, so an in-flight orbit never
+    # changes shape mid-ring.
+    k_cap: int = 0
+    max_frames_cap: int = 0
+    # Hint returned with level-2 rejects: how long the client should
+    # back off before retrying.
+    retry_after_s: float = 0.25
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Sampling-service front-end (sample/service.py; `nvs3d serve`).
 
@@ -434,6 +472,35 @@ class ServeConfig:
     # Where the service writes its events.csv (rejections, deadline
     # expiries) — same schema as the trainer's.
     results_folder: str = "./serve"
+    # --- survivability (docs/DESIGN.md "Serving survivability") ---
+    # Graceful drain: on SIGTERM/SIGINT (`nvs3d serve`) or
+    # SamplingService.drain(), new admissions are rejected with a
+    # structured retryable reason while queued + in-ring work finishes;
+    # past this budget the leftovers fail retryably and the worker stops.
+    drain_timeout_s: float = 30.0
+    # Worker supervisor (the serving analogue of `nvs3d train
+    # --supervise`): a died worker thread is restarted with exponential
+    # backoff at most this many times per service lifetime; undispatched
+    # requests stay queued across the restart, in-flight ring rows fail
+    # retryably. 0 disables restarts (a worker death stops the service).
+    max_worker_restarts: int = 3
+    # First-restart backoff; doubles per consecutive restart (capped at
+    # 30 s). Small default: serving restarts race an SLO, not a
+    # checkpoint restore.
+    worker_backoff_s: float = 0.05
+    # In-ring anomaly quarantine: consecutive non-finite steps (the
+    # per-row device-side finite mask) a slot survives before it is
+    # evicted and its ticket failed with SampleAnomaly. NaN never heals
+    # under further denoising, so 1 (evict on first strike) is right for
+    # production; > 1 exists for drills/diagnosis.
+    anomaly_strikes: int = 1
+    # stop()'s worker-join budget: past it the service writes a
+    # stall-style all-thread-stacks diagnosis and raises instead of
+    # silently leaking a wedged thread (PR 2 watchdog convention).
+    stop_timeout_s: float = 10.0
+    # Brownout degradation ladder (off by default).
+    brownout: BrownoutConfig = dataclasses.field(
+        default_factory=BrownoutConfig)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -858,6 +925,53 @@ class Config:
             errors.append(
                 f"serve.max_frames={sv.max_frames} must be >= 1 (it "
                 "bounds the poses per trajectory request)")
+        if sv.drain_timeout_s < 0:
+            errors.append(
+                f"serve.drain_timeout_s={sv.drain_timeout_s} must be "
+                ">= 0 (the in-flight budget of a graceful drain)")
+        if sv.max_worker_restarts < 0:
+            errors.append(
+                f"serve.max_worker_restarts={sv.max_worker_restarts} "
+                "must be >= 0 (0 disables supervised worker restarts)")
+        if sv.worker_backoff_s < 0:
+            errors.append(
+                f"serve.worker_backoff_s={sv.worker_backoff_s} must be "
+                ">= 0")
+        if sv.anomaly_strikes < 1:
+            errors.append(
+                f"serve.anomaly_strikes={sv.anomaly_strikes} must be "
+                ">= 1 (strikes before a non-finite ring row is evicted)")
+        if sv.stop_timeout_s <= 0:
+            errors.append(
+                f"serve.stop_timeout_s={sv.stop_timeout_s} must be > 0 "
+                "(stop()'s worker-join budget before the stall "
+                "diagnosis)")
+        bo = sv.brownout
+        for fname in ("queue_soft", "queue_hard", "debt_soft",
+                      "debt_hard", "k_cap", "max_frames_cap"):
+            if getattr(bo, fname) < 0:
+                errors.append(
+                    f"serve.brownout.{fname}={getattr(bo, fname)} must "
+                    "be >= 0 (0 disables that signal)")
+        if (bo.queue_soft > 0 and bo.queue_hard > 0
+                and bo.queue_hard < bo.queue_soft):
+            errors.append(
+                f"serve.brownout.queue_hard={bo.queue_hard} must be >= "
+                f"queue_soft={bo.queue_soft} (shed only past degrade)")
+        if (bo.debt_soft > 0 and bo.debt_hard > 0
+                and bo.debt_hard < bo.debt_soft):
+            errors.append(
+                f"serve.brownout.debt_hard={bo.debt_hard} must be >= "
+                f"debt_soft={bo.debt_soft} (shed only past degrade)")
+        if bo.retry_after_s < 0:
+            errors.append(
+                f"serve.brownout.retry_after_s={bo.retry_after_s} must "
+                "be >= 0")
+        if bo.k_cap > 0 and sv.k_max > 0 and bo.k_cap > sv.k_max:
+            errors.append(
+                f"serve.brownout.k_cap={bo.k_cap} must be <= "
+                f"serve.k_max={sv.k_max} (a degraded admission cannot "
+                "widen the bank window)")
         sc = self.diffusion.stochastic_cond
         if sc not in (True, False):
             errors.append(

@@ -11,28 +11,39 @@ dispatches — requests in flight finish on the version they started on,
 warm sampler programs survive (the program cache is keyed on
 shapes/precision, not params), and the old tree is freed after the flip.
 
-Failure policy: a version that fails verification or staging is logged
-(`swap_fail` event) and BLACKLISTED until the pointer moves again — the
-service keeps serving the old weights, and the poller doesn't retry-storm
-a known-bad artifact. Rolling the channel back is therefore always safe:
-the watcher treats the restored pointer like any other move.
+Failure policy — a circuit breaker, not a permanent blacklist. A version
+that fails verification or staging is logged (`swap_fail` event) and the
+breaker OPENS: the poller stops retrying that version, the service keeps
+serving the old weights, and `nvs3d_swap_failures_total` ticks. After a
+backoff that doubles with each consecutive failure (capped at
+`breaker_cap_s`) the breaker goes HALF-OPEN and probes the same version
+once — transient faults (torn copy mid-publish, flaky blob store) heal
+without operator action, while a genuinely corrupt artifact re-opens the
+breaker with a longer backoff instead of retry-storming. A pointer move
+to a DIFFERENT version resets the breaker immediately: rolling the
+channel back or forward is always safe and takes effect on the next poll.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
+from novel_view_synthesis_3d_tpu import obs
 from novel_view_synthesis_3d_tpu.registry.gate import EventCb
 from novel_view_synthesis_3d_tpu.registry.store import (
     RegistryError,
     RegistryStore,
 )
+from novel_view_synthesis_3d_tpu.utils import faultinject
 
 
 class RegistryWatcher:
     def __init__(self, service, store: RegistryStore, channel: str, *,
                  poll_s: float = 2.0, event_cb: Optional[EventCb] = None,
+                 breaker_base_s: Optional[float] = None,
+                 breaker_cap_s: float = 300.0,
                  start: bool = True):
         self.service = service
         self.store = store
@@ -41,7 +52,18 @@ class RegistryWatcher:
         self.event_cb = event_cb
         self.swaps = 0
         self.failures = 0
+        self.consecutive_failures = 0
+        # Half-open probe cadence: default one poll period, so a flaky
+        # artifact is re-tried on the next poll but never sooner.
+        self.breaker_base_s = (float(breaker_base_s)
+                               if breaker_base_s is not None
+                               else self.poll_s)
+        self.breaker_cap_s = float(breaker_cap_s)
         self._failed_vid: Optional[str] = None
+        self._retry_at = 0.0  # monotonic deadline for the half-open probe
+        self._swap_failures_total = obs.get_registry().counter(
+            "nvs3d_swap_failures_total",
+            "model swaps that failed verify/stage (breaker openings)")
         self._stop = threading.Event()
         self._poked = threading.Event()  # test hook: poll NOW
         self._thread = threading.Thread(
@@ -66,26 +88,52 @@ class RegistryWatcher:
             vid = self.store.read_channel(self.channel)
         except OSError:
             return None
-        if (not vid or vid == self.service.model_version
-                or vid == self._failed_vid):
+        if not vid or vid == self.service.model_version:
             return None
+        half_open = False
+        if vid == self._failed_vid:
+            if time.monotonic() < self._retry_at:
+                return None  # breaker open: don't retry-storm
+            half_open = True  # backoff elapsed: single probe
         try:
+            faultinject.maybe_serve_swap_fail()
             manifest = self.store.verify(vid)
             params = self.store.load_params(vid, verify=False)
             self.service.swap_params(params, vid, step=manifest.step,
                                      timeout=600.0)
         except Exception as exc:  # IntegrityError, torn IO, staging error
             self.failures += 1
-            self._failed_vid = vid  # no retry-storm on a bad artifact
+            self._swap_failures_total.inc()
+            if vid == self._failed_vid:
+                self.consecutive_failures += 1
+            else:
+                self.consecutive_failures = 1
+            self._failed_vid = vid
+            backoff = min(self.breaker_cap_s,
+                          self.breaker_base_s
+                          * 2 ** (self.consecutive_failures - 1))
+            self._retry_at = time.monotonic() + backoff
             if self.event_cb is not None:
                 self.event_cb(0, "swap_fail",
                               f"channel {self.channel} -> {vid}: {exc!r}; "
                               "still serving "
-                              f"{self.service.model_version or '<initial>'}",
+                              f"{self.service.model_version or '<initial>'}"
+                              f"; breaker open (failure "
+                              f"{self.consecutive_failures}, "
+                              f"{'half-open probe failed, ' if half_open else ''}"
+                              f"retry in {backoff:.3g}s)",
                               vid)
             return None
         self.swaps += 1
+        if half_open and self.event_cb is not None:
+            self.event_cb(0, "swap_recover",
+                          f"channel {self.channel} -> {vid}: half-open "
+                          f"probe succeeded after "
+                          f"{self.consecutive_failures} failure(s); "
+                          "breaker closed", vid)
         self._failed_vid = None
+        self.consecutive_failures = 0
+        self._retry_at = 0.0
         return vid
 
     def stop(self) -> None:

@@ -462,7 +462,8 @@ def make_slot_step_fn(model, config: DiffusionConfig, *,
     The serving stepper's device program (sample/service.py,
     docs/DESIGN.md "Continuous batching & distillation"):
 
-      step(params, z, keys, first, cond, coefs, w) -> (z_next, keys_next)
+      step(params, z, keys, first, cond, coefs, w)
+        -> (z_next, keys_next, finite)
 
     with z (B, H, W, 3), keys a (B, 2) per-row PRNG carry, `first` a (B,)
     bool marking rows entering the ring THIS step, `coefs` a
@@ -497,7 +498,14 @@ def make_slot_step_fn(model, config: DiffusionConfig, *,
     matrix — one HBM pass per step instead of ~a dozen elementwise
     HLOs, identical math and RNG stream. `param_transform` (optional)
     is applied to `params` INSIDE the jit — the int8 serving path
-    passes the dequantizer here (sample/precision.py)."""
+    passes the dequantizer here (sample/precision.py).
+
+    `finite` is a (B,) bool — a device-side all-reduce of
+    isfinite(z_next) per row, the in-ring anomaly mask the service's
+    quarantine consumes (docs/DESIGN.md "Serving survivability"). It is
+    computed FROM z_next and never feeds back into the update, so
+    clean-path z/keys bits are untouched, and an extra output does not
+    change the program-cache identity (still bucket/shape-only)."""
     phi = config.cfg_rescale
     if not 0.0 <= phi <= 1.0:
         raise ValueError(f"cfg_rescale must be in [0, 1], got {phi}")
@@ -551,7 +559,11 @@ def make_slot_step_fn(model, config: DiffusionConfig, *,
             z_in, ec, eu, noise, coefs_in, w_in, sampler=sampler,
             objective=objective, eta=eta, cfg_rescale=phi,
             clip_denoised=clip_denoised)
-        return z_next, keys_next
+        # Per-row anomaly mask: reduced on device so the host learns
+        # "row i went non-finite" from a (B,) bool instead of pulling
+        # the latent back every step. Read-only over z_next.
+        finite = jnp.all(jnp.isfinite(z_next).reshape(B, -1), axis=1)
+        return z_next, keys_next, finite
 
     return step
 
@@ -563,7 +575,8 @@ def make_bank_step_fn(model, config: DiffusionConfig, k_max: int, *,
     "Trajectory serving & stochastic conditioning").
 
       step(params, z, keys, first, cond, coefs, w, R2, t2,
-           bank_x, bank_R, bank_t, bank_state) -> (z_next, keys_next)
+           bank_x, bank_R, bank_t, bank_state)
+        -> (z_next, keys_next, finite)
 
     On top of the slot-step contract: `bank_x` (B, k_max, H, W, C) holds
     each row's clean conditioning frames (the request's source view plus
@@ -683,7 +696,11 @@ def make_bank_step_fn(model, config: DiffusionConfig, k_max: int, *,
             z_in, ec, eu, noise, coefs_in, w_in, sampler=sampler,
             objective=objective, eta=eta, cfg_rescale=phi,
             clip_denoised=clip_denoised)
-        return z_next, keys_next
+        # Same read-only per-row anomaly mask as make_slot_step_fn —
+        # vital here: a non-finite frame committed to the bank would
+        # poison every later frame's stochastic conditioning.
+        finite = jnp.all(jnp.isfinite(z_next).reshape(B, -1), axis=1)
+        return z_next, keys_next, finite
 
     return step
 

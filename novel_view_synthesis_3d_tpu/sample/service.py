@@ -104,6 +104,8 @@ Shared architecture (docs/DESIGN.md "Serving"):
 from __future__ import annotations
 
 import collections
+import os
+import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -112,6 +114,7 @@ import jax
 import numpy as np
 
 from novel_view_synthesis_3d_tpu import obs
+from novel_view_synthesis_3d_tpu.utils import faultinject
 from novel_view_synthesis_3d_tpu.config import DiffusionConfig, ServeConfig
 from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
 from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
@@ -137,7 +140,46 @@ class ServeError(RuntimeError):
 
 
 class Rejected(ServeError):
-    """Request refused at submit time (backpressure / bad input)."""
+    """Request refused at submit time (backpressure / bad input).
+
+    The refusal is STRUCTURED (docs/DESIGN.md "Serving survivability"):
+    `retryable=True` means the request itself was fine and the service
+    was merely loaded/draining/restarting — clients should back off
+    `retry_after_s` (plus jitter; cli.submit_with_retry) and resubmit.
+    `retryable=False` (malformed conditioning, bad step count) means a
+    retry would fail identically."""
+
+    def __init__(self, message: str, *, retryable: bool = False,
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retryable = retryable
+        self.retry_after_s = float(retry_after_s)
+
+
+class SampleAnomaly(ServeError):
+    """A ring row's latent went non-finite and the slot was quarantined.
+
+    The per-row finite mask (a device-side reduce folded into the step
+    program, sample/ddpm.make_slot_step_fn) flagged this request's z;
+    after `serve.anomaly_strikes` consecutive strikes the slot is
+    EVICTED — its co-riders are untouched (ring-composition invariance
+    means the poison cannot spread across rows) and nothing non-finite
+    is ever streamed, resolved, or committed to a frame bank. Retryable:
+    the usual causes (distilled/int8 students under guidance-weight
+    extremes) are stochastic, so the same request often serves clean on
+    resubmit. For trajectory tickets the frames already streamed ride
+    along (`frames`); `frame_index` names the first frame NOT
+    delivered."""
+
+    retryable = True
+
+    def __init__(self, message: str, *,
+                 frames: Optional[List[np.ndarray]] = None,
+                 frame_index: int = 0, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.frames = list(frames) if frames else []
+        self.frame_index = int(frame_index)
+        self.retry_after_s = float(retry_after_s)
 
 
 class DeadlineExceeded(ServeError):
@@ -385,7 +427,8 @@ class _Slot:
 
     __slots__ = ("req", "bank", "w", "z", "keys", "first", "t", "version",
                  "t_admit", "device_s", "compile_s", "steps_done",
-                 "bucket0", "batch0", "fbank", "frame_index", "frame_t0")
+                 "bucket0", "batch0", "fbank", "frame_index", "frame_t0",
+                 "strikes")
 
     def __init__(self, req: _Request, bank, version: str, t_admit: float,
                  fbank: Optional[FrameBank] = None):
@@ -408,6 +451,9 @@ class _Slot:
         self.fbank = fbank
         self.frame_index = 0
         self.frame_t0 = t_admit
+        # Consecutive non-finite steps (the device-side anomaly mask);
+        # at serve.anomaly_strikes the slot is quarantined.
+        self.strikes = 0
 
     @property
     def shape(self) -> tuple:
@@ -553,6 +599,29 @@ class SamplingService:
         self._frames_count = 0
         self._frames_t0: Optional[float] = None
         self._traj_in_ring = 0
+        # Survivability surfaces (docs/DESIGN.md "Serving
+        # survivability"): anomaly quarantine, drain state, supervised
+        # worker restarts, and the brownout ladder.
+        self._anomalies_total = obs.get_registry().counter(
+            "nvs3d_sample_anomalies_total",
+            "ring rows quarantined for non-finite latents")
+        self._worker_restarts_total = obs.get_registry().counter(
+            "nvs3d_worker_restarts_total",
+            "supervised restarts of the sampling worker thread")
+        self._serve_state_gauge = obs.get_registry().gauge(
+            "nvs3d_serve_state",
+            "service lifecycle: 0=serving, 1=draining, 2=stopped")
+        self._brownout_gauge = obs.get_registry().gauge(
+            "nvs3d_brownout_level",
+            "brownout ladder level: 0=serving, 1=degraded, 2=shedding")
+        self._serve_state_gauge.set(0.0)
+        self.anomalies = 0
+        self.worker_restarts = 0
+        self.dispatches = 0
+        self._draining = False
+        self._drained_ev = threading.Event()
+        self._brownout_level = 0
+        self._ring_debt = 0
         self._results_folder = results_folder or self.serve.results_folder
         self._events_lock = threading.Lock()
         # Live (params, model_version) pair — ONE attribute so readers
@@ -621,27 +690,128 @@ class SamplingService:
         if self._worker is None:
             self._stop.clear()
             self._worker = threading.Thread(
-                target=self._run, daemon=True, name="sampling-service")
+                target=self._run_supervised, daemon=True,
+                name="sampling-service")
             self._worker.start()
         return self
 
-    def stop(self) -> None:
-        """Stop the worker; queued-but-undispatched requests fail with
-        Rejected('service stopped')."""
+    def stop(self, timeout: Optional[float] = None) -> None:
+        """Stop the worker; queued-but-undispatched requests fail with a
+        RETRYABLE Rejected('service stopped').
+
+        `timeout` (default serve.stop_timeout_s) bounds the worker join.
+        A join that times out means the worker is WEDGED mid-dispatch —
+        the service writes a stall-style all-thread-stacks diagnosis
+        (stall_serve_stop_<n>.txt, the PR 2 watchdog convention) and
+        raises instead of silently leaking a live thread that still owns
+        the device."""
+        timeout = self.serve.stop_timeout_s if timeout is None else timeout
         self._stop.set()
         with self._queue_cv:
             self._queue_cv.notify_all()
-        if self._worker is not None:
-            self._worker.join(timeout=10.0)
+        worker = self._worker
+        if worker is not None:
+            worker.join(timeout=timeout)
+            if worker.is_alive():
+                self._dump_stop_stall(worker, timeout)
+                raise RuntimeError(
+                    f"sampling-service worker still alive after "
+                    f"{timeout:.1f}s join (stop()): thread-stack "
+                    f"diagnosis written under {self._results_folder!r} "
+                    "(stall_serve_stop_*.txt)")
             self._worker = None
+        self._serve_state_gauge.set(2.0)
         # A swap staged but not yet applied must not leave its waiter
         # hanging: apply it inline (no dispatch can be in flight now).
         self._apply_pending_swap()
+        self._fail_queue(lambda: Rejected(
+            "service stopped", retryable=True, retry_after_s=1.0))
+
+    def _fail_queue(self, make_error: Callable[[], ServeError]) -> None:
         with self._lock:
             leftovers = list(self._queue)
             self._queue.clear()
         for req in leftovers:
-            req.ticket._fail(Rejected("service stopped"))
+            req.ticket._fail(make_error())
+
+    def _dump_stop_stall(self, worker: threading.Thread,
+                         timeout: float) -> None:
+        """Wedged-worker diagnosis: every thread's stack to a stall_*
+        file (stderr when even that fails — the diagnosis must never be
+        the second fault), plus a `stall` event row."""
+        from novel_view_synthesis_3d_tpu.utils import watchdog
+
+        self._append_event(
+            0, "stall",
+            f"stop(): worker {worker.name!r} wedged past the "
+            f"{timeout:.1f}s join (serve.stop_timeout_s); diagnosis "
+            "stall_serve_stop_*.txt", model_version=self.model_version)
+        body = (f"sampling-service stop(): worker {worker.name!r} still "
+                f"alive after join timeout {timeout:.1f}s\n"
+                f"time: {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}"
+                f"\ndispatches: {self.dispatches}\n\n"
+                + watchdog.thread_stacks())
+        try:
+            os.makedirs(self._results_folder, exist_ok=True)
+            n = 0
+            while os.path.exists(os.path.join(
+                    self._results_folder, f"stall_serve_stop_{n}.txt")):
+                n += 1
+            path = os.path.join(self._results_folder,
+                                f"stall_serve_stop_{n}.txt")
+            with open(path, "w") as fh:
+                fh.write(body)
+            print(f"[serve] wedged-worker diagnosis: {path}",
+                  file=sys.stderr, flush=True)
+        except OSError:
+            print(body, file=sys.stderr, flush=True)
+
+    def begin_drain(self, reason: str = "") -> None:
+        """Flip to DRAINING: admissions are rejected with a structured
+        retryable reason; queued + in-ring work keeps being served until
+        done (the worker then parks itself). Non-blocking — `drain()`
+        adds the wait + stop. Idempotent."""
+        with self._queue_cv:
+            if self._draining or self._stop.is_set():
+                return
+            self._draining = True
+            self._queue_cv.notify_all()
+        self._serve_state_gauge.set(1.0)
+        self._append_event(
+            0, "drain",
+            "accepting -> draining"
+            + (f" ({reason})" if reason else "")
+            + "; new admissions rejected retryably, in-flight work "
+            f"finishes within serve.drain_timeout_s="
+            f"{self.serve.drain_timeout_s:.0f}s",
+            model_version=self.model_version)
+
+    def drain(self, timeout_s: Optional[float] = None,
+              reason: str = "") -> bool:
+        """Graceful shutdown (the SIGTERM path of `nvs3d serve`):
+        reject new admissions retryably, let every queued and in-ring
+        request finish, then stop. Returns True when everything in
+        flight completed within `timeout_s` (default
+        serve.drain_timeout_s); on timeout the leftovers fail with a
+        retryable Rejected via stop()."""
+        timeout_s = (self.serve.drain_timeout_s if timeout_s is None
+                     else float(timeout_s))
+        self.begin_drain(reason)
+        worker = self._worker
+        if worker is None or not worker.is_alive():
+            with self._lock:
+                drained = not self._queue
+        else:
+            drained = self._drained_ev.wait(timeout_s)
+        self._append_event(
+            0, "drain",
+            ("draining -> stopped (clean: queue and ring empty)"
+             if drained else
+             f"draining -> stopped (TIMEOUT after {timeout_s:.1f}s; "
+             "leftover requests fail retryably)"),
+            model_version=self.model_version)
+        self.stop()
+        return drained
 
     def __enter__(self) -> "SamplingService":
         return self.start()
@@ -762,6 +932,56 @@ class SamplingService:
         pend["applied"].set()
 
     # -- submission ----------------------------------------------------
+    def _step_debt_locked(self) -> int:
+        """Denoise steps still owed: the ring's remaining steps (updated
+        by the worker each dispatch) plus everything queued. One of the
+        two brownout pressure signals — queue DEPTH is blind to a queue
+        of three 256-step orbits. Caller holds self._lock."""
+        debt = self._ring_debt
+        for r in self._queue:
+            steps = int(r.program_key[2])
+            debt += steps * (r.num_frames if r.is_traj else 1)
+        return debt
+
+    def _brownout_check(self, request_id: int) -> int:
+        """Evaluate the brownout ladder at admission time; returns the
+        level (0 serving / 1 degraded / 2 shedding) and logs + gauges
+        the transition when it moved."""
+        bo = self.serve.brownout
+        if not (bo.queue_soft or bo.queue_hard or bo.debt_soft
+                or bo.debt_hard):
+            return 0
+        with self._lock:
+            q = len(self._queue)
+            debt = (self._step_debt_locked()
+                    if (bo.debt_soft or bo.debt_hard) else 0)
+            level = 0
+            if ((bo.queue_soft and q >= bo.queue_soft)
+                    or (bo.debt_soft and debt >= bo.debt_soft)):
+                level = 1
+            if ((bo.queue_hard and q >= bo.queue_hard)
+                    or (bo.debt_hard and debt >= bo.debt_hard)):
+                level = 2
+            prev, self._brownout_level = self._brownout_level, level
+        if level != prev:
+            self._brownout_gauge.set(float(level))
+            names = {0: "serving", 1: "degraded", 2: "shedding"}
+            self._append_event(
+                request_id, "brownout",
+                f"level {prev} ({names[prev]}) -> {level} "
+                f"({names[level]}): queued={q}, step_debt={debt}",
+                model_version=self.model_version)
+        return level
+
+    def _reject_drain(self, ticket) -> None:
+        self._log_event(ticket.request_id, "drain",
+                        "admission rejected: service draining "
+                        "(retryable)")
+        raise Rejected(
+            "service draining for restart; retry against a peer or "
+            "after the restart", retryable=True,
+            retry_after_s=self.serve.drain_timeout_s)
+
     def submit(self, cond: Dict[str, np.ndarray], *, seed: int = 0,
                sample_steps: Optional[int] = None,
                guidance_weight: Optional[float] = None,
@@ -792,6 +1012,15 @@ class SamplingService:
             deadline_ms = self.serve.default_deadline_ms
         program_key = (int(x.shape[0]), int(x.shape[1]), int(steps), w)
         ticket = Ticket(self._claim_id())
+        if self._brownout_check(ticket.request_id) >= 2:
+            self._log_event(
+                ticket.request_id, "reject",
+                "brownout shed (level 2): load above "
+                "serve.brownout.{queue,debt}_hard (retryable)")
+            raise Rejected(
+                "service shedding load (brownout level 2); retry with "
+                "backoff", retryable=True,
+                retry_after_s=self.serve.brownout.retry_after_s)
         req = _Request(
             ticket,
             {k: np.asarray(cond[k]) for k in COND_KEYS},
@@ -801,13 +1030,16 @@ class SamplingService:
         with self._queue_cv:
             if self._stop.is_set():
                 raise Rejected("service stopped")
+            if self._draining:
+                self._reject_drain(ticket)
             if len(self._queue) >= self.serve.queue_depth:
                 self._log_event(
                     ticket.request_id, "reject",
                     f"queue full (depth {self.serve.queue_depth})")
                 raise Rejected(
                     f"queue full (serve.queue_depth="
-                    f"{self.serve.queue_depth}); retry with backoff")
+                    f"{self.serve.queue_depth}); retry with backoff",
+                    retryable=True, retry_after_s=0.05)
             self._queue.append(req)
             self._queue_cv.notify_all()
         return ticket
@@ -858,6 +1090,33 @@ class SamplingService:
                 f"k_max={k_max} outside [1, serve.k_max={self._k_max}] — "
                 "the service's bank arrays are sized once; per-request "
                 "windows can only shrink")
+        ticket_id = self._claim_id()
+        level = self._brownout_check(ticket_id)
+        if level >= 2:
+            self._log_event(
+                ticket_id, "reject",
+                "brownout shed (level 2): load above "
+                "serve.brownout.{queue,debt}_hard (retryable)")
+            raise Rejected(
+                "service shedding load (brownout level 2); retry with "
+                "backoff", retryable=True,
+                retry_after_s=self.serve.brownout.retry_after_s)
+        if level == 1:
+            # Degraded admission: cheaper orbits instead of refusal —
+            # a narrower conditioning window and/or a truncated pose
+            # list, applied HERE so an in-flight orbit never changes
+            # shape mid-ring.
+            bo = self.serve.brownout
+            if bo.k_cap and cap > bo.k_cap:
+                cap = bo.k_cap
+            if bo.max_frames_cap and n_frames > bo.max_frames_cap:
+                poses_R = poses_R[:bo.max_frames_cap]
+                poses_t = poses_t[:bo.max_frames_cap]
+                n_frames = bo.max_frames_cap
+                self._log_event(
+                    ticket_id, "brownout",
+                    f"degraded admission (level 1): orbit capped to "
+                    f"{n_frames} frames, bank window {cap}")
         steps = sample_steps or self.serve.sample_steps or \
             self.diffusion.sample_timesteps
         if not 1 <= int(steps) <= self.diffusion.timesteps:
@@ -869,7 +1128,7 @@ class SamplingService:
         if deadline_ms is None:
             deadline_ms = self.serve.default_deadline_ms
         program_key = (int(x.shape[0]), int(x.shape[1]), int(steps), w)
-        ticket = TrajectoryTicket(self._claim_id(), n_frames)
+        ticket = TrajectoryTicket(ticket_id, n_frames)
         full_cond = {k: np.asarray(cond[k]) for k in TRAJ_COND_KEYS}
         # R2/t2 ride as zeros so trajectory rows stack uniformly with
         # single-shot rows; the step program takes the CURRENT frame's
@@ -884,13 +1143,16 @@ class SamplingService:
         with self._queue_cv:
             if self._stop.is_set():
                 raise Rejected("service stopped")
+            if self._draining:
+                self._reject_drain(ticket)
             if len(self._queue) >= self.serve.queue_depth:
                 self._log_event(
                     ticket.request_id, "reject",
                     f"queue full (depth {self.serve.queue_depth})")
                 raise Rejected(
                     f"queue full (serve.queue_depth="
-                    f"{self.serve.queue_depth}); retry with backoff")
+                    f"{self.serve.queue_depth}); retry with backoff",
+                    retryable=True, retry_after_s=0.05)
             self._queue.append(req)
             self._queue_cv.notify_all()
         return ticket
@@ -921,7 +1183,10 @@ class SamplingService:
         return dict(self.stats.summary(), **self.compile_counters(),
                     model_version=self.model_version,
                     model_swaps=self._swaps,
-                    precision=self.precision, fused_step=fused)
+                    precision=self.precision, fused_step=fused,
+                    anomalies=self.anomalies,
+                    worker_restarts=self.worker_restarts,
+                    brownout_level=self._brownout_level)
 
     def _log_event(self, request_id: int, kind: str, detail: str) -> None:
         """Event-log append via the obs bus, schema-compatible with the
@@ -941,6 +1206,53 @@ class SamplingService:
             pass  # the event log must never be the serving fault
 
     # -- batching worker -----------------------------------------------
+    def _run_supervised(self) -> None:
+        """Worker supervisor (the serving analogue of train/supervisor):
+        a worker death — anything escaping `_run`'s per-dispatch guards
+        — is restarted with bounded exponential backoff instead of
+        stranding every ticket. Undispatched requests STAY QUEUED across
+        the restart (the new worker admits them); in-flight ring rows
+        were already failed retryably by `_run_stepper`'s unwind. Past
+        serve.max_worker_restarts the service gives up loudly: the
+        queue fails retryably and the service stops."""
+        while True:
+            try:
+                self._run()
+                return  # clean exit: stop() or drain completion
+            except BaseException as exc:
+                if self._stop.is_set():
+                    return
+                self.worker_restarts += 1
+                self._worker_restarts_total.inc()
+                n = self.worker_restarts
+                budget = self.serve.max_worker_restarts
+                if n > budget:
+                    self._append_event(
+                        -1, "worker_restart",
+                        f"worker died ({exc!r}); restart budget "
+                        f"serve.max_worker_restarts={budget} exhausted "
+                        "— service stopping, queued requests fail "
+                        "retryably", model_version=self.model_version)
+                    print(f"[serve] worker died ({exc!r}); restart "
+                          f"budget {budget} exhausted — stopping",
+                          file=sys.stderr, flush=True)
+                    self._stop.set()
+                    self._fail_queue(lambda: Rejected(
+                        "service worker dead (restart budget "
+                        "exhausted); retry against a peer",
+                        retryable=True, retry_after_s=1.0))
+                    return
+                delay = min(30.0, self.serve.worker_backoff_s
+                            * (2 ** (n - 1)))
+                self._append_event(
+                    -1, "worker_restart",
+                    f"worker died ({exc!r}); supervised restart "
+                    f"{n}/{budget} in {delay:.2f}s — undispatched "
+                    "requests stay queued",
+                    model_version=self.model_version)
+                if delay > 0 and self._stop.wait(delay):
+                    return
+
     def _run(self) -> None:
         if self.serve.scheduler == "step":
             self._run_stepper()
@@ -951,6 +1263,10 @@ class SamplingService:
         """Whole-request dispatch (PR 3 semantics; serve.scheduler=
         'request'): one lax.scan program per coalesced group."""
         while not self._stop.is_set():
+            faultinject.maybe_serve_worker_die(self.dispatches)
+            with self._lock:
+                if self._draining and not self._queue:
+                    break  # drained: nothing queued, nothing in flight
             # Swaps apply HERE — between dispatches, never under one, so
             # freeing the old tree can't race an in-flight program.
             self._apply_pending_swap()
@@ -963,6 +1279,7 @@ class SamplingService:
                 for req in group:
                     req.ticket._fail(
                         ServeError(f"dispatch failed: {exc!r}"))
+        self._drained_ev.set()
 
     # -- step-level continuous batching (serve.scheduler='step') --------
     def _run_stepper(self) -> None:
@@ -975,7 +1292,15 @@ class SamplingService:
         carry: Optional[dict] = None
         try:
             while not self._stop.is_set():
+                # Worker-death drill: raises OUTSIDE the per-dispatch
+                # guard below, so the exception unwinds the thread and
+                # exercises the supervisor restart path.
+                faultinject.maybe_serve_worker_die(self.dispatches)
                 if not ring:
+                    self._ring_debt = 0
+                    with self._lock:
+                        if self._draining and not self._queue:
+                            break  # drained: ring and queue both empty
                     # Swaps apply only on an empty ring (drain-on-swap):
                     # in-flight requests keep their start version.
                     if carry is not None:
@@ -1000,11 +1325,25 @@ class SamplingService:
                             self._traj_exit()
                     ring.clear()
                     carry = None
+            self._drained_ev.set()
         finally:
+            # Stop: the remaining rows were ASKED to die — retryable
+            # backpressure. A crash unwinding through here instead means
+            # their device state is lost mid-flight: also retryable (the
+            # supervisor restarts the worker, but ring rows cannot be
+            # replayed — their PRNG position is gone), with a hint.
+            if self._stop.is_set():
+                err_msg, after = "service stopped", 1.0
+            else:
+                err_msg = ("serving worker died mid-flight; in-ring "
+                           "state lost — safe to retry")
+                after = self.serve.worker_backoff_s * 2
             for slot in ring:
-                slot.req.ticket._fail(Rejected("service stopped"))
+                slot.req.ticket._fail(Rejected(
+                    err_msg, retryable=True, retry_after_s=after))
                 if slot.is_traj:
                     self._traj_exit()
+            self._ring_debt = 0
 
     def _admit(self, ring: List[_Slot]) -> bool:
         """Move queued requests into free ring slots; True if the ring
@@ -1021,7 +1360,8 @@ class SamplingService:
         with self._queue_cv:
             if not ring:
                 while (not self._queue and not self._stop.is_set()
-                       and self._pending_swap is None):
+                       and self._pending_swap is None
+                       and not self._draining):
                     self._queue_cv.wait(timeout=0.1)
                 if (self._stop.is_set() or not self._queue
                         or self._pending_swap is not None):
@@ -1210,6 +1550,20 @@ class SamplingService:
         its device bank in-jit, and re-arms for the next pose while the
         carry (z, keys, cond, banks) stays on device — only an expiry or
         the orbit's LAST frame makes the slot exit the ring."""
+        self.dispatches += 1
+        faultinject.maybe_serve_dispatch_raise(self.dispatches)
+        faultinject.maybe_serve_slow_step(self.dispatches)
+        nan_at = faultinject.serve_nan_spec()
+        if nan_at is not None and nan_at[0] == self.dispatches:
+            # Poison one row's carried latent at the host boundary; the
+            # DEVICE-side finite mask must catch it downstream — the
+            # drill proves detection, not just injection.
+            if carry is not None:
+                self._materialize(carry)
+                carry = None
+            victim = ring[min(nan_at[1], len(ring) - 1)]
+            if victim.z is not None:
+                victim.z = np.full_like(victim.z, np.nan)
         n = len(ring)
         bucket = bucket_for(n, self.serve.max_batch)
         H, W = ring[0].shape
@@ -1299,12 +1653,12 @@ class SamplingService:
         cold = not entry["warm"]
         t0 = time.perf_counter()
         if bank_mode:
-            z_next, keys_next = entry["fn"](
+            z_next, keys_next, finite_dev = entry["fn"](
                 params, z_dev, keys_dev, first_dev, cond_dev, coefs_dev,
                 w_dev, R2_dev, t2_dev, bank_dev[0], bank_dev[1],
                 bank_dev[2], state_dev)
         else:
-            z_next, keys_next = entry["fn"](
+            z_next, keys_next, finite_dev = entry["fn"](
                 params, z_dev, keys_dev, first_dev, cond_dev, coefs_dev,
                 w_dev)
         jax.block_until_ready(z_next)
@@ -1313,9 +1667,26 @@ class SamplingService:
         self.tracer.add_span("compile" if cold else "ring_step", elapsed,
                              bucket=bucket, batch_n=n)
         self.stats.record_span("ring_step", elapsed)
+        # In-ring anomaly quarantine: the step program's third output is
+        # a per-row finite mask (a device-side reduce — the host reads a
+        # (bucket,) bool, never the latent). A row under strikes keeps
+        # stepping (NaN can't heal, but the ladder is explicit); a row
+        # AT the strike budget — or any non-finite row at a frame or
+        # request boundary, where the only alternative is emitting the
+        # garbage — is evicted and its ticket failed with SampleAnomaly.
+        finite = np.asarray(jax.device_get(finite_dev))
+        anomalous: List[_Slot] = []
+        for i, s in enumerate(ring):
+            if finite[i]:
+                s.strikes = 0
+            else:
+                s.strikes += 1
+                if s.strikes >= self.serve.anomaly_strikes:
+                    anomalous.append(s)
+        anom_ids = {id(s) for s in anomalous}
         finished: List[_Slot] = []
         rearm: List[_Slot] = []
-        for s in ring:
+        for i, s in enumerate(ring):
             if s.first:
                 s.bucket0, s.batch0 = bucket, n
                 s.first = False
@@ -1327,12 +1698,24 @@ class SamplingService:
                 s.device_s += elapsed
             s.steps_done += 1
             s.t -= 1
+            if id(s) in anom_ids:
+                continue
             if s.t < 0:
-                if s.is_traj and s.frame_index + 1 < s.req.num_frames:
+                if not finite[i]:
+                    # Boundary forces the verdict regardless of strike
+                    # budget: a non-finite frame must never stream,
+                    # resolve, or commit into a bank.
+                    anomalous.append(s)
+                    anom_ids.add(id(s))
+                elif s.is_traj and s.frame_index + 1 < s.req.num_frames:
                     rearm.append(s)
                 else:
                     finished.append(s)
-        if not finished and not rearm:
+        self._ring_debt = sum(
+            (s.t + 1) + ((s.req.num_frames - s.frame_index - 1)
+                         * s.bank.n if s.is_traj else 0)
+            for s in ring if id(s) not in anom_ids)
+        if not finished and not rearm and not anomalous:
             # Every continuing row has now taken its first step, so the
             # carried `first` is the cached all-False vector (reusing
             # this dispatch's `first_dev` would re-draw init noise).
@@ -1350,7 +1733,10 @@ class SamplingService:
             k_host = np.asarray(jax.device_get(keys_next))
         expired: List[_Slot] = []
         with self.tracer.span("respond",
-                              batch_n=len(finished) + len(rearm)):
+                              batch_n=(len(finished) + len(rearm)
+                                       + len(anomalous))):
+            for s in anomalous:
+                self._quarantine_slot(s)
             for i, s in enumerate(ring):
                 if id(s) in rearm_ids:
                     # Frame boundary: deliver + in-jit bank commit +
@@ -1365,7 +1751,7 @@ class SamplingService:
                         self._finish_trajectory(s, z_host[i])
                     else:
                         self._resolve_slot(s, z_host[i])
-            if not finished and not expired:
+            if not finished and not expired and not anomalous:
                 # Pure frame boundary: the ring composition is
                 # unchanged, the carry stays device-resident. The stale
                 # bank_sig forces a device-side restack next dispatch
@@ -1379,7 +1765,7 @@ class SamplingService:
             if z_host is None:
                 z_host = np.asarray(jax.device_get(z_next))
                 k_host = np.asarray(jax.device_get(keys_next))
-            exit_ids = fin_ids | {id(s) for s in expired}
+            exit_ids = fin_ids | {id(s) for s in expired} | anom_ids
             keep: List[_Slot] = []
             for i, s in enumerate(ring):
                 if id(s) in exit_ids:
@@ -1389,6 +1775,37 @@ class SamplingService:
                 keep.append(s)
             ring[:] = keep
         return None
+
+    def _quarantine_slot(self, slot: _Slot) -> None:
+        """Evict a poisoned ring row: fail its ticket with a structured
+        SampleAnomaly, log + count the anomaly, and never let the
+        non-finite latent reach a stream, a resolution, or a bank
+        commit. Co-riders are untouched (ring-composition invariance
+        bounds the blast radius to one row)."""
+        req = slot.req
+        self.anomalies += 1
+        self._anomalies_total.inc()
+        where = f"after step {slot.steps_done}"
+        if slot.is_traj:
+            where += (f" of frame {slot.frame_index}/"
+                      f"{req.num_frames}")
+        self._log_event(
+            req.ticket.request_id, "anomaly",
+            f"non-finite latent {where} (strike {slot.strikes}/"
+            f"{self.serve.anomaly_strikes}); slot quarantined, ticket "
+            "failed retryably")
+        msg = (f"sample went non-finite {where}; the row was "
+               "quarantined before anything was streamed or committed "
+               "— safe to retry")
+        if slot.is_traj:
+            with req.ticket._lock:
+                done_frames = list(req.ticket._frames)
+            req.ticket._fail(SampleAnomaly(
+                msg + f"; {len(done_frames)} completed frames attached",
+                frames=done_frames, frame_index=slot.frame_index))
+            self._traj_exit()
+        else:
+            req.ticket._fail(SampleAnomaly(msg))
 
     def _frame_boundary(self, slot: _Slot, frame: np.ndarray,
                         frame_dev) -> bool:
@@ -1511,12 +1928,13 @@ class SamplingService:
         flush_s = self.serve.flush_timeout_ms / 1000.0
         with self._queue_cv:
             while (not self._queue and not self._stop.is_set()
-                   and self._pending_swap is None):
+                   and self._pending_swap is None
+                   and not self._draining):
                 self._queue_cv.wait(timeout=0.1)
             if self._stop.is_set():
                 return []
             if not self._queue:
-                return []  # woken by a pending swap: let _run apply it
+                return []  # woken by a swap/drain: let _run handle it
             first = self._queue[0]
             key = first.program_key
             deadline = first.t_submit + flush_s
@@ -1584,6 +2002,8 @@ class SamplingService:
                                     param_transform=self._param_transform)
 
     def _dispatch(self, group: List[_Request]) -> None:
+        self.dispatches += 1
+        faultinject.maybe_serve_dispatch_raise(self.dispatches)
         n = len(group)
         bucket = bucket_for(n, self.serve.max_batch)
         H, W, steps, w = group[0].program_key

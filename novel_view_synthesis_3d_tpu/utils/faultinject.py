@@ -40,6 +40,40 @@ Injection points:
                             tail → end marker missing → quarantined),
                             the shape a host dying mid-write leaves.
 
+Serving-plane points (sample/service.py stepper ring, registry/watcher.py;
+the chaos drills in tests/test_serve_chaos.py and `serve_bench --chaos`):
+
+  NVS3D_FI_SERVE_NAN_AT     "<dispatch>[:<row>]" (row defaults to 0); the
+                            stepper poisons ring row <row>'s carried z
+                            with NaN just before ring dispatch number
+                            <dispatch> — the device-side finite mask must
+                            quarantine exactly that slot. Exact-dispatch
+                            match, so it fires once.
+  NVS3D_FI_SERVE_WORKER_DIE_AT
+                            single dispatch ordinal; the service worker
+                            thread raises InjectedFault OUTSIDE the ring
+                            try-block at that dispatch (worker-death
+                            drill for the serve supervisor). One shot:
+                            cleared on fire so the restarted worker
+                            lives.
+  NVS3D_FI_SERVE_DISPATCH_RAISE_AT
+                            comma list of dispatch ordinals; the ring
+                            step / group dispatch raises InjectedFault
+                            INSIDE the guarded region (fail-the-ring,
+                            keep-serving drill).
+  NVS3D_FI_SERVE_SWAP_FAIL  integer N; the next N registry swap attempts
+                            (RegistryWatcher.poll_once) raise
+                            InjectedFault before verify — the circuit
+                            breaker / half-open-recovery drill. The
+                            counter decrements per fire and the env var
+                            is cleared at 0, so the (N+1)th attempt
+                            succeeds.
+  NVS3D_FI_SERVE_SLOW_STEP  "<dispatch>[:<seconds>]"; the stepper SLEEPS
+                            for <seconds> (default 30) at exactly that
+                            ring dispatch — the wedged-worker drill for
+                            SamplingService.stop()'s join-timeout
+                            diagnosis and the brownout step-debt drill.
+
 plus `truncate_checkpoint`, a direct helper that corrupts an on-disk Orbax
 step the way a mid-write preemption does (the checkpoint-fallback drill).
 """
@@ -160,6 +194,97 @@ def maybe_stall(kind: str, step: int) -> float:
 
     print(f"[faultinject] stalling {kind} at step {step} for "
           f"{spec[1]:.1f}s ({_STALL_ENVS[kind]})", flush=True)
+    time.sleep(spec[1])
+    return spec[1]
+
+
+def serve_nan_spec() -> Optional[Tuple[int, int]]:
+    """(dispatch, row) armed for the ring NaN-poison drill.
+
+    Env format "<dispatch>" (row 0) or "<dispatch>:<row>"."""
+    raw = os.environ.get("NVS3D_FI_SERVE_NAN_AT", "").strip()
+    if not raw:
+        return None
+    disp_s, _, row_s = raw.partition(":")
+    try:
+        return int(disp_s), int(row_s) if row_s else 0
+    except ValueError as e:
+        raise ValueError(
+            f"NVS3D_FI_SERVE_NAN_AT={raw!r} must be '<dispatch>' or "
+            "'<dispatch>:<row>'") from e
+
+
+def maybe_serve_worker_die(dispatch: int) -> None:
+    """Hook for the service worker loop (OUTSIDE the per-dispatch guard):
+    raise at the armed ring dispatch, killing the thread. One shot — the
+    env var is cleared so the supervisor's restarted worker runs clean."""
+    ats = _int_list("NVS3D_FI_SERVE_WORKER_DIE_AT")
+    if ats and dispatch >= ats[0]:
+        os.environ.pop("NVS3D_FI_SERVE_WORKER_DIE_AT", None)
+        raise InjectedFault(
+            f"injected worker death at ring dispatch {dispatch} "
+            "(NVS3D_FI_SERVE_WORKER_DIE_AT)")
+
+
+def maybe_serve_dispatch_raise(dispatch: int) -> None:
+    """Hook INSIDE the guarded ring-step/dispatch region: raise at the
+    armed dispatch ordinals (fail-the-group, keep-serving drill)."""
+    if dispatch in _int_list("NVS3D_FI_SERVE_DISPATCH_RAISE_AT"):
+        raise InjectedFault(
+            f"injected dispatch failure at ring dispatch {dispatch} "
+            "(NVS3D_FI_SERVE_DISPATCH_RAISE_AT)")
+
+
+def maybe_serve_swap_fail() -> None:
+    """Hook for RegistryWatcher.poll_once: fail the next N swap attempts,
+    decrementing the armed count so attempt N+1 succeeds (the half-open
+    recovery drill)."""
+    raw = os.environ.get("NVS3D_FI_SERVE_SWAP_FAIL", "").strip()
+    if not raw:
+        return
+    try:
+        n = int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"NVS3D_FI_SERVE_SWAP_FAIL={raw!r} must be an int") from e
+    if n <= 0:
+        os.environ.pop("NVS3D_FI_SERVE_SWAP_FAIL", None)
+        return
+    if n - 1 <= 0:
+        os.environ.pop("NVS3D_FI_SERVE_SWAP_FAIL", None)
+    else:
+        os.environ["NVS3D_FI_SERVE_SWAP_FAIL"] = str(n - 1)
+    raise InjectedFault(
+        "injected registry swap failure (NVS3D_FI_SERVE_SWAP_FAIL, "
+        f"{n - 1} left)")
+
+
+def serve_slow_step_spec() -> Optional[Tuple[int, float]]:
+    """(dispatch, seconds) armed for the slow-ring-step drill.
+
+    Env format "<dispatch>" (default 30 s) or "<dispatch>:<seconds>"."""
+    raw = os.environ.get("NVS3D_FI_SERVE_SLOW_STEP", "").strip()
+    if not raw:
+        return None
+    disp_s, _, dur_s = raw.partition(":")
+    try:
+        return int(disp_s), float(dur_s) if dur_s else _DEFAULT_STALL_S
+    except ValueError as e:
+        raise ValueError(
+            f"NVS3D_FI_SERVE_SLOW_STEP={raw!r} must be '<dispatch>' or "
+            "'<dispatch>:<seconds>'") from e
+
+
+def maybe_serve_slow_step(dispatch: int) -> float:
+    """Hook for the stepper ring: sleep if armed at exactly this dispatch
+    (the wedged-worker drill). Returns seconds slept (0.0 when inert)."""
+    spec = serve_slow_step_spec()
+    if spec is None or spec[0] != dispatch:
+        return 0.0
+    import time
+
+    print(f"[faultinject] slow ring step at dispatch {dispatch} for "
+          f"{spec[1]:.1f}s (NVS3D_FI_SERVE_SLOW_STEP)", flush=True)
     time.sleep(spec[1])
     return spec[1]
 

@@ -182,13 +182,14 @@ def test_slot_step_fused_bit_identical(setup, sampler_name):
     first = jnp.asarray([True, False, True, False])
     coefs = jnp.asarray(np.stack([bank.table[2]] * B))
     w = jnp.asarray([3.0, 1.5, 0.0, 7.0], jnp.float32)
-    zu, ku = make_slot_step_fn(model, dcfg)(
+    zu, ku, fu = make_slot_step_fn(model, dcfg)(
         params, z, keys, first, cond, coefs, w)
-    zf, kf = make_slot_step_fn(
+    zf, kf, ff = make_slot_step_fn(
         model, dataclasses.replace(dcfg, fused_step=True))(
             params, z, keys, first, cond, coefs, w)
     np.testing.assert_array_equal(np.asarray(zu), np.asarray(zf))
     np.testing.assert_array_equal(np.asarray(ku), np.asarray(kf))
+    assert np.asarray(fu).all() and np.asarray(ff).all()
 
 
 def test_fused_ring_composition_invariance(setup, tmp_path):

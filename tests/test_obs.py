@@ -370,6 +370,60 @@ def test_trajectory_frame_writer_routes_through_bus():
     assert "service.py" in emitters
 
 
+def test_survivability_event_writers_route_through_bus():
+    """The serving-survivability events (PR 11: anomaly quarantine,
+    drain state machine, brownout ladder, worker supervisor, swap
+    circuit breaker) are NEW writer surfaces — every module that names
+    one of the event kinds or the survivability gauges must route
+    through the bus (obs.append_event / an obs-wired event_cb), never a
+    private csv path (the walk above already bans the literals)."""
+    import novel_view_synthesis_3d_tpu as pkg
+
+    pkg_root = os.path.dirname(os.path.abspath(pkg.__file__))
+    kinds = ("anomaly", "drain", "brownout", "worker_restart",
+             "swap_recover", "nvs3d_sample_anomalies_total",
+             "nvs3d_worker_restarts_total", "nvs3d_serve_state",
+             "nvs3d_brownout_level", "nvs3d_swap_failures_total")
+    emitters = []
+    for root, _, files in os.walk(pkg_root):
+        if os.path.basename(root) == "obs":
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+            names_kind = imports_csv = False
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in kinds):
+                    names_kind = True
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    mod = getattr(node, "module", None) or ""
+                    if "csv" in [a.name for a in node.names] \
+                            or mod == "csv":
+                        imports_csv = True
+            if names_kind:
+                rel = os.path.relpath(path, pkg_root)
+                emitters.append(rel)
+                assert not imports_csv, (
+                    f"{rel} names survivability events AND imports csv "
+                    "— telemetry writes belong to obs.bus only")
+                assert "obs." in src or "event_cb" in src, (
+                    f"{rel} names survivability events but has no "
+                    "bus-routed event path")
+    # The writer surfaces the DESIGN doc promises actually exist: the
+    # service (quarantine/drain/brownout/supervisor) and the watcher
+    # (swap breaker).
+    assert any(e.endswith(os.path.join("sample", "service.py"))
+               for e in emitters)
+    assert any(e.endswith(os.path.join("registry", "watcher.py"))
+               for e in emitters)
+
+
 # ---------------------------------------------------------------------------
 # Device monitor / MFU
 # ---------------------------------------------------------------------------

@@ -1,0 +1,624 @@
+"""Serving survivability chaos drills (docs/DESIGN.md "Serving
+survivability"): deterministic NVS3D_FI_SERVE_* fault injection driving
+the in-ring anomaly quarantine, the worker supervisor, graceful
+drain/stop, the brownout ladder, the registry swap circuit breaker, and
+the wedged-worker stall diagnosis — all on the 8-virtual-CPU test mesh.
+
+The invariant under every drill: a fault takes down AT MOST its own
+request. Co-riders stay bit-identical to their solo reference, nothing
+non-finite is ever streamed or committed, the program cache never
+recompiles on the anomaly path, and every rejection is STRUCTURED
+(retryable + retry_after_s) so clients can fail over."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import (
+    BrownoutConfig,
+    Config,
+    DiffusionConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+from novel_view_synthesis_3d_tpu.sample.service import (
+    Rejected,
+    SampleAnomaly,
+    SamplingService,
+    request_cond_from_batch,
+)
+from novel_view_synthesis_3d_tpu.utils import faultinject
+from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.smoke]
+
+TINY = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                   attn_resolutions=(8,), dropout=0.0)
+T = 3  # steps per frame: small enough for CPU, enough for mid-flight
+S = 16
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T)
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=4, sidelength=S, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((4,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((4,)), train=False)["params"]
+    conds = [request_cond_from_batch(mb, i) for i in range(4)]
+    return model, params, dcfg, conds
+
+
+def make_service(setup, tmp, **serve_kw):
+    model, params, dcfg, _ = setup
+    kw = dict(scheduler="step", max_batch=4, flush_timeout_ms=5.0,
+              queue_depth=64, k_max=4)
+    kw.update(serve_kw)
+    return SamplingService(model, params, dcfg, ServeConfig(**kw),
+                           results_folder=str(tmp))
+
+
+def traj_cond(cond):
+    return {k: cond[k] for k in ("x", "R1", "t1", "K")}
+
+
+def orbit_for(cond, n):
+    return orbit_poses(n, radius=float(np.linalg.norm(cond["t1"])) or 1.0,
+                       elevation=0.3)
+
+
+def warm(svc, cond, *, seed=990):
+    """One resolved request: compiles the bucket-1 program so dispatch
+    ordinals are deterministic when the drill arms."""
+    svc.submit(cond, seed=seed).result(timeout=300)
+
+
+def events_text(tmp):
+    p = os.path.join(str(tmp), "events.csv")
+    return open(p).read() if os.path.exists(p) else ""
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection helpers are inert when unarmed
+# ---------------------------------------------------------------------------
+def test_serve_fi_inert_when_unset(monkeypatch):
+    for var in ("NVS3D_FI_SERVE_NAN_AT", "NVS3D_FI_SERVE_WORKER_DIE_AT",
+                "NVS3D_FI_SERVE_DISPATCH_RAISE_AT",
+                "NVS3D_FI_SERVE_SWAP_FAIL", "NVS3D_FI_SERVE_SLOW_STEP"):
+        monkeypatch.delenv(var, raising=False)
+    assert faultinject.serve_nan_spec() is None
+    assert faultinject.serve_slow_step_spec() is None
+    faultinject.maybe_serve_worker_die(10 ** 9)
+    faultinject.maybe_serve_dispatch_raise(10 ** 9)
+    faultinject.maybe_serve_swap_fail()
+    assert faultinject.maybe_serve_slow_step(10 ** 9) == 0.0
+
+
+def test_serve_fi_spec_parsing(monkeypatch):
+    monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT", "7:2")
+    assert faultinject.serve_nan_spec() == (7, 2)
+    monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT", "7")
+    assert faultinject.serve_nan_spec() == (7, 0)
+    monkeypatch.setenv("NVS3D_FI_SERVE_SLOW_STEP", "3:0.5")
+    assert faultinject.serve_slow_step_spec() == (3, 0.5)
+    monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT", "bogus")
+    with pytest.raises(ValueError):
+        faultinject.serve_nan_spec()
+    assert "NVS3D_FI_SERVE_NAN_AT" in faultinject.armed()
+
+
+# ---------------------------------------------------------------------------
+# In-ring anomaly quarantine
+# ---------------------------------------------------------------------------
+def test_nan_quarantine_single_shot(setup, tmp_path, monkeypatch):
+    """A latent poisoned mid-flight fails ONLY its own ticket, with a
+    structured retryable SampleAnomaly; the anomaly lands in
+    events.csv and the summary counter."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, anomaly_strikes=1)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT",
+                           f"{svc.dispatches + 2}:0")
+        tk = svc.submit(conds[0], seed=41)
+        with pytest.raises(SampleAnomaly) as ei:
+            tk.result(timeout=300)
+        assert ei.value.retryable
+        assert "non-finite" in str(ei.value)
+        # The service keeps serving: the very same request succeeds on
+        # resubmit (the poison was one-dispatch-exact).
+        img = svc.submit(conds[0], seed=41).result(timeout=300)
+        assert np.isfinite(img).all()
+        assert svc.summary()["anomalies"] == 1
+        ev = events_text(tmp_path)
+        assert "anomaly" in ev and "quarantined" in ev
+    finally:
+        svc.stop()
+
+
+def test_nan_mid_orbit_partial_frames_no_bad_commit(
+        setup, tmp_path, monkeypatch):
+    """NaN injected mid-orbit: the trajectory ticket fails with its
+    COMPLETED frames attached (all finite — the poisoned frame was
+    never streamed, and the bank never committed it)."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, anomaly_strikes=1)
+    try:
+        warm(svc, conds[0])
+        # Frame 0 takes dispatches +1..+T; arm the 2nd step of frame 1
+        # (dispatch +T+2), after frame 0 committed.
+        monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT",
+                           f"{svc.dispatches + T + 2}:0")
+        tk = svc.submit_trajectory(traj_cond(conds[0]),
+                                   poses=orbit_for(conds[0], 3), seed=5)
+        streamed = []
+        with pytest.raises(SampleAnomaly) as ei:
+            for j, img in tk.frames(timeout=300):
+                streamed.append((j, img))
+        exc = ei.value
+        assert exc.retryable
+        assert len(exc.frames) == 1 and exc.frame_index == 1
+        for f in exc.frames:
+            assert f.shape == (S, S, 3) and np.isfinite(f).all()
+        # Whatever reached the stream is exactly the completed prefix.
+        assert [j for j, _ in streamed] == [0]
+        assert all(np.isfinite(i).all() for _, i in streamed)
+        assert "of frame 1/3" in events_text(tmp_path)
+    finally:
+        svc.stop()
+
+
+def test_nan_corider_bit_identical_and_zero_recompiles(
+        setup, tmp_path, monkeypatch):
+    """The quarantine blast radius is ONE row: a single-shot co-rider
+    sharing the ring with the poisoned trajectory returns the same bits
+    as its solo reference, and the anomaly path compiles nothing."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, anomaly_strikes=1,
+                       flush_timeout_ms=300.0)
+    try:
+        # Warm bucket 1 and 2 and take the solo reference.
+        warm(svc, conds[1])
+        svc.submit_trajectory(traj_cond(conds[0]),
+                              poses=orbit_for(conds[0], 1),
+                              seed=7).result(timeout=300)
+        t0 = svc.submit_trajectory(traj_cond(conds[0]),
+                                   poses=orbit_for(conds[0], 2), seed=7)
+        s0 = svc.submit(conds[1], seed=77)
+        s0.result(timeout=300)
+        t0.result(timeout=300)
+        ref = svc.submit(conds[1], seed=77).result(timeout=300)
+        before = svc.compile_counters()
+        # Poison the trajectory row (row 0: first submitted) on the 2nd
+        # shared dispatch; the co-rider must not notice.
+        monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT",
+                           f"{svc.dispatches + 2}:0")
+        traj = svc.submit_trajectory(traj_cond(conds[0]),
+                                     poses=orbit_for(conds[0], 2), seed=7)
+        single = svc.submit(conds[1], seed=77)
+        img = single.result(timeout=300)
+        with pytest.raises(SampleAnomaly):
+            traj.result(timeout=300)
+        np.testing.assert_array_equal(img, ref)
+        after = svc.compile_counters()
+        assert after["programs_built"] == before["programs_built"]
+        assert svc.summary()["anomalies"] == 1
+    finally:
+        svc.stop()
+
+
+def test_anomaly_strike_budget(setup, tmp_path, monkeypatch):
+    """serve.anomaly_strikes > 1 tolerates N-1 flagged steps before
+    evicting; a real NaN persists across steps, so the slot still
+    quarantines once the budget is burned."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, anomaly_strikes=2)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT",
+                           f"{svc.dispatches + 2}:0")
+        tk = svc.submit(conds[0], seed=9)
+        with pytest.raises(SampleAnomaly) as ei:
+            tk.result(timeout=300)
+        assert "strike 2/2" in events_text(tmp_path) or \
+            "non-finite" in str(ei.value)
+        assert svc.summary()["anomalies"] == 1
+    finally:
+        svc.stop()
+
+
+def test_boundary_forces_quarantine_despite_strike_budget(
+        setup, tmp_path, monkeypatch):
+    """A non-finite latent at its LAST step would otherwise resolve into
+    a client-visible image: the boundary overrides any remaining strike
+    budget — nothing non-finite is ever streamed."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, anomaly_strikes=5)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT",
+                           f"{svc.dispatches + T}:0")  # final step
+        tk = svc.submit(conds[0], seed=13)
+        with pytest.raises(SampleAnomaly):
+            tk.result(timeout=300)
+        assert svc.summary()["anomalies"] == 1
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Worker supervisor
+# ---------------------------------------------------------------------------
+def test_worker_die_restart_then_serves(setup, tmp_path, monkeypatch):
+    """A killed worker thread is restarted with backoff; the in-flight
+    ring row fails RETRYABLY (its device PRNG position is gone), and
+    requests queued across the death are served by the new worker."""
+    _, _, _, conds = setup
+    # max_batch=2 bounds the ring: with 4 requests queued, at most 2 can
+    # be in flight when the worker dies — the rest are undispatched BY
+    # CONSTRUCTION and must survive the restart.
+    svc = make_service(setup, tmp_path, worker_backoff_s=0.01,
+                       max_worker_restarts=3, max_batch=2)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_WORKER_DIE_AT",
+                           str(svc.dispatches + 1))
+        tickets = [svc.submit(conds[i], seed=21 + i) for i in range(4)]
+        failed, served = [], []
+        for t in tickets:
+            try:
+                img = t.result(timeout=300)
+            except Rejected as e:
+                assert e.retryable, "mid-flight loss must be retryable"
+                failed.append(t)
+            else:
+                assert np.isfinite(img).all()
+                served.append(t)
+        # The in-flight ring rows (<= max_batch) died retryably; every
+        # undispatched request was served by the restarted worker.
+        assert 1 <= len(failed) <= 2 and len(served) >= 2
+        assert svc.summary()["worker_restarts"] == 1
+        ev = events_text(tmp_path)
+        assert "worker_restart" in ev and "stay queued" in ev
+        # And a resubmit serves clean (the death env was one-shot).
+        svc.submit(conds[0], seed=29).result(timeout=300)
+    finally:
+        svc.stop()
+
+
+def test_worker_restart_budget_exhausted(setup, tmp_path, monkeypatch):
+    """Past serve.max_worker_restarts the supervisor gives up loudly:
+    the service stops, queued tickets fail retryably with the
+    fail-over hint, and new submits are refused."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, worker_backoff_s=0.01,
+                       max_worker_restarts=0)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_WORKER_DIE_AT",
+                           str(svc.dispatches + 1))
+        t1 = svc.submit(conds[0], seed=31)
+        with pytest.raises(Rejected) as ei:
+            t1.result(timeout=300)
+        assert ei.value.retryable
+        deadline = time.monotonic() + 30.0
+        while svc._worker is not None and svc._worker.is_alive() \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        with pytest.raises(Rejected):
+            svc.submit(conds[0], seed=32)
+        assert svc.summary()["worker_restarts"] == 1
+        assert "restart budget" in events_text(tmp_path)
+    finally:
+        svc.stop()
+
+
+def test_dispatch_raise_fails_group_keeps_serving(
+        setup, tmp_path, monkeypatch):
+    """An exception INSIDE the guarded dispatch fails the in-flight
+    group but never kills the worker: the next request serves without
+    a restart."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_DISPATCH_RAISE_AT",
+                           str(svc.dispatches + 1))
+        tk = svc.submit(conds[0], seed=51)
+        with pytest.raises(Exception, match="injected dispatch failure"):
+            tk.result(timeout=300)
+        img = svc.submit(conds[0], seed=52).result(timeout=300)
+        assert np.isfinite(img).all()
+        assert svc.summary()["worker_restarts"] == 0
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain / stop
+# ---------------------------------------------------------------------------
+def test_drain_finishes_in_flight_rejects_new(setup, tmp_path):
+    """begin_drain(): in-flight + queued work completes, new admissions
+    get a structured retryable reject carrying retry_after_s, and
+    drain() returns True with the queue and ring empty."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, drain_timeout_s=60.0)
+    try:
+        warm(svc, conds[0])
+        tk = svc.submit_trajectory(traj_cond(conds[0]),
+                                   poses=orbit_for(conds[0], 3), seed=61)
+        svc.begin_drain(reason="test")
+        with pytest.raises(Rejected) as ei:
+            svc.submit(conds[1], seed=62)
+        assert ei.value.retryable and ei.value.retry_after_s > 0
+        with pytest.raises(Rejected):
+            svc.submit_trajectory(traj_cond(conds[1]),
+                                  poses=orbit_for(conds[1], 2), seed=63)
+        assert svc.drain() is True
+        frames = tk.result(timeout=10)  # finished during the drain
+        assert len(frames) == 3
+        ev = events_text(tmp_path)
+        assert "accepting -> draining" in ev
+        assert "draining -> stopped (clean" in ev
+    finally:
+        if svc._worker is not None:
+            svc.stop()
+
+
+def test_drain_idle_service_immediate(setup, tmp_path):
+    svc = make_service(setup, tmp_path)
+    t0 = time.monotonic()
+    assert svc.drain(timeout_s=30.0) is True
+    assert time.monotonic() - t0 < 15.0
+    with pytest.raises(Rejected):
+        svc.submit({}, seed=0)
+
+
+def test_drain_timeout_fails_leftovers_retryably(
+        setup, tmp_path, monkeypatch):
+    """A drain deadline shorter than the in-flight tail: drain()
+    returns False and the leftover ticket fails RETRYABLY (never
+    silently dropped)."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_SLOW_STEP",
+                           f"{svc.dispatches + 1}:1.5")
+        tk = svc.submit(conds[0], seed=71)
+        time.sleep(0.3)  # worker is now asleep inside the dispatch
+        assert svc.drain(timeout_s=0.2) is False
+        with pytest.raises(Rejected) as ei:
+            tk.result(timeout=30)
+        assert ei.value.retryable
+        assert "TIMEOUT" in events_text(tmp_path)
+    finally:
+        if svc._worker is not None:
+            svc.stop()
+
+
+def test_stop_wedged_worker_writes_stall_diagnosis(
+        setup, tmp_path, monkeypatch):
+    """stop() on a wedged worker must not silently leak the thread: it
+    writes the PR 2 stall-style all-thread-stacks diagnosis and raises."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path)
+    warm(svc, conds[0])
+    monkeypatch.setenv("NVS3D_FI_SERVE_SLOW_STEP",
+                       f"{svc.dispatches + 1}:1.5")
+    svc.submit(conds[0], seed=81)
+    time.sleep(0.3)
+    with pytest.raises(RuntimeError, match="still alive"):
+        svc.stop(timeout=0.2)
+    path = tmp_path / "stall_serve_stop_0.txt"
+    assert path.exists()
+    body = path.read_text()
+    assert "still alive after join timeout" in body
+    assert "Thread" in body or "thread" in body  # the stack dump
+    assert "stall" in events_text(tmp_path)
+    time.sleep(1.6)  # let the injected sleep end, then stop clean
+    svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Brownout ladder
+# ---------------------------------------------------------------------------
+def brownout_service(setup, tmp, **kw):
+    bo = BrownoutConfig(queue_soft=1, queue_hard=2, k_cap=2,
+                        max_frames_cap=2, retry_after_s=0.2)
+    return make_service(setup, tmp, brownout=bo, **kw)
+
+
+def test_brownout_shed_degrade_and_recover(setup, tmp_path, monkeypatch):
+    """Queue depth climbing through the soft then hard thresholds moves
+    the ladder 0 -> 1 (degraded trajectory admission) -> 2 (shed with
+    a retryable reject); pressure falling moves it back to 0."""
+    _, _, _, conds = setup
+    svc = brownout_service(setup, tmp_path)
+    try:
+        warm(svc, conds[0])
+        # Stall the worker so queue depth is deterministic.
+        monkeypatch.setenv("NVS3D_FI_SERVE_SLOW_STEP",
+                           f"{svc.dispatches + 1}:1.2")
+        t1 = svc.submit(conds[0], seed=91)
+        time.sleep(0.3)  # t1 dispatched (queue empty), worker asleep
+        t2 = svc.submit(conds[1], seed=92)       # q=0 at check -> level 0
+        # q=1 >= queue_soft -> level 1: orbit capped to max_frames_cap=2
+        # and bank window to k_cap=2.
+        t3 = svc.submit_trajectory(traj_cond(conds[2]),
+                                   poses=orbit_for(conds[2], 4), seed=93)
+        assert t3.num_frames == 2
+        # q=2 >= queue_hard -> level 2: shed, retryable with the
+        # server-suggested retry_after_s.
+        with pytest.raises(Rejected) as ei:
+            svc.submit(conds[3], seed=94)
+        assert ei.value.retryable
+        assert ei.value.retry_after_s == pytest.approx(0.2)
+        assert svc.summary()["brownout_level"] == 2
+        for t in (t1, t2):
+            assert np.isfinite(t.result(timeout=300)).all()
+        assert len(t3.result(timeout=300)) == 2
+        # Pressure gone: the next admission closes the ladder.
+        svc.submit(conds[0], seed=95).result(timeout=300)
+        assert svc.summary()["brownout_level"] == 0
+        ev = events_text(tmp_path)
+        assert "brownout" in ev and "degraded admission" in ev
+        assert "2 (shedding)" in ev and "0 (serving)" in ev
+    finally:
+        svc.stop()
+
+
+def test_brownout_reject_retries_to_success(setup, tmp_path, monkeypatch):
+    """Satellite (c) end to end: a brownout-shed request resubmitted via
+    cli.submit_with_retry succeeds once the queue drains — the client
+    honors retryable + retry_after_s instead of giving up."""
+    from novel_view_synthesis_3d_tpu.cli import submit_with_retry
+
+    _, _, _, conds = setup
+    svc = brownout_service(setup, tmp_path)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_SLOW_STEP",
+                           f"{svc.dispatches + 1}:0.8")
+        t1 = svc.submit(conds[0], seed=96)
+        time.sleep(0.2)
+        t2 = svc.submit(conds[1], seed=97)
+        t3 = svc.submit(conds[2], seed=98)  # q=2 -> hard from here on
+        sleeps = []
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            time.sleep(min(s, 0.4))
+
+        ticket = submit_with_retry(
+            lambda: svc.submit(conds[3], seed=99), retries=8,
+            sleep=fake_sleep)
+        assert np.isfinite(ticket.result(timeout=300)).all()
+        assert sleeps, "first attempt should have been shed"
+        # Jittered backoff honors the server's retry_after_s=0.2 floor.
+        assert all(s >= 0.2 for s in sleeps)
+        for t in (t1, t2, t3):
+            t.result(timeout=300)
+    finally:
+        svc.stop()
+
+
+def test_submit_with_retry_gives_up_on_nonretryable():
+    from novel_view_synthesis_3d_tpu.cli import submit_with_retry
+
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise Rejected("malformed", retryable=False)
+
+    with pytest.raises(Rejected):
+        submit_with_retry(bad, retries=5, sleep=lambda s: None)
+    assert len(calls) == 1  # non-retryable: no second attempt
+
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise Rejected("loaded", retryable=True, retry_after_s=0.01)
+        return "ok"
+
+    assert submit_with_retry(flaky, retries=5,
+                             sleep=lambda s: None) == "ok"
+    assert len(attempts) == 3
+
+
+# ---------------------------------------------------------------------------
+# Registry swap circuit breaker (satellite b)
+# ---------------------------------------------------------------------------
+class _StubService:
+    """The watcher only needs model_version + swap_params."""
+
+    def __init__(self):
+        self.model_version = "v0"
+        self.swapped = []
+
+    def swap_params(self, params, vid, *, step, timeout):
+        self.swapped.append(vid)
+        self.model_version = vid
+
+
+class _StubStore:
+    def __init__(self, vid="v1"):
+        self.vid = vid
+
+    def read_channel(self, channel):
+        return self.vid
+
+    def verify(self, vid):
+        class M:
+            step = 1
+        return M()
+
+    def load_params(self, vid, verify=False):
+        return {"w": np.zeros(1)}
+
+
+def test_swap_fail_breaker_opens_then_half_open_recovers(monkeypatch):
+    """NVS3D_FI_SERVE_SWAP_FAIL drill: two injected failures open the
+    breaker with doubling backoff; after the backoff the half-open
+    probe retries the SAME version and a clean attempt closes the
+    breaker (swap applied, swap_recover logged)."""
+    from novel_view_synthesis_3d_tpu.registry.watcher import (
+        RegistryWatcher)
+
+    events = []
+    svc, store = _StubService(), _StubStore()
+    w = RegistryWatcher(
+        svc, store, "stable", poll_s=30.0, start=False,
+        breaker_base_s=0.1, event_cb=lambda s, k, d, v="":
+        events.append(k))
+    monkeypatch.setenv("NVS3D_FI_SERVE_SWAP_FAIL", "2")
+    assert w.poll_once() is None
+    assert w.failures == 1 and w.consecutive_failures == 1
+    # Breaker OPEN: an immediate re-poll does not retry (no storm).
+    assert w.poll_once() is None and w.failures == 1
+    time.sleep(0.12)
+    # Half-open probe #1: the second injected failure re-opens with a
+    # doubled backoff.
+    assert w.poll_once() is None
+    assert w.failures == 2 and w.consecutive_failures == 2
+    assert w.poll_once() is None and w.failures == 2  # open again
+    time.sleep(0.25)
+    # Half-open probe #2: the fault budget is spent — clean swap.
+    assert w.poll_once() == "v1"
+    assert svc.model_version == "v1"
+    assert w.consecutive_failures == 0
+    assert events == ["swap_fail", "swap_fail", "swap_recover"]
+
+
+def test_swap_breaker_resets_on_new_version(monkeypatch):
+    """A pointer move to a DIFFERENT version bypasses the open breaker:
+    rollback/roll-forward is always safe and takes the next poll."""
+    from novel_view_synthesis_3d_tpu.registry.watcher import (
+        RegistryWatcher)
+
+    svc, store = _StubService(), _StubStore("bad")
+    w = RegistryWatcher(svc, store, "stable", poll_s=30.0, start=False,
+                        breaker_base_s=60.0)
+    monkeypatch.setenv("NVS3D_FI_SERVE_SWAP_FAIL", "1")
+    assert w.poll_once() is None and w.failures == 1
+    assert w.poll_once() is None  # open for 60s against "bad"
+    store.vid = "good"  # operator rolls the channel
+    assert w.poll_once() == "good"
+    assert svc.model_version == "good" and w.consecutive_failures == 0

@@ -368,6 +368,53 @@ def precision_sweep_lines(rows):
     return lines
 
 
+def chaos_lines(rows):
+    """Per-phase tables for serve_bench --chaos artifacts: each injected
+    fault against the requests it poisoned vs the requests it was NOT
+    allowed to touch, and the fault-phase p99 against the same trace's
+    steady-state — the latency cost of surviving."""
+    lines = []
+    for name, d in rows:
+        chaos = d.get("chaos")
+        if not isinstance(chaos, dict):
+            continue
+        lines += ["", f"## Chaos drills — {name}", ""]
+        tr = chaos.get("trace", {})
+        lines.append(
+            f"- trace: {tr.get('requests_per_phase')} req/phase @ "
+            f"{tr.get('rate_per_s')}/s (target "
+            f"{tr.get('utilization_target')} utilization), mix "
+            f"{tr.get('mix')}, max_batch {tr.get('max_batch')}")
+        lines.append(
+            f"- worst fault-phase p99 {chaos.get('p99_worst_fault_s')}s "
+            f"vs steady {chaos.get('p99_steady_s')}s; anomalies "
+            f"{chaos.get('anomalies_total')}, worker restarts "
+            f"{chaos.get('worker_restarts_total')}, recompiles "
+            f"{chaos.get('programs_built_delta')}")
+        lines += ["",
+                  "| phase | injected | ok | late | expired | rejected "
+                  "| failed | p50 (s) | p99 (s) |",
+                  "|---|---|---|---|---|---|---|---|---|"]
+        for phase in ("steady", "nan", "worker_die", "swap_fail"):
+            p = chaos.get("phases", {}).get(phase)
+            if not p:
+                continue
+            lines.append(
+                "| {} | {} | {} | {} | {} | {} | {} | {} | {} |".format(
+                    phase, p.get("injected", "—"), p.get("ok", 0),
+                    p.get("late", 0), p.get("expired", 0),
+                    p.get("rejected", 0), p.get("failed", 0),
+                    fmt(p.get("p50_s", 0.0)), fmt(p.get("p99_s", 0.0))))
+        sw = chaos.get("phases", {}).get("swap_fail", {})
+        if sw:
+            lines.append(
+                f"- swap breaker: {sw.get('swap_failures')} failure(s) "
+                f"opened it, half-open probe recovered to v2="
+                f"{sw.get('recovered_to_v2')} "
+                f"({sw.get('swaps')} swap(s))")
+    return lines
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     out_dir = args[0] if args else os.path.join("results", "tpu_r04")
@@ -406,6 +453,8 @@ def main() -> int:
     lines += precision_sweep_lines(rows)
     # Ring-native vs naive orbit serving for --trajectory artifacts.
     lines += trajectory_serving_lines(rows)
+    # Survivability drill tables for any --chaos artifacts.
+    lines += chaos_lines(rows)
     # The restored CPU-lane trajectory from the repo-root BENCH archives.
     lines += cpu_lane_lines(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
