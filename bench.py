@@ -54,7 +54,8 @@ def build(preset_name: str, overrides=()):
     from novel_view_synthesis_3d_tpu.diffusion import make_schedule
     from novel_view_synthesis_3d_tpu.models.xunet import XUNet
     from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
-    from novel_view_synthesis_3d_tpu.train.state import create_train_state
+    from novel_view_synthesis_3d_tpu.train.state import (
+        create_train_state, pack_train_state)
     from novel_view_synthesis_3d_tpu.train.step import make_train_step
     from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
 
@@ -90,8 +91,17 @@ def build(preset_name: str, overrides=()):
     schedule = make_schedule(cfg.diffusion)
     model = XUNet(cfg.model)
     state = create_train_state(cfg.train, model, _sample_model_batch(batch))
-    state = mesh_lib.replicate(mesh, state)
-    step = make_train_step(cfg, model, schedule, mesh)
+    if cfg.train.update_sharding == "zero":
+        # ZeRO lane: opt_state/EMA live lane-packed and row-sharded over
+        # 'data' between steps; the step fn gets the packed-layout
+        # shardings so donation and the sharded update line up.
+        state, state_sharding = pack_train_state(cfg.train, mesh, state)
+        state = jax.device_put(state, state_sharding)
+        step = make_train_step(cfg, model, schedule, mesh,
+                               state_sharding=state_sharding)
+    else:
+        state = mesh_lib.replicate(mesh, state)
+        step = make_train_step(cfg, model, schedule, mesh)
     spd = cfg.train.steps_per_dispatch
     if spd > 1:
         # Fused multi-step dispatch: the step fn consumes a (K, B, ...)
@@ -728,6 +738,18 @@ def main():
     # `state`, so its device buffers are deleted after the first call.
     host_params = jax.device_get(state.params)
 
+    # Per-device train-state footprint, measured BEFORE the loop for the
+    # same donation reason. With train.update_sharding=zero the opt/EMA
+    # entries shrink ~1/data_shards vs the replicated layout — this
+    # breakdown is how BENCH_r* rounds see the memory claim.
+    from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+
+    device_bytes = {
+        "params": mesh_lib.tree_device_bytes(state.params),
+        "opt_state": mesh_lib.tree_device_bytes(state.opt_state),
+        "ema_params": mesh_lib.tree_device_bytes(state.ema_params),
+    }
+
     # Telemetry snapshot (obs/): per-phase span percentiles + device
     # memory ride in the judged JSON so BENCH_*.json trajectories carry
     # utilization, not just steps/sec.
@@ -767,6 +789,12 @@ def main():
     # changes need every record to say what the config would deploy.
     result["precision"] = cfg.serve.precision
     result["fused_step"] = cfg.diffusion.fused_step
+    # Update-sharding / pipeline attribution (PR 13): which optimizer
+    # layout ran and how many GPipe stages the mesh carved, plus the
+    # measured per-device state footprint those choices produced.
+    result["update_sharding"] = cfg.train.update_sharding
+    result["pipeline_stages"] = cfg.mesh.stages
+    result["state_device_bytes"] = device_bytes
     if flops:
         # Peak table lives in obs/devmon.py (one home — the trainer's MFU
         # gauge reads the same numbers). Unknown kinds report raw

@@ -287,6 +287,18 @@ class TrainConfig:
     # (parallel/mesh.fsdp_spec). The reference replicates everything per
     # device (train.py:46).
     fsdp: bool = False
+    # Weight-update sharding ('replicated' or 'zero'). 'zero' keeps params
+    # REPLICATED for fwd/bwd (unlike fsdp, no per-layer all-gathers in the
+    # forward) but shards the Adam moments + EMA over the mesh 'data' axis
+    # (parallel/zero.py): gradients reduce-scatter into 1/N shards, the
+    # update runs on each replica's shard, and fresh params all-gather out —
+    # "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    # Training" (Xu et al. 2020). opt_state+EMA device bytes drop to
+    # ~1/data_shards while the step stays numerically identical to
+    # 'replicated'. Requires optimizer='adam' (adafactor's factored second
+    # moments don't survive the flatten/pad shard layout) and fsdp=False
+    # (fsdp already shards the whole state its own way).
+    update_sharding: str = "replicated"
     # Tensor parallelism: shard attention heads + conv/dense output channels
     # over the mesh 'model' axis (parallel/mesh.tp_spec). No-op unless
     # mesh.model > 1. The reference has no TP (SURVEY.md §2.3).
@@ -637,6 +649,14 @@ class MeshConfig:
     data: int = -1  # -1 = all remaining devices
     model: int = 1
     seq: int = 1
+    # Pipeline parallelism: partition the XUNet's block sequence into this
+    # many stages placed along the 'model' axis (parallel/pipeline.py).
+    # stages>1 runs the train.grad_accum_steps microbatches through a
+    # GPipe-style fill/drain schedule with ppermute stage handoff, so the
+    # model's activations (and its stage params inside the step) scale past
+    # one chip. Requires mesh.model == stages and is mutually exclusive
+    # with tensor parallelism / sequence parallelism / fsdp.
+    stages: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -797,6 +817,33 @@ class Config:
                 f"train.optimizer={t.optimizer!r} must be 'adam' "
                 "(reference, train.py:46) or 'adafactor' (memory-lean: "
                 "factored second moments, no first moment)")
+        if t.grad_accum_steps > 1 and t.loss == "frobenius":
+            # Lifted out of train/step.make_train_step: the whole-tensor L2
+            # norm is not decomposable across micro-batches (mean of micro
+            # norms != full-batch norm), so accumulation would silently
+            # change the reference-parity objective. Failing here costs
+            # nothing; failing at step-build time costs the compile.
+            errors.append(
+                f"train.grad_accum_steps={t.grad_accum_steps} > 1 requires "
+                "train.loss='mse' — the 'frobenius' whole-tensor norm has "
+                "no per-micro-batch decomposition")
+        if t.update_sharding not in ("replicated", "zero"):
+            errors.append(
+                f"train.update_sharding={t.update_sharding!r} must be "
+                "'replicated' or 'zero' (ZeRO-style sharded Adam+EMA "
+                "update, parallel/zero.py)")
+        elif t.update_sharding == "zero":
+            if t.optimizer != "adam":
+                errors.append(
+                    "train.update_sharding='zero' requires "
+                    f"train.optimizer='adam' (got {t.optimizer!r}) — the "
+                    "sharded update flattens optimizer moments per leaf, "
+                    "which breaks adafactor's factored row/col stats")
+            if t.fsdp:
+                errors.append(
+                    "train.update_sharding='zero' conflicts with "
+                    "train.fsdp=True: fsdp already shards params + "
+                    "optimizer state over 'data'; pick one")
         if t.adam_mu_dtype not in ("float32", "bfloat16"):
             errors.append(
                 f"train.adam_mu_dtype={t.adam_mu_dtype!r} must be "
@@ -1088,11 +1135,43 @@ class Config:
             errors.append(
                 f"obs.xprof_steps={ob.xprof_steps} must be (start, end) "
                 "with 0 <= start < end, or (0, 0) for off")
-        for axis in ("model", "seq"):
+        for axis in ("model", "seq", "stages"):
             if getattr(self.mesh, axis) < 1:
                 errors.append(f"mesh.{axis} must be >= 1")
         if self.mesh.data == 0 or self.mesh.data < -1:
             errors.append("mesh.data must be -1 (all remaining) or >= 1")
+        if self.mesh.stages > 1:
+            # Pipeline stages ride the 'model' axis (parallel/pipeline.py):
+            # one stage per model-shard, so the axis size must match, and
+            # the other uses of that axis (TP) — or of shard_map-managed
+            # model partitioning (sequence parallel, fsdp) — can't coexist
+            # with the stage placement.
+            if self.mesh.model != self.mesh.stages:
+                errors.append(
+                    f"mesh.stages={self.mesh.stages} requires mesh.model="
+                    f"{self.mesh.stages} (stages are placed one per "
+                    f"'model' shard; got mesh.model={self.mesh.model})")
+            if t.tp:
+                errors.append(
+                    "mesh.stages > 1 conflicts with train.tp=True — both "
+                    "claim the 'model' axis")
+            if t.fsdp:
+                errors.append(
+                    "mesh.stages > 1 conflicts with train.fsdp=True — the "
+                    "pipelined step passes stage-sliced params through "
+                    "shard_map and cannot compose with data-axis param "
+                    "sharding (use train.update_sharding='zero' for the "
+                    "optimizer-state memory win instead)")
+            if m.sequence_parallel:
+                errors.append(
+                    "mesh.stages > 1 conflicts with "
+                    "model.sequence_parallel=True — ring attention's "
+                    "shard_map cannot nest inside the pipeline stage "
+                    "shard_map")
+            if self.mesh.seq != 1:
+                errors.append(
+                    f"mesh.stages={self.mesh.stages} requires mesh.seq=1 "
+                    f"(got {self.mesh.seq})")
         if errors:
             raise ValueError("invalid config:\n  - " + "\n  - ".join(errors))
         return self

@@ -215,19 +215,84 @@ def precompute_pose_embs(model: "XUNet", params, cond: dict,
     return tuple(pose_embs)
 
 
+def pipeline_op_specs(cfg: ModelConfig):
+    """Static, ordered op list for the XUNet — the pipeline partition unit.
+
+    Each entry is (kind, info) where `kind` selects a body in
+    XUNet.__call__ and `info` carries the static metadata INCLUDING the
+    explicit flax module name. Names replicate the per-class auto-counter
+    flax would have assigned in the monolithic call order, so:
+      - the param tree is IDENTICAL to pre-refactor checkpoints, and
+      - a stage-sliced execution (ops=(a, b)) creates modules under the
+        SAME paths as the full run — which also makes flax's per-path
+        dropout-rng folding identical under pipeline execution.
+    `param_names` lists the top-level param-tree keys the op owns, so the
+    pipeline planner can slice per-stage param subtrees exactly.
+    """
+    counters: dict = {}
+
+    def nm(cls: str) -> str:
+        i = counters.get(cls, 0)
+        counters[cls] = i + 1
+        return f"{cls}_{i}"
+
+    num_resolutions = len(cfg.ch_mult)
+    specs = []
+    cond, stem = nm("ConditioningProcessor"), nm("FrameConv")
+    specs.append(("prelude", dict(cond=cond, stem=stem,
+                                  param_names=(cond, stem))))
+    for i_level in range(num_resolutions):
+        for _ in range(cfg.num_res_blocks):
+            name = nm("XUNetBlock")
+            specs.append(("down_block", dict(
+                level=i_level, features=cfg.ch * cfg.ch_mult[i_level],
+                name=name, param_names=(name,))))
+        if i_level != num_resolutions - 1:
+            name = nm("ResnetBlock")
+            specs.append(("down_trans", dict(level=i_level, name=name,
+                                             param_names=(name,))))
+    name = nm("XUNetBlock")
+    specs.append(("middle", dict(features=cfg.ch * cfg.ch_mult[-1],
+                                 name=name, param_names=(name,))))
+    for i_level in reversed(range(num_resolutions)):
+        for _ in range(cfg.num_res_blocks + 1):
+            name = nm("XUNetBlock")
+            specs.append(("up_block", dict(
+                level=i_level, features=cfg.ch * cfg.ch_mult[i_level],
+                name=name, param_names=(name,))))
+        if i_level != 0:
+            name = nm("ResnetBlock")
+            specs.append(("up_trans", dict(level=i_level, name=name,
+                                           param_names=(name,))))
+    gn, out = nm("GroupNorm"), nm("FrameConv")
+    specs.append(("final", dict(gn=gn, out=out, param_names=(gn, out))))
+    return specs
+
+
 class XUNet(nn.Module):
     """The X-UNet (reference model/xunet.py:205-280), config-driven.
 
     `mesh` activates sequence-parallel ring attention when
     config.sequence_parallel is set (tokens sharded over the mesh 'seq'
     axis; parallel/ring_attention.py).
+
+    The body is an ordered list of ops (pipeline_op_specs): the default
+    call runs all of them — numerically and param-tree identical to the
+    monolithic forward — while `ops=(a, b)` runs the half-open slice
+    [a, b) for pipeline-stage execution (parallel/pipeline.py): a slice
+    starting at 0 consumes `batch`/`cond_mask` and later slices consume
+    `carry` (the (h, skip-stack, logsnr_emb, pose_embs) state); a slice
+    ending before the last op returns the carry instead of the output.
+    `batch` is still required for ops>0 slices — only its SHAPES are used
+    (e.g. the output-channel count), never its values.
     """
 
     config: ModelConfig = ModelConfig()
     mesh: object = None
 
     @nn.compact
-    def __call__(self, batch: dict, *, cond_mask: jnp.ndarray, train: bool) -> jnp.ndarray:
+    def __call__(self, batch: dict, *, cond_mask: jnp.ndarray = None,
+                 train: bool, ops=None, carry=None) -> jnp.ndarray:
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         param_dtype = jnp.dtype(cfg.param_dtype)
@@ -235,29 +300,14 @@ class XUNet(nn.Module):
         fused_gn = resolve_fused_gn(cfg.use_fused_groupnorm)
         blk_kw = dict(per_frame_gn=cfg.groupnorm_per_frame,
                       fused_gn=fused_gn, **kw)
-
-        z = batch["z"]
-        B, H, W, C = z.shape
         num_resolutions = len(cfg.ch_mult)
-
-        logsnr_emb, pose_embs = ConditioningProcessor(
-            emb_ch=cfg.emb_ch,
-            num_resolutions=num_resolutions,
-            use_pos_emb=cfg.use_pos_emb,
-            use_ref_pose_emb=cfg.use_ref_pose_emb,
-            **kw,
-        )(batch, cond_mask)
-        del cond_mask
-
-        def level_emb(i_level):
-            # (B, 1, 1, 1, emb) + (B, F, H/2ˡ, W/2ˡ, emb), broadcast add.
-            return logsnr_emb[:, None, None, None, :] + pose_embs[i_level]
+        C = batch["z"].shape[-1]
 
         # `train` is threaded as a module attribute (static by construction)
         # so the blocks can be remat'd without static-argnum plumbing.
         Block = _remat_block(cfg.remat)
 
-        def block(features, use_attn, h, emb, train):
+        def block(features, use_attn, h, emb, train, name):
             return Block(
                 features=features,
                 use_attn=use_attn,
@@ -267,55 +317,81 @@ class XUNet(nn.Module):
                 attn_mesh=(self.mesh if cfg.sequence_parallel else None),
                 dropout=cfg.dropout,
                 train=train,
+                name=name,
                 **blk_kw,
             )(h, emb)
 
-        # Frame stacking: cond frames first, noised target LAST.
-        x = batch["x"]
-        if x.ndim == 4:  # (B,H,W,3) → (B,1,H,W,3)
-            x = x[:, None]
-        h = jnp.concatenate([x, z[:, None]], axis=1).astype(dtype)
-        h = FrameConv(cfg.ch, **kw)(h)
+        def run_op(kind, info, state):
+            if kind == "prelude":
+                logsnr_emb, pose_embs = ConditioningProcessor(
+                    emb_ch=cfg.emb_ch,
+                    num_resolutions=num_resolutions,
+                    use_pos_emb=cfg.use_pos_emb,
+                    use_ref_pose_emb=cfg.use_ref_pose_emb,
+                    name=info["cond"],
+                    **kw,
+                )(batch, cond_mask)
+                # Frame stacking: cond frames first, noised target LAST.
+                x = batch["x"]
+                if x.ndim == 4:  # (B,H,W,3) → (B,1,H,W,3)
+                    x = x[:, None]
+                h = jnp.concatenate([x, batch["z"][:, None]],
+                                    axis=1).astype(dtype)
+                h = FrameConv(cfg.ch, name=info["stem"], **kw)(h)
+                return (h, (h,), logsnr_emb, tuple(pose_embs))
 
-        # Down path.
-        hs = [h]
-        for i_level in range(num_resolutions):
-            emb = level_emb(i_level)
-            for _ in range(cfg.num_res_blocks):
+            h, hs, logsnr_emb, pose_embs = state
+
+            def level_emb(i_level):
+                # (B, 1, 1, 1, emb) + (B, F, H/2ˡ, W/2ˡ, emb) broadcast add.
+                return logsnr_emb[:, None, None, None, :] + pose_embs[i_level]
+
+            if kind == "down_block":
                 use_attn = h.shape[3] in cfg.attn_resolutions
-                h = block(cfg.ch * cfg.ch_mult[i_level], use_attn, h, emb, train)
-                hs.append(h)
-            if i_level != num_resolutions - 1:
+                h = block(info["features"], use_attn, h,
+                          level_emb(info["level"]), train, info["name"])
+                return (h, hs + (h,), logsnr_emb, pose_embs)
+            if kind == "down_trans":
                 # Strided transition conditioned with the NEXT level's pose
                 # embedding (reference xunet.py:243-246).
-                emb = level_emb(i_level + 1)
                 h = ResnetBlock(dropout=cfg.dropout, resample="down",
-                                **blk_kw)(h, emb, train=train)
-                hs.append(h)
-
-        # Middle (bottleneck features = ch·ch_mult[-1], ref xunet.py:248-255).
-        emb = level_emb(num_resolutions - 1)
-        use_attn = h.shape[3] in cfg.attn_resolutions
-        h = block(cfg.ch * cfg.ch_mult[-1], use_attn, h, emb, train)
-
-        # Up path: num_res_blocks+1 blocks per level, skip-concat each.
-        for i_level in reversed(range(num_resolutions)):
-            emb = level_emb(i_level)
-            for _ in range(cfg.num_res_blocks + 1):
+                                name=info["name"], **blk_kw)(
+                    h, level_emb(info["level"] + 1), train=train)
+                return (h, hs + (h,), logsnr_emb, pose_embs)
+            if kind == "middle":
+                # Bottleneck features = ch·ch_mult[-1], ref xunet.py:248-255.
+                use_attn = h.shape[3] in cfg.attn_resolutions
+                h = block(info["features"], use_attn, h,
+                          level_emb(num_resolutions - 1), train,
+                          info["name"])
+                return (h, hs, logsnr_emb, pose_embs)
+            if kind == "up_block":
+                # Skip-concat then block (num_res_blocks+1 per level).
                 use_attn = hs[-1].shape[3] in cfg.attn_resolutions
-                h = jnp.concatenate([h, hs.pop()], axis=-1)
-                h = block(cfg.ch * cfg.ch_mult[i_level], use_attn, h, emb, train)
-            if i_level != 0:
-                # Upsample transition conditioned with the FINER level's pose
-                # embedding (reference xunet.py:269-271).
-                emb = level_emb(i_level - 1)
+                h = jnp.concatenate([h, hs[-1]], axis=-1)
+                h = block(info["features"], use_attn, h,
+                          level_emb(info["level"]), train, info["name"])
+                return (h, hs[:-1], logsnr_emb, pose_embs)
+            if kind == "up_trans":
+                # Upsample transition conditioned with the FINER level's
+                # pose embedding (reference xunet.py:269-271).
                 h = ResnetBlock(dropout=cfg.dropout, resample="up",
-                                **blk_kw)(h, emb, train=train)
+                                name=info["name"], **blk_kw)(
+                    h, level_emb(info["level"] - 1), train=train)
+                return (h, hs, logsnr_emb, pose_embs)
+            assert kind == "final", kind
+            assert not hs
+            h = GroupNorm(per_frame=cfg.groupnorm_per_frame, act="swish",
+                          fused=fused_gn, dtype=dtype, name=info["gn"])(h)
+            # Zero-init output conv in float32 for stable noise predictions.
+            out = FrameConv(C, zero_init=True, dtype=jnp.float32,
+                            param_dtype=param_dtype, name=info["out"])(
+                h.astype(jnp.float32))
+            return out[:, -1]
 
-        assert not hs
-        h = GroupNorm(per_frame=cfg.groupnorm_per_frame, act="swish",
-                      fused=fused_gn, dtype=dtype)(h)
-        # Zero-init output conv in float32 for stable noise predictions.
-        out = FrameConv(C, zero_init=True, dtype=jnp.float32,
-                        param_dtype=param_dtype)(h.astype(jnp.float32))
-        return out[:, -1]
+        specs = pipeline_op_specs(cfg)
+        a, b = (0, len(specs)) if ops is None else ops
+        state = carry
+        for kind, info in specs[a:b]:
+            state = run_op(kind, info, state)
+        return state

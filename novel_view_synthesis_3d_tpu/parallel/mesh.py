@@ -313,3 +313,28 @@ def validate_global_batch(mesh: Mesh, global_batch_size: int) -> None:
         raise ValueError(
             f"global batch {global_batch_size} not divisible by data-axis "
             f"size {n}")
+
+
+def tree_device_bytes(tree) -> int:
+    """Per-device bytes of a pytree's leaves (0 for an empty/None tree).
+
+    Sharded leaves count their LOCAL shard shape (leaf.sharding), so the
+    same params tree reports full bytes when replicated and 1/N when ZeRO-
+    or FSDP-sharded — this feeds the nvs3d_*_bytes gauges and the bench
+    memory breakdown, where "what actually sits on one chip" is the
+    number that decides whether a config fits."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                shape = sharding.shard_shape(tuple(shape))
+            except (TypeError, ValueError):
+                pass
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+        total += int(np.prod(shape or (1,))) * itemsize
+    return total

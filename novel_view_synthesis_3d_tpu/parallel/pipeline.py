@@ -1,0 +1,332 @@
+"""GPipe pipeline parallelism for the XUNet over the mesh 'model' axis.
+
+`mesh.stages = S > 1` partitions the XUNet's ordered op list
+(models/xunet.py `pipeline_op_specs`) into S contiguous stages, one per
+'model'-axis shard, and streams the `train.grad_accum_steps` micro-batches
+through a fill/drain schedule (Huang et al. 2019, GPipe — PAPERS.md):
+
+      tick t:   stage s runs micro-batch m = t - s   (valid for 0 <= m < M)
+                then hands its boundary activations to stage s+1 via
+                jax.lax.ppermute — one ICI neighbor hop, no all-to-all.
+
+  T = M + S - 1 ticks total; (S-1)/T of stage-ticks are fill/drain bubble
+  (`bubble_fraction`). Each device runs ONLY its stage's ops on one
+  micro-batch of activations at a time — the live-activation footprint
+  per device drops to one stage slice of one micro-batch, which is what
+  lets the training step grow past one chip's activation memory.
+
+Mechanics (all inside one shard_map over ('model', 'data')):
+
+  - Params enter replicated (in_spec P()) — matching the repo's
+    replicated-params training layout (update sharding is ZeRO's job,
+    parallel/zero.py) — and each stage's switch branch touches only its
+    own op range's param subtree (`pipeline_op_specs` names). What the
+    pipeline shards is the ACTIVATION footprint: each device holds one
+    stage × one micro-batch of activations instead of the whole net.
+    The replicated feed keeps reverse-mode AD trivial: the transpose is
+    a psum over ('model', 'data') that assembles the full gradient tree
+    with no hand-written collectives. (A per-stage packed param stack
+    with in_spec P('model', None) is the memory-leaner layout, but jit's
+    sharding propagation mis-partitions the pack→shard_map handoff on
+    this jax version — values produced INSIDE the jit that feed a
+    'model'-split in_spec come out wrong, while the identical array
+    passed as a jit argument works. Revisit when jax is bumped.)
+  - Boundary activations (h, skip stack, logsnr_emb, pose_embs) are
+    flattened to one padded f32 vector per boundary — a single static
+    carry shape lets every stage run the same lax.scan program. Shapes
+    per boundary come from jax.eval_shape of the prefix slice at trace
+    time; nothing is shape-polymorphic at runtime.
+  - lax.switch on axis_index('model') picks the stage body; idle
+    (fill/drain) ticks run the stage on zeros — every op is finite on
+    zeros, and the last stage masks invalid outputs to 0 so idle compute
+    contributes exactly zero cotangent.
+  - The diffusion micro-batch DERIVATION (t, noise, z, cond_mask, …)
+    also runs inside the shard_map, via the `derive_local` callback:
+    every shard redraws the full-batch randoms from the replicated step
+    key and slices its own global row block — bit-identical to the
+    sequential path's global draws, at the cost of a B-sized (instead of
+    B/D-sized) PRNG draw per shard, which is noise-tensor sized and
+    negligible next to one XUNet stage. This is the second partitioner
+    workaround: on this jax version, jax.random draws whose consumers
+    are 'data'-sharded come out with WRONG VALUES on meshes with a
+    nontrivial 'model' axis (the key is identical; the generated bits
+    are not) — inside shard_map each shard compiles single-device code
+    and the bug cannot trigger. Revisit when jax is bumped.
+  - Predictions stay inside: the region returns per-micro-batch LOCAL
+    mean losses, out-sharded P(None, 'data') as an (M, data) grid; the
+    caller's global mean equals the sequential path's loss because micro
+    slices and data shards are all equal-sized.
+
+The dropout key for micro-batch m is shared by all stages; flax folds it
+per module path, and `pipeline_op_specs` pins explicit module names, so a
+stage slice draws the SAME masks as the monolithic forward — pipelined
+training is numerically the accumulation path up to f32 reduction order
+(tests/test_pipeline.py asserts equivalence for S in {2, 4}). Note the
+row→micro-batch grouping differs from the sequential path (each data
+shard splits its OWN rows into M micros); per-row t/noise/cond_mask pairs
+are identical, and with equal-sized micros the mean-of-means is the same
+global mean, so loss and grads still agree.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from novel_view_synthesis_3d_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+# Keys of the model-input slice of a training micro-batch (train/step.py
+# builds micro dicts with these + cond_mask + regression_target [+
+# loss_weight]; only these and cond_mask enter model.apply).
+MODEL_KEYS = ("x", "z", "logsnr", "R1", "t1", "R2", "t2", "K")
+
+
+def stage_bounds(num_ops: int, stages: int) -> List[int]:
+    """Contiguous op partition: S+1 boundaries, every stage non-empty.
+
+    Even op-count split (first `num_ops % stages` stages take one extra).
+    Deterministic in (num_ops, stages) alone so every host and every
+    trace agrees on the partition without coordination."""
+    if stages < 1:
+        raise ValueError(f"stages must be >= 1, got {stages}")
+    if num_ops < stages:
+        raise ValueError(
+            f"cannot split {num_ops} XUNet ops into {stages} pipeline "
+            "stages — reduce mesh.stages (each stage needs >= 1 op)")
+    base, rem = divmod(num_ops, stages)
+    bounds = [0]
+    for s in range(stages):
+        bounds.append(bounds[-1] + base + (1 if s < rem else 0))
+    return bounds
+
+
+def bubble_fraction(num_micro: int, stages: int) -> float:
+    """Fill/drain bubble share of the GPipe schedule: (S-1)/(M+S-1).
+
+    Static in config — exported to obs gauges and the bench JSON so a
+    too-coarse micro-batch split is visible before it burns a pod-day."""
+    return (stages - 1) / max(1, num_micro + stages - 1)
+
+
+def _tree_size(aval_tree) -> int:
+    return sum(int(np.prod(a.shape or (1,)))
+               for a in jax.tree_util.tree_leaves(aval_tree))
+
+
+def _flatten_pad(tree, length: int) -> jnp.ndarray:
+    """Pytree → one zero-padded f32 vector (linear, AD-transparent)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    flat = jnp.concatenate(
+        [jnp.ravel(x).astype(jnp.float32) for x in leaves]
+    ) if leaves else jnp.zeros((0,), jnp.float32)
+    return jnp.pad(flat, (0, length - flat.shape[0]))
+
+
+def _unflatten(vec: jnp.ndarray, aval_tree):
+    """Padded f32 vector → pytree with the aval tree's shapes/dtypes."""
+    leaves, treedef = jax.tree_util.tree_flatten(aval_tree)
+    out, off = [], 0
+    for a in leaves:
+        size = int(np.prod(a.shape or (1,)))
+        out.append(vec[off:off + size].reshape(a.shape).astype(a.dtype))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _aval_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+        tree)
+
+
+def _stage_param_names(specs, bounds: Sequence[int]) -> List[Tuple[str, ...]]:
+    names = []
+    for s in range(len(bounds) - 1):
+        ns: List[str] = []
+        for _, info in specs[bounds[s]:bounds[s + 1]]:
+            ns.extend(info["param_names"])
+        names.append(tuple(ns))
+    return names
+
+
+def value_and_grad_pipelined(model, mesh: Mesh, stages: int, params,
+                             batch, rng, micro_steps, derive_local,
+                             micro_loss_of):
+    """(mean loss over micro-batches, full param-tree grads), pipelined.
+
+    model      XUNet whose __call__ honors ops=(a, b) slices.
+    mesh       mesh with shape['model'] == stages.
+    batch      raw training batch pytree, batch axis 0 sharded over 'data'
+               (parallel.mesh.shard_batch layout).
+    rng        step-folded PRNG key, replicated.
+    micro_steps  M, the number of micro-batches per shard.
+    derive_local  (local_batch, rng, data_index) -> (micro, keys); runs
+               INSIDE the shard_map on one data shard's rows. micro is a
+               pytree of (M, b_local, ...) arrays (MODEL_KEYS + cond_mask
+               + regression_target [+ loss_weight]); keys is (M, 2)
+               uint32 dropout keys. Must draw randoms full-batch from the
+               replicated key and slice rows [d*B_l, (d+1)*B_l) so every
+               row sees the sequential path's values (see module note on
+               the partitioner bug).
+    micro_loss_of  (pred, micro_batch_slice) -> scalar micro loss.
+
+    Numerically equivalent to the sequential accumulation scan in
+    train/step.py (same per-row t/noise/cond_mask, equal-size micro
+    means) up to f32 reduction order.
+    """
+    if mesh.shape[MODEL_AXIS] != stages:
+        raise ValueError(
+            f"pipeline stages={stages} needs mesh 'model' axis of the same "
+            f"size, got {mesh.shape[MODEL_AXIS]}")
+
+    # Differentiate the whole (derive ∘ forward ∘ loss) composite wrt
+    # params: the shard_map body and the ppermute handoffs are
+    # AD-transparent, so one value_and_grad yields the full-tree gradient.
+    def loss_of(p):
+        losses = _pipelined_losses(model, mesh, stages, p, batch, rng,
+                                   micro_steps, derive_local, micro_loss_of)
+        return jnp.mean(losses)
+
+    return jax.value_and_grad(loss_of)(params)
+
+
+def _pipelined_losses(model, mesh: Mesh, stages: int, params, batch, rng,
+                      micro_steps, derive_local, micro_loss_of):
+    """Run M micro-batches through S stages; returns (M, data) per-micro
+    local mean losses (data axis sharded over 'data')."""
+    from novel_view_synthesis_3d_tpu.models.xunet import pipeline_op_specs
+    from novel_view_synthesis_3d_tpu.parallel.ring_attention import (
+        _shard_map)
+
+    S = stages
+    M = int(micro_steps)
+    T = M + S - 1
+    specs = pipeline_op_specs(model.config)
+    bounds = stage_bounds(len(specs), S)
+    stage_names = _stage_param_names(specs, bounds)
+
+    data_n = mesh.shape[DATA_AXIS]
+    B = batch["target"].shape[0]
+    if B % (data_n * M) != 0:
+        raise ValueError(
+            f"global batch {B} not divisible by data axis x micro steps "
+            f"({data_n} x {M})")
+    b_shard = B // data_n        # rows per data shard
+    b_local = b_shard // M       # rows per (data shard, micro-batch)
+
+    # --- trace-time geometry ------------------------------------------------
+    # Derive the micro avals by eval_shape'ing the caller's derivation on
+    # one data shard's row block — no FLOPs, and geometry stays in sync
+    # with whatever fields the caller derives.
+    local_batch_aval = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct((b_shard,) + a.shape[1:], a.dtype),
+        batch)
+    micro_aval, keys_aval = jax.eval_shape(
+        derive_local, local_batch_aval, _aval_tree(rng),
+        jax.ShapeDtypeStruct((), jnp.int32))
+    mb_aval = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+        {k: micro_aval[k] for k in MODEL_KEYS})
+    cm_aval = jax.ShapeDtypeStruct((b_local,), micro_aval["cond_mask"].dtype)
+    key_aval = jax.ShapeDtypeStruct(tuple(keys_aval.shape[1:]),
+                                    keys_aval.dtype)
+    param_avals = _aval_tree(params)
+
+    def _prefix(p, mb, cm, k, upto):
+        return model.apply({"params": p}, mb, cond_mask=cm, train=True,
+                           ops=(0, upto), rngs={"dropout": k})
+
+    # Boundary activation avals: carry entering stage s is the output of
+    # the prefix slice [0, bounds[s]).  eval_shape costs no FLOPs.
+    boundary_avals = [
+        jax.eval_shape(partial(_prefix, upto=bounds[s]),
+                       param_avals, mb_aval, cm_aval, key_aval)
+        for s in range(1, S)
+    ]
+    Lc = max(_tree_size(av) for av in boundary_avals)
+
+    pred_shape = (b_local,) + tuple(micro_aval["z"].shape[2:])
+
+    def body(p_full, local_batch, rng_in):
+        s_idx = jax.lax.axis_index(MODEL_AXIS)
+        micro_local, keys_local = derive_local(
+            local_batch, rng_in, jax.lax.axis_index(DATA_AXIS))
+
+        def pick_micro(m):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, m, 0,
+                                                       keepdims=False),
+                micro_local)
+
+        def make_branch(s):
+            a, b = bounds[s], bounds[s + 1]
+            sub = {n: p_full[n] for n in stage_names[s]}
+
+            def branch(vec_in, t):
+                m = jnp.clip(t - s, 0, M - 1)
+                valid = ((t >= s) & (t - s < M)).astype(jnp.float32)
+                mb = pick_micro(m)
+                key = jax.lax.dynamic_index_in_dim(keys_local, m, 0,
+                                                   keepdims=False)
+                # Inside shard_map the dropout mask is drawn PER data
+                # shard (the global-mask GSPMD semantics of the scan path
+                # don't apply); folding the shard index in keeps masks
+                # decorrelated across 'data'. Consequence: pipelined runs
+                # match the sequential path bit-for-bit only at
+                # dropout=0 — with dropout on they are statistically,
+                # not numerically, equivalent.
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(DATA_AXIS))
+                model_mb = {k: mb[k] for k in MODEL_KEYS}
+                carry = (None if s == 0
+                         else _unflatten(vec_in, boundary_avals[s - 1]))
+                out = model.apply({"params": sub}, model_mb,
+                                  cond_mask=mb["cond_mask"], train=True,
+                                  ops=(a, b), carry=carry,
+                                  rngs={"dropout": key})
+                if s == S - 1:
+                    # Final slice returns the prediction; idle ticks are
+                    # masked to exact zeros so fill/drain compute carries
+                    # zero cotangent.
+                    pred = out.astype(jnp.float32) * valid
+                    return jnp.zeros((Lc,), jnp.float32), pred
+                return _flatten_pad(out, Lc), jnp.zeros(pred_shape,
+                                                        jnp.float32)
+
+            return branch
+
+        branches = [make_branch(s) for s in range(S)]
+
+        def tick(vec, t):
+            vec_out, pred = jax.lax.switch(s_idx, branches, vec, t)
+            # Stage s's tick-t output reaches stage s+1 for tick t+1; the
+            # last stage sends nothing, stage 0 receives zeros (ignored).
+            vec_out = jax.lax.ppermute(
+                vec_out, MODEL_AXIS,
+                perm=[(i, i + 1) for i in range(S - 1)])
+            return vec_out, pred
+
+        _, preds = jax.lax.scan(tick, jnp.zeros((Lc,), jnp.float32),
+                                jnp.arange(T))
+        # Only the last stage's rows are nonzero; psum replicates them
+        # across 'model' so every shard computes the same local losses.
+        preds = jax.lax.psum(preds, MODEL_AXIS)
+        # Micro-batch m finishes the last stage at tick m + S - 1.
+        preds = preds[S - 1:S - 1 + M]
+        losses = jax.vmap(micro_loss_of)(preds, micro_local)
+        return losses.reshape(M, 1)
+
+    batch_specs = jax.tree_util.tree_map(
+        lambda a: P(DATA_AXIS), batch)
+    param_specs = jax.tree_util.tree_map(lambda a: P(), params)
+    out = _shard_map(
+        body, mesh,
+        in_specs=(param_specs, batch_specs, P()),
+        out_specs=P(None, DATA_AXIS),
+    )(params, batch, rng)
+    return out

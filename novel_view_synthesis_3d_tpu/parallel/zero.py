@@ -1,0 +1,238 @@
+"""ZeRO-style sharded weight update over the mesh 'data' axis.
+
+`train.update_sharding='zero'` keeps params REPLICATED for forward/backward
+(no per-layer all-gathers, unlike `train.fsdp`) but stores the Adam moments
+and the EMA as 1/N shards per data replica — the layout of "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training" (Xu et
+al. 2020, PAPERS.md). The step becomes:
+
+      grads (replicated after XLA's DP all-reduce)
+        │  shard_map over 'data': each replica slices row i of a
+        ▼  lane-padded (N, c) view — XLA's reduce-scatter pass folds the
+      grad shard (c,)        all-reduce + slice into one reduce-scatter
+        │  Adam + EMA on the local 1/N shard (elementwise, so the shard
+        ▼  update is bitwise the slice of the replicated update)
+      param shard (c,)
+        │  all_gather(tiled) over 'data'
+        ▼
+      fresh params (replicated again for the next fwd/bwd)
+
+Leaf layout ("lane-friendly flatten/pad"): each float leaf with >=
+`min_elems` elements is flattened, zero-padded to N·c with c a multiple of
+128 (the TPU lane width, so every shard is a whole number of vregs), and
+viewed as (N, c) sharded PartitionSpec('data', None). Small leaves (biases,
+norm scales, scalar counts) stay replicated — sharding them costs more in
+collective latency than the bytes saved. Padding lanes hold zeros and stay
+zero under Adam (zero grad + zero moments → zero update), so they never
+leak into real values.
+
+The packed representation is what lives in TrainState.opt_state /
+ema_params between steps (and is donated). Checkpoints stay in the
+canonical UNPACKED layout — the Trainer gathers on save and re-packs on
+restore — so a run can resume under a different update_sharding setting
+bit-identically (tests/test_zero_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from novel_view_synthesis_3d_tpu.parallel.mesh import DATA_AXIS
+
+# TPU vector lane width; shard rows padded to a multiple of this so each
+# replica's slice is contiguous whole vregs (see /opt/skills/guides —
+# min f32 tile is (8, 128)).
+LANE = 128
+
+# Leaves below this element count stay replicated. Matches the spirit of
+# mesh.fsdp_spec's min_elems but lower: the packed layout can shard ANY
+# large-enough leaf (no divisibility constraint), and the per-leaf cost is
+# one slice + one all-gather row, so the break-even is earlier.
+MIN_ELEMS = 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafPlan:
+    """Static packing geometry for one pytree leaf.
+
+    NOT a registered pytree node on purpose: a plan tree built with
+    jax.tree.map(..., tree) has LeafPlan leaves and can be zipped against
+    the data tree in later jax.tree.map calls.
+    """
+
+    packed: bool
+    shape: Tuple[int, ...]
+    dtype: Any
+    rows: int  # data-axis shards N
+    cols: int  # padded per-shard length c (multiple of LANE)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape or (1,)))
+
+
+def build_plan(tree: Any, num_shards: int, min_elems: int = MIN_ELEMS):
+    """Per-leaf packing plan for `tree` (arrays OR ShapeDtypeStructs).
+
+    Deterministic in (shape, dtype, num_shards) only, so plans built from a
+    live tree, from jax.eval_shape, or on a different host always agree —
+    the property the checkpoint round-trip and the in-step re-derivation
+    both rely on.
+    """
+
+    def mk(x) -> LeafPlan:
+        shape = tuple(x.shape)
+        dtype = np.dtype(x.dtype)
+        size = int(np.prod(shape or (1,)))
+        if (num_shards > 1 and size >= min_elems
+                and np.issubdtype(dtype, np.floating)):
+            cols = -(-size // num_shards)          # ceil division
+            cols = -(-cols // LANE) * LANE         # round up to lane width
+            return LeafPlan(True, shape, dtype, num_shards, cols)
+        return LeafPlan(False, shape, dtype, num_shards, 0)
+
+    return jax.tree.map(mk, tree)
+
+
+def _pack_leaf(x: jnp.ndarray, lp: LeafPlan) -> jnp.ndarray:
+    flat = jnp.ravel(x)
+    flat = jnp.pad(flat, (0, lp.rows * lp.cols - flat.size))
+    return flat.reshape(lp.rows, lp.cols)
+
+
+def _unpack_leaf(x: jnp.ndarray, lp: LeafPlan) -> jnp.ndarray:
+    return x.reshape(-1)[: lp.size].reshape(lp.shape)
+
+
+def pack(tree: Any, plan: Any) -> Any:
+    """Canonical layout → packed (N, c) layout (planned leaves only)."""
+    return jax.tree.map(
+        lambda x, lp: _pack_leaf(x, lp) if lp.packed else x, tree, plan)
+
+
+def unpack(tree: Any, plan: Any) -> Any:
+    """Packed (N, c) layout → canonical layout (shapes from the plan)."""
+    return jax.tree.map(
+        lambda x, lp: _unpack_leaf(x, lp) if lp.packed else x, tree, plan)
+
+
+def packed_shardings(mesh: Mesh, plan: Any) -> Any:
+    """NamedSharding tree for a PACKED tree: row-sharded over 'data'."""
+    return jax.tree.map(
+        lambda lp: NamedSharding(mesh, P(DATA_AXIS, None) if lp.packed
+                                 else P()), plan)
+
+
+def opt_state_template(tx: optax.GradientTransformation, params: Any) -> Any:
+    """Canonical (unpacked) opt-state structure as ShapeDtypeStructs.
+
+    Used wherever the packed opt_state's original leaf shapes are needed
+    but only params are at hand (checkpoint templates, in-step plan
+    re-derivation)."""
+    return jax.eval_shape(tx.init, params)
+
+
+def state_plans(tx: optax.GradientTransformation, params: Any,
+                has_ema: bool, num_shards: int) -> dict:
+    """Plans for the three shardable TrainState trees.
+
+    The EMA mirrors params (same shapes/dtypes — train/state.py creates it
+    as jnp.copy(params)), so its plan equals the params-shaped plan."""
+    pplan = build_plan(params, num_shards)
+    return {
+        "opt_state": build_plan(opt_state_template(tx, params), num_shards),
+        "ema_params": pplan if has_ema else None,
+    }
+
+
+def sharded_update(mesh: Mesh, tx: optax.GradientTransformation,
+                   grads: Any, params: Any, opt_state: Any,
+                   ema_params: Optional[Any], ema_decay: float):
+    """One ZeRO update: (replicated grads/params, PACKED opt/ema) →
+    (replicated new params, PACKED new opt/ema).
+
+    `tx` must be shard-local-safe (elementwise — make_optimizer(...,
+    shard_local=True) swaps the global-norm clip for identity; the caller
+    applies the clip on the full gradient before this). `opt_state` /
+    `ema_params` are in the packed layout; plans are re-derived here from
+    the params avals, which is exact because build_plan is deterministic
+    in shapes alone.
+    """
+    n = mesh.shape[DATA_AXIS]
+    pplan = build_plan(params, n)
+    oplan = build_plan(opt_state_template(tx, params), n)
+    opt_specs = jax.tree.map(
+        lambda lp: P(DATA_AXIS, None) if lp.packed else P(), oplan)
+    param_specs = jax.tree.map(lambda lp: P(), pplan)
+    has_ema = ema_params is not None
+
+    def shard_of(x, lp, idx):
+        if not lp.packed:
+            return x
+        return jax.lax.dynamic_slice_in_dim(
+            _pack_leaf(x, lp), idx, 1, axis=0)[0]
+
+    def local_row(x, lp):
+        # A packed leaf arrives as this replica's (1, c) row under
+        # in_spec P('data', None); drop the row axis for elementwise math.
+        return x[0] if lp.packed else x
+
+    def to_row(x, lp):
+        return x[None] if lp.packed else x
+
+    def body(g_full, p_full, opt_loc, *maybe_ema):
+        idx = jax.lax.axis_index(DATA_AXIS)
+        g_sh = jax.tree.map(lambda x, lp: shard_of(x, lp, idx),
+                            g_full, pplan)
+        p_sh = jax.tree.map(lambda x, lp: shard_of(x, lp, idx),
+                            p_full, pplan)
+        opt_sh = jax.tree.map(local_row, opt_loc, oplan)
+        updates, new_opt = tx.update(g_sh, opt_sh, p_sh)
+        new_p_sh = optax.apply_updates(p_sh, updates)
+
+        outs = []
+        if has_ema:
+            ema_sh = jax.tree.map(local_row, maybe_ema[0], pplan)
+            new_ema = jax.tree.map(
+                lambda e, p: e * ema_decay + p.astype(e.dtype)
+                * (1.0 - ema_decay),
+                ema_sh, new_p_sh)
+            outs = [jax.tree.map(to_row, new_ema, pplan)]
+
+        def gather(p_new, lp):
+            if not lp.packed:
+                return p_new
+            flat = jax.lax.all_gather(p_new, DATA_AXIS, tiled=True)
+            return _unpack_leaf(flat, lp)
+
+        new_p_full = jax.tree.map(gather, new_p_sh, pplan)
+        return (new_p_full, jax.tree.map(to_row, new_opt, oplan), *outs)
+
+    from novel_view_synthesis_3d_tpu.parallel.ring_attention import \
+        _shard_map
+
+    in_specs = [param_specs, param_specs, opt_specs]
+    out_specs = [param_specs, opt_specs]
+    args = [grads, params, opt_state]
+    if has_ema:
+        ema_specs = jax.tree.map(
+            lambda lp: P(DATA_AXIS, None) if lp.packed else P(), pplan)
+        in_specs.append(ema_specs)
+        out_specs.append(ema_specs)
+        args.append(ema_params)
+
+    fn = _shard_map(body, mesh, in_specs=tuple(in_specs),
+                    out_specs=tuple(out_specs))
+    out = fn(*args)
+    if has_ema:
+        new_params, new_opt, new_ema = out
+    else:
+        (new_params, new_opt), new_ema = out, None
+    return new_params, new_opt, new_ema

@@ -59,7 +59,8 @@ def make_lr_schedule(cfg: TrainConfig):
     raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
 
 
-def make_optimizer(cfg: TrainConfig, return_schedule: bool = False):
+def make_optimizer(cfg: TrainConfig, return_schedule: bool = False,
+                   shard_local: bool = False):
     """Optimizer chain per config; with return_schedule=True also returns
     the EXACT lr schedule handed to optax, so callers logging lr can never
     drift from what the optimizer applies.
@@ -70,11 +71,21 @@ def make_optimizer(cfg: TrainConfig, return_schedule: bool = False):
     from 2x param bytes (Adam f32 mu+nu; 5.3G for the 708M-param paper256
     model) to ~sqrt-sized row/col stats, the difference between paper256
     fitting a 16G v5e with margin and scraping the ceiling.
+
+    `shard_local=True` (the ZeRO update path, parallel/zero.py) builds the
+    chain that runs INSIDE shard_map on each replica's 1/N shard: the
+    global-norm clip is replaced by optax.identity() — a shard-local norm
+    would be wrong, so the caller clips the full gradient before entering
+    the sharded region. identity's state is EmptyState(), exactly like
+    clip_by_global_norm's, so the opt_state TREEDEF is identical across
+    both variants and checkpoints move freely between update_sharding
+    settings.
     """
     schedule = make_lr_schedule(cfg)
     parts = []
     if cfg.grad_clip > 0:
-        parts.append(optax.clip_by_global_norm(cfg.grad_clip))
+        parts.append(optax.identity() if shard_local
+                     else optax.clip_by_global_norm(cfg.grad_clip))
     if cfg.optimizer == "adam":
         parts.append(optax.adam(
             schedule, mu_dtype=jnp.dtype(cfg.adam_mu_dtype)))
@@ -172,3 +183,76 @@ def create_train_state(cfg: TrainConfig, model, sample_batch: dict,
         with jax.default_device(jax.devices("cpu")[0]):
             return build_state()
     return build_state()
+
+
+# ---------------------------------------------------------------------------
+# ZeRO (train.update_sharding='zero') state layout
+# ---------------------------------------------------------------------------
+# Between steps the TrainState carries opt_state/ema_params in the packed
+# (N, c) row-sharded layout of parallel/zero.py; params stay replicated.
+# Checkpoints and the registry/probe always see the canonical UNPACKED
+# layout — pack/unpack live here so every boundary (trainer init, save,
+# restore, publish) converts the same way.
+
+def _zero_plans(cfg: TrainConfig, params: Any, has_ema: bool, n: int):
+    from novel_view_synthesis_3d_tpu.parallel import zero as zero_lib
+
+    tx = make_optimizer(cfg, shard_local=True)
+    return zero_lib.state_plans(tx, params, has_ema, n)
+
+
+def pack_train_state(cfg: TrainConfig, mesh, state: TrainState):
+    """Canonical state → (packed state, matching per-leaf sharding tree).
+
+    The sharding tree mirrors the PACKED state leaf-for-leaf (packed
+    opt/EMA rows over 'data', everything else replicated) so it can feed
+    both jax.device_put and the train step's in/out_shardings."""
+    import jax.sharding as js
+
+    from novel_view_synthesis_3d_tpu.parallel import zero as zero_lib
+
+    n = mesh.shape["data"]
+    plans = _zero_plans(cfg, state.params, state.ema_params is not None, n)
+    packed = state.replace(
+        opt_state=zero_lib.pack(state.opt_state, plans["opt_state"]),
+        ema_params=(zero_lib.pack(state.ema_params, plans["ema_params"])
+                    if state.ema_params is not None else None))
+    repl = js.NamedSharding(mesh, js.PartitionSpec())
+    shardings = packed.replace(
+        step=repl,
+        params=jax.tree.map(lambda _: repl, state.params),
+        opt_state=zero_lib.packed_shardings(mesh, plans["opt_state"]),
+        rng=repl,
+        ema_params=(zero_lib.packed_shardings(mesh, plans["ema_params"])
+                    if state.ema_params is not None else None),
+        guard=(jax.tree.map(lambda _: repl, state.guard)
+               if state.guard is not None else None))
+    return packed, shardings
+
+
+def unpack_train_state(cfg: TrainConfig, mesh, packed: TrainState
+                       ) -> TrainState:
+    """Packed state → canonical layout (leaf shapes re-derived from the
+    params avals; works on device or host-numpy leaves alike)."""
+    from novel_view_synthesis_3d_tpu.parallel import zero as zero_lib
+
+    n = mesh.shape["data"]
+    plans = _zero_plans(cfg, packed.params, packed.ema_params is not None, n)
+    return packed.replace(
+        opt_state=zero_lib.unpack(packed.opt_state, plans["opt_state"]),
+        ema_params=(zero_lib.unpack(packed.ema_params, plans["ema_params"])
+                    if packed.ema_params is not None else None))
+
+
+def unpack_ema(cfg: TrainConfig, mesh, params: Any, ema_packed: Any):
+    """Gather a ZeRO-packed EMA tree back to canonical leaves.
+
+    The registry publisher and the sampling probes call this ONCE per
+    publish/probe — the shard gather stays off the train-step hot loop.
+    Works on device or host-numpy leaves alike (parallel/zero.py unpack
+    is pure reshape/slice)."""
+    from novel_view_synthesis_3d_tpu.parallel import zero as zero_lib
+
+    n = mesh.shape["data"]
+    plans = _zero_plans(cfg, params, True, n)
+    return zero_lib.unpack(ema_packed, plans["ema_params"])

@@ -30,6 +30,8 @@ import optax
 from novel_view_synthesis_3d_tpu.config import Config
 from novel_view_synthesis_3d_tpu.diffusion.schedules import DiffusionSchedule
 from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+from novel_view_synthesis_3d_tpu.parallel import zero as zero_lib
+from novel_view_synthesis_3d_tpu.parallel.pipeline import MODEL_KEYS
 from novel_view_synthesis_3d_tpu.train import guard as guard_lib
 from novel_view_synthesis_3d_tpu.train.state import TrainState, make_optimizer
 from novel_view_synthesis_3d_tpu.utils import faultinject
@@ -114,34 +116,55 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
     data_shards = mesh_lib.num_data_shards(mesh)
     accum = effective_accum_steps(tcfg.batch_size, data_shards,
                                   tcfg.grad_accum_steps)
-    if accum > 1 and tcfg.loss == "frobenius":
-        # The whole-tensor L2 norm is not decomposable across micro-batches
-        # (mean of micro norms ≠ full-batch norm), so accumulation would
-        # silently change the reference-parity objective.
-        raise ValueError("grad_accum_steps > 1 requires loss='mse'")
+    # (grad_accum_steps > 1 with loss='frobenius' is rejected by
+    # Config.validate() at startup — the whole-tensor norm has no
+    # per-micro-batch decomposition.)
     if tcfg.loss_weighting not in ("none", "min_snr"):
         raise ValueError(
             f"unknown loss_weighting {tcfg.loss_weighting!r}")
     if tcfg.loss_weighting != "none" and tcfg.loss != "mse":
         raise ValueError("loss_weighting requires loss='mse'")
-    tx, lr_schedule = make_optimizer(tcfg, return_schedule=True)
+    # Composable update sharding (train.update_sharding): 'zero' runs the
+    # Adam+EMA update on 1/data_shards shards (parallel/zero.py). Its inner
+    # chain swaps the global-norm clip for identity (a shard-local norm
+    # would be wrong); the clip then runs here on the FULL gradient before
+    # the sharded region — same math, same order as the replicated chain.
+    zero = tcfg.update_sharding == "zero"
+    stages = config.mesh.stages
+    tx, lr_schedule = make_optimizer(tcfg, return_schedule=True,
+                                     shard_local=zero)
+    full_clip = (optax.clip_by_global_norm(tcfg.grad_clip)
+                 if zero and tcfg.grad_clip > 0 else None)
+    if stages > 1:
+        from novel_view_synthesis_3d_tpu.parallel import (
+            pipeline as pipeline_lib)
     # Fault injection (utils/faultinject.py): read at TRACE time — a clean
     # build compiles no injection ops at all.
     fi_nan_steps = faultinject.nan_loss_steps()
 
-    def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
-        step_rng = jax.random.fold_in(state.rng, state.step)
-        k_t, k_noise, k_mask, k_dropout = jax.random.split(step_rng, 4)
+    def derive_fields(batch, k_t, k_noise, k_mask, B, rows):
+        """Diffusion training fields for `rows` of a B-row batch.
 
+        Randoms (t, noise, cond_mask) are drawn FULL-batch from the given
+        keys and then sliced to `rows` — so the per-row values are the
+        same no matter which shard computes them, which is what lets the
+        pipeline path rerun this inside its shard_map (parallel/pipeline.py
+        explains why it must). `rows=None` keeps the whole batch.
+        """
         target = batch["target"]
-        B = target.shape[0]
         t = jax.random.randint(k_t, (B,), 0, schedule.num_timesteps)
-        noise = jax.random.normal(k_noise, target.shape, dtype=target.dtype)
-        z = schedule.q_sample(target, t, noise)
-        logsnr = schedule.logsnr(t)
+        noise = jax.random.normal(
+            k_noise, (B,) + target.shape[1:], dtype=target.dtype)
         cond_mask = (
             jax.random.uniform(k_mask, (B,)) >= tcfg.cond_drop_prob
         ).astype(jnp.float32)
+        if rows is not None:
+            n = target.shape[0]
+            t = jax.lax.dynamic_slice_in_dim(t, rows, n)
+            noise = jax.lax.dynamic_slice_in_dim(noise, rows, n)
+            cond_mask = jax.lax.dynamic_slice_in_dim(cond_mask, rows, n)
+        z = schedule.q_sample(target, t, noise)
+        logsnr = schedule.logsnr(t)
 
         model_batch = {
             "x": batch["x"],
@@ -163,26 +186,61 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         else:  # 'v'
             regression_target = schedule.v_from_eps_x0(t, noise, target)
 
+        full = dict(model_batch, cond_mask=cond_mask,
+                    regression_target=regression_target)
         if tcfg.loss_weighting == "min_snr":
             acp = jnp.take(schedule.alphas_cumprod, t, axis=0)
             snr = acp / (1.0 - acp)
-            loss_weight = min_snr_weight(snr, tcfg.min_snr_gamma, objective)
-        else:
-            loss_weight = None
+            full["loss_weight"] = min_snr_weight(
+                snr, tcfg.min_snr_gamma, objective)
+        return full
+
+    def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        k_t, k_noise, k_mask, k_dropout = jax.random.split(step_rng, 4)
+
+        target = batch["target"]
+        B = target.shape[0]
+
+        if stages > 1:
+            # Pipeline-staged forward/backward (parallel/pipeline.py):
+            # same per-row t/noise/cond_mask and dropout keys as the
+            # accumulation path below, but the micro-batches stream
+            # through S model stages in a GPipe fill/drain schedule
+            # instead of a sequential scan — equivalent loss/grads up to
+            # f32 reduction order (tests/test_pipeline.py). The field
+            # derivation reruns inside the shard_map, per data shard;
+            # see parallel/pipeline.py for why it cannot stay out here.
+            def derive_local(local_batch, rng, data_index):
+                k_t_, k_noise_, k_mask_, k_drop_ = jax.random.split(rng, 4)
+                rows = data_index * local_batch["target"].shape[0]
+                full = derive_fields(local_batch, k_t_, k_noise_, k_mask_,
+                                     B, rows)
+                micro = jax.tree.map(
+                    lambda a: a.reshape((accum, a.shape[0] // accum)
+                                        + a.shape[1:]), full)
+                return micro, jax.random.split(k_drop_, accum)
+
+            def micro_loss_of(pred, mb):
+                return compute_loss(pred, mb["regression_target"],
+                                    tcfg.loss, weight=mb.get("loss_weight"))
+
+            loss, grads = pipeline_lib.value_and_grad_pipelined(
+                model, mesh, stages, state.params, batch, step_rng,
+                accum, derive_local, micro_loss_of)
+            return finish_step(state, loss, grads)
+
+        full = derive_fields(batch, k_t, k_noise, k_mask, B, None)
 
         def micro_loss(params, mb):
             pred = model.apply(
                 {"params": params},
-                {k: mb[k] for k in model_batch},
+                {k: mb[k] for k in MODEL_KEYS},
                 cond_mask=mb["cond_mask"], train=True,
                 rngs={"dropout": mb["dropout_key"]})
             return compute_loss(pred, mb["regression_target"], tcfg.loss,
                                 weight=mb.get("loss_weight"))
 
-        full = dict(model_batch, cond_mask=cond_mask,
-                    regression_target=regression_target)
-        if loss_weight is not None:
-            full["loss_weight"] = loss_weight
         if accum == 1:
             loss, grads = jax.value_and_grad(micro_loss)(
                 state.params, dict(full, dropout_key=k_dropout))
@@ -212,6 +270,12 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
             grads = jax.tree.map(
                 lambda g, p: (g / accum).astype(p.dtype),
                 grads, state.params)
+        return finish_step(state, loss, grads)
+
+    def finish_step(state: TrainState, loss, grads):
+        """Everything after the forward/backward: fault injection, clip,
+        (possibly ZeRO-sharded) update, anomaly guard, metrics. Shared by
+        the sequential and pipeline-staged paths."""
         if fi_nan_steps:
             # Injected fault: poison loss AND gradients at the armed steps,
             # exactly what a numerically-blown forward/backward produces.
@@ -225,6 +289,17 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         grad_norm = optax.global_norm(grads)
 
         def apply_update(_):
+            if zero:
+                # ZeRO path: clip on the full gradient (exactly what the
+                # replicated chain's first link does), then the sharded
+                # Adam+EMA update — state.opt_state/ema_params are in the
+                # packed (N, c) layout (parallel/zero.py).
+                g = grads
+                if full_clip is not None:
+                    g, _ = full_clip.update(g, full_clip.init(None))
+                return zero_lib.sharded_update(
+                    mesh, tx, g, state.params, state.opt_state,
+                    state.ema_params, tcfg.ema_decay)
             updates, opt_state = tx.update(grads, state.opt_state,
                                            state.params)
             params = optax.apply_updates(state.params, updates)

@@ -34,12 +34,21 @@ from novel_view_synthesis_3d_tpu.diffusion.schedules import (
 )
 from novel_view_synthesis_3d_tpu.models.xunet import XUNet
 from novel_view_synthesis_3d_tpu.parallel import dist, mesh as mesh_lib
+from novel_view_synthesis_3d_tpu.parallel import pipeline as pipeline_lib
 from novel_view_synthesis_3d_tpu.sample.ddpm import make_sampler
 from novel_view_synthesis_3d_tpu.train.checkpoint import CheckpointManager
 from novel_view_synthesis_3d_tpu.train.guard import init_guard_state
 from novel_view_synthesis_3d_tpu.train.metrics import MetricsLogger
-from novel_view_synthesis_3d_tpu.train.state import create_train_state
-from novel_view_synthesis_3d_tpu.train.step import make_train_step
+from novel_view_synthesis_3d_tpu.train.state import (
+    create_train_state,
+    pack_train_state,
+    unpack_ema,
+    unpack_train_state,
+)
+from novel_view_synthesis_3d_tpu.train.step import (
+    effective_accum_steps,
+    make_train_step,
+)
 from novel_view_synthesis_3d_tpu.utils import faultinject, watchdog
 from novel_view_synthesis_3d_tpu.utils.images import save_image_grid
 from novel_view_synthesis_3d_tpu.utils.profiling import (
@@ -311,8 +320,19 @@ class Trainer:
         self._cond_sens_fn = None  # lazily-built jitted probe (eval_step)
         self.state = create_train_state(
             tcfg, self.model, _sample_model_batch(first_batch))
-        self._state_sharding = mesh_lib.state_shardings(
-            self.mesh, self.state, tcfg.fsdp, tp=tcfg.tp)
+        # ZeRO update sharding (train.update_sharding='zero'): between
+        # steps the state carries opt_state/EMA in the packed row-sharded
+        # layout of parallel/zero.py — 1/data_shards of those bytes per
+        # device. Every host boundary (checkpoint save/restore, registry
+        # publish, probes) converts through pack/unpack below so the rest
+        # of the trainer only ever sees the canonical layout.
+        self._zero = tcfg.update_sharding == "zero"
+        if self._zero:
+            self.state, self._state_sharding = pack_train_state(
+                tcfg, self.mesh, self.state)
+        else:
+            self._state_sharding = mesh_lib.state_shardings(
+                self.mesh, self.state, tcfg.fsdp, tp=tcfg.tp)
         self.state = jax.device_put(self.state, self._state_sharding)
         self.train_step = make_train_step(
             config, self.model, self.schedule, self.mesh,
@@ -359,6 +379,34 @@ class Trainer:
         self._gauge_mfu = reg.gauge(
             "nvs3d_mfu", "model-FLOPs utilization of the train step")
         self._gauge_loss = reg.gauge("nvs3d_loss", "last logged train loss")
+        # Static memory/topology gauges: set once at init. The *_bytes
+        # gauges report PER-DEVICE bytes (local shard shapes), so a ZeRO
+        # run shows opt/EMA at ~1/data_shards of the replicated numbers —
+        # the measured half of the ISSUE's memory claim, also asserted in
+        # tests/test_zero.py.
+        self._gauge_params_bytes = reg.gauge(
+            "nvs3d_params_bytes", "per-device bytes of the param tree")
+        self._gauge_opt_state_bytes = reg.gauge(
+            "nvs3d_opt_state_bytes",
+            "per-device bytes of the optimizer state")
+        self._gauge_ema_bytes = reg.gauge(
+            "nvs3d_ema_bytes", "per-device bytes of the EMA tree")
+        self._gauge_pipeline_bubble = reg.gauge(
+            "nvs3d_pipeline_bubble_frac",
+            "GPipe fill/drain bubble fraction of the pipelined step")
+        self._gauge_params_bytes.set(
+            float(mesh_lib.tree_device_bytes(self.state.params)))
+        self._gauge_opt_state_bytes.set(
+            float(mesh_lib.tree_device_bytes(self.state.opt_state)))
+        self._gauge_ema_bytes.set(
+            float(mesh_lib.tree_device_bytes(self.state.ema_params)))
+        stages = config.mesh.stages
+        self._gauge_pipeline_bubble.set(
+            pipeline_lib.bubble_fraction(
+                effective_accum_steps(
+                    tcfg.batch_size, mesh_lib.num_data_shards(self.mesh),
+                    tcfg.grad_accum_steps), stages)
+            if stages > 1 else 0.0)
         # One-time FLOPs estimate for MFU (obs.cost_analysis): filled at
         # the first dispatch via train_step.lower(...).cost_analysis().
         self._flops_per_step: Optional[float] = None
@@ -552,9 +600,17 @@ class Trainer:
         rides in ema_params (StandardSave/Restore handle mixed
         device/numpy leaves), so the checkpoint format is identical to a
         device-EMA run's."""
+        state = self.state
+        if self._zero:
+            # Gather-on-save: checkpoints always hold the CANONICAL
+            # layout, so a run can resume under either update_sharding
+            # setting (tests/test_zero.py round-trips both ways). The
+            # device_get is the same full-state fetch Orbax would do.
+            state = unpack_train_state(
+                self.config.train, self.mesh, jax.device_get(state))
         if self._host_ema is None:
-            return self.state
-        return self.state.replace(ema_params=self._host_ema)
+            return state
+        return state.replace(ema_params=self._host_ema)
 
     def _adopt_restored_state(self, restored):
         """Install a checkpoint-restored TrainState (resume or rollback):
@@ -575,6 +631,10 @@ class Trainer:
         owned = jax.tree.map(
             lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a,
             restored)
+        if self._zero:
+            # Checkpoints are canonical (gather-on-save above); re-pack
+            # into the row-sharded between-steps layout before device_put.
+            owned, _ = pack_train_state(self.config.train, self.mesh, owned)
         self.state = jax.device_put(owned, self._state_sharding)
         self._host_ema_step = int(jax.device_get(restored.step))
         return restored
@@ -986,15 +1046,22 @@ class Trainer:
             if jax.process_index() != 0:
                 return None
             return self._host_ema
-        tree = (self.state.ema_params
-                if use_ema and self.state.ema_params is not None
-                else self.state.params)
+        device_ema = use_ema and self.state.ema_params is not None
+        tree = (self.state.ema_params if device_ema else self.state.params)
         if jax.process_count() > 1:
             tree = mesh_lib.replicate(self.mesh, tree)
             jax.block_until_ready(tree)
             if jax.process_index() != 0:
                 return None
-        return jax.tree.map(np.asarray, jax.device_get(tree))
+        host = jax.tree.map(np.asarray, jax.device_get(tree))
+        if device_ema and self._zero:
+            # The device EMA rides in the packed 1/N row-sharded layout;
+            # gather it back to canonical leaves exactly once per publish,
+            # off the step loop (tests/test_zero.py asserts the published
+            # tree hashes identical to a replicated run's).
+            host = unpack_ema(self.config.train, self.mesh,
+                              self.state.params, host)
+        return host
 
     def _probe_host_params(self):
         """Sampling params for the in-loop probes, pod-safe.
@@ -1025,6 +1092,21 @@ class Trainer:
             return jax.device_put(tree, jax.local_devices()[0])
         params = (self.state.ema_params if self.state.ema_params is not None
                   else self.state.params)
+        if self._zero and self.state.ema_params is not None:
+            # Packed EMA → canonical, one gather per probe (the sampler
+            # can't consume (N, c) rows); then pin on one local device
+            # like the pod path below.
+            packed = self.state.ema_params
+            if jax.process_count() > 1:
+                packed = mesh_lib.replicate(self.mesh, packed)
+                jax.block_until_ready(packed)
+                if jax.process_index() != 0:
+                    return None
+            host = unpack_ema(self.config.train, self.mesh,
+                              self.state.params, jax.device_get(packed))
+            if pd:
+                host = jax.tree.map(lambda a: np.asarray(a, pd), host)
+            return jax.device_put(host, jax.local_devices()[0])
         if jax.process_count() == 1:
             if pd and pd != self.config.model.param_dtype:
                 return jax.tree.map(lambda a: jnp.asarray(a, pd), params)

@@ -424,6 +424,53 @@ def test_survivability_event_writers_route_through_bus():
                for e in emitters)
 
 
+def test_memory_and_bubble_gauges_route_through_bus():
+    """The sharded-update / pipeline gauges (PR 13: per-device bytes of
+    params/opt_state/EMA, GPipe bubble fraction) are NEW writer surfaces
+    — every module naming one of the gauge names must route through a
+    MetricsRegistry wired to obs (no private csv path, no direct
+    telemetry-file literal — the walk above already bans those)."""
+    import novel_view_synthesis_3d_tpu as pkg
+
+    pkg_root = os.path.dirname(os.path.abspath(pkg.__file__))
+    names = ("nvs3d_params_bytes", "nvs3d_opt_state_bytes",
+             "nvs3d_ema_bytes", "nvs3d_pipeline_bubble_frac")
+    emitters = []
+    for root, _, files in os.walk(pkg_root):
+        if os.path.basename(root) == "obs":
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+            names_gauge = imports_csv = False
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in names):
+                    names_gauge = True
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    mod = getattr(node, "module", None) or ""
+                    if "csv" in [a.name for a in node.names] \
+                            or mod == "csv":
+                        imports_csv = True
+            if names_gauge:
+                rel = os.path.relpath(path, pkg_root)
+                emitters.append(rel)
+                assert not imports_csv, (
+                    f"{rel} names memory/bubble gauges AND imports csv — "
+                    "telemetry writes belong to obs.bus only")
+                assert "telemetry" in src or "obs." in src, (
+                    f"{rel} names memory/bubble gauges but has no "
+                    "bus-routed registry path")
+    # The trainer sets these once at init (they are static per run).
+    assert any(e.endswith(os.path.join("train", "trainer.py"))
+               for e in emitters)
+
+
 # ---------------------------------------------------------------------------
 # Device monitor / MFU
 # ---------------------------------------------------------------------------

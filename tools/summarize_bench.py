@@ -249,27 +249,34 @@ def cpu_lane_lines(repo_root: str):
                          parsed.get("metric"), parsed.get("value"),
                          parsed.get("vs_baseline"),
                          parsed.get("precision", "-"),
-                         parsed.get("fused_step", "-")))
+                         parsed.get("fused_step", "-"),
+                         parsed.get("update_sharding", "-"),
+                         parsed.get("pipeline_stages", "-")))
             good.append((name, lane, parsed.get("metric"),
                          parsed.get("value")))
         else:
             rows.append((name, d.get("rc"), "-",
-                         "(no parsed datapoint)", None, None, "-", "-"))
+                         "(no parsed datapoint)", None, None, "-", "-",
+                         "-", "-"))
             skipped.append((name, f"rc={d.get('rc')}, no parsed "
                                   "datapoint"))
     if not rows:
         return []
-    # precision / fused_step columns (PR 8): the trajectory must record
-    # what was measured — a bf16+fused number next to an f32 one is a
-    # different deployment, not a regression/improvement of the same.
+    # precision / fused_step columns (PR 8) and update-sharding / stage
+    # columns (PR 13): the trajectory must record what was measured — a
+    # bf16+fused or zero-sharded number next to an f32/replicated one is
+    # a different deployment, not a regression/improvement of the same.
     lines += ["| round | rc | lane | metric | value | vs_baseline | "
-              "precision | fused_step |",
-              "|---|---|---|---|---|---|---|---|"]
-    for name, rc, lane, metric, value, vsb, prec, fused in rows:
-        lines.append("| {} | {} | {} | {} | {} | {} | {} | {} |".format(
-            name, rc, lane, metric,
-            fmt(value) if value is not None else "null",
-            fmt(vsb) if vsb is not None else "", prec, fused))
+              "precision | fused_step | sharding | stages |",
+              "|---|---|---|---|---|---|---|---|---|---|"]
+    for (name, rc, lane, metric, value, vsb, prec, fused, shard,
+         stages) in rows:
+        lines.append(
+            "| {} | {} | {} | {} | {} | {} | {} | {} | {} | {} |".format(
+                name, rc, lane, metric,
+                fmt(value) if value is not None else "null",
+                fmt(vsb) if vsb is not None else "", prec, fused, shard,
+                stages))
     lines.append("")
     if good:
         by_lane = {}
@@ -368,6 +375,35 @@ def precision_sweep_lines(rows):
     return lines
 
 
+def state_memory_lines(rows):
+    """Per-device train-state footprint from the judged train-bench
+    records (bench.py `state_device_bytes`): params / opt_state / EMA in
+    MB next to the sharding mode that produced them. With
+    train.update_sharding=zero, opt+EMA should read ~1/data_shards of
+    the replicated lane's numbers — this table is where BENCH_r* rounds
+    check the memory claim without a device profiler."""
+    lines = []
+    body = []
+    for name, d in rows:
+        sb = d.get("state_device_bytes")
+        if not isinstance(sb, dict):
+            continue
+        mb = {k: sb.get(k, 0) / 1e6 for k in
+              ("params", "opt_state", "ema_params")}
+        body.append(
+            "| {} | {} | {} | {:.1f} | {:.1f} | {:.1f} | {:.1f} |".format(
+                name, d.get("update_sharding", "?"),
+                d.get("pipeline_stages", "?"), mb["params"],
+                mb["opt_state"], mb["ema_params"],
+                mb["params"] + mb["opt_state"] + mb["ema_params"]))
+    if body:
+        lines += ["", "## Train-state device memory (MB/device)", "",
+                  "| entry | sharding | stages | params | opt_state | "
+                  "ema | total |",
+                  "|---|---|---|---|---|---|---|"] + body
+    return lines
+
+
 def chaos_lines(rows):
     """Per-phase tables for serve_bench --chaos artifacts: each injected
     fault against the requests it poisoned vs the requests it was NOT
@@ -421,20 +457,27 @@ def main() -> int:
     lines = [
         f"# Bench summary — {out_dir}", "",
         "| entry | metric | value | unit | vs_baseline | platform | mfu "
-        "| precision | fused_step |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "| precision | fused_step | sharding | stages |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     rows = load_rows(out_dir)
     for name, d in rows:
         lines.append(
-            "| {} | {} | {} | {} | {} | {} | {} | {} | {} |".format(
+            "| {} | {} | {} | {} | {} | {} | {} | {} | {} | {} | {} |"
+            .format(
                 name, d.get("metric", "?"), fmt(d.get("value", "?")),
                 d.get("unit", ""), fmt(d.get("vs_baseline", "")),
                 d.get("platform", "?"),
                 fmt(d.get("mfu", "")) if d.get("mfu") else "",
-                d.get("precision", ""), d.get("fused_step", "")))
+                d.get("precision", ""), d.get("fused_step", ""),
+                d.get("update_sharding", ""),
+                d.get("pipeline_stages", "")))
     if not rows:
-        lines.append("| (no artifacts yet) | | | | | | | | |")
+        lines.append("| (no artifacts yet) | | | | | | | | | | |")
+    # Per-device train-state footprint (PR 13): rows that carry the
+    # measured params/opt/EMA byte breakdown — the number the zero
+    # update-sharding lane exists to shrink.
+    lines += state_memory_lines(rows)
     # Quality summaries live in sibling dirs; pull their headline if there.
     for qdir in sorted(d for d in os.listdir("results")
                        if d.startswith("quality_tpu")):
