@@ -824,6 +824,38 @@ def main():
         for name, s in tracer.summary().items()}
     result["telemetry"] = {"spans": spans, "device_memory": mem_snapshot}
     _emit(result)
+    _run_sentry(result)
+
+
+def _run_sentry(result: dict) -> None:
+    """Judge the number just emitted against the banked BENCH_r*
+    trajectory (tools/bench_sentry.py). The verdict always prints; the
+    process exits with the sentry's own rc (4 — regression, distinct
+    from rc=3 infra refusal) only under NVS3D_BENCH_SENTRY=1, so
+    archived rounds keep their rc semantics unless a lane opts in."""
+    vs = result.get("vs_baseline")
+    if not isinstance(vs, (int, float)):
+        return
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import bench_sentry
+    except ImportError:
+        return
+    try:
+        verdict = bench_sentry.judge(
+            os.path.dirname(os.path.abspath(__file__)), fresh_vs=vs)
+    except Exception as e:  # the sentry must never eat the judged line
+        print(f"sentry: skipped ({e})", file=sys.stderr)
+        return
+    newest = verdict["newest_bench"] or {}
+    print(f"sentry: vs_baseline={vs} vs trajectory median="
+          f"{newest.get('median_prior')} -> "
+          + ("REGRESSION" if verdict["regressed"] else "healthy"),
+          file=sys.stderr)
+    if verdict["regressed"] and os.environ.get(
+            "NVS3D_BENCH_SENTRY") == "1":
+        sys.exit(bench_sentry.REGRESSION_RC)
 
 
 if __name__ == "__main__":

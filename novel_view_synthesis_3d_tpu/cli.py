@@ -529,6 +529,7 @@ def cmd_serve(args, overrides: List[str]) -> int:
     service = SamplingService(model, params, cfg.diffusion, cfg.serve,
                               mesh=mesh, results_folder=args.out,
                               tracer=telemetry.tracer,
+                              flight=telemetry.flight,
                               model_version=model_version)
     if store is not None:
         from novel_view_synthesis_3d_tpu.registry import RegistryWatcher
@@ -581,7 +582,8 @@ def cmd_serve(args, overrides: List[str]) -> int:
                                                   args.sample_steps),
                             guidance_weight=spec.get("guidance_weight"),
                             deadline_ms=spec.get("deadline_ms"),
-                            k_max=spec.get("k_max"))
+                            k_max=spec.get("k_max"),
+                            trace_id=spec.get("trace_id"))
                 else:
                     def _submit(cond=cond, spec=spec, i=i):
                         return service.submit(
@@ -590,7 +592,8 @@ def cmd_serve(args, overrides: List[str]) -> int:
                             sample_steps=spec.get("sample_steps",
                                                   args.sample_steps),
                             guidance_weight=spec.get("guidance_weight"),
-                            deadline_ms=spec.get("deadline_ms"))
+                            deadline_ms=spec.get("deadline_ms"),
+                            trace_id=spec.get("trace_id"))
                 # Brownout/queue-full rejects are retryable with a
                 # server-suggested retry_after_s; honor it before giving
                 # up on the request.
@@ -1211,6 +1214,113 @@ def cmd_registry(args, overrides: List[str]) -> int:
 
 
 # ---------------------------------------------------------------------------
+# obs (offline observability: trace reconstruction, run diff, SLO score)
+# ---------------------------------------------------------------------------
+def cmd_obs(args, overrides: List[str]) -> int:
+    """Postmortem tooling over a finished run's telemetry.jsonl.
+
+    `trace`: reconstruct per-request causal timelines (which dispatches
+    a request rode, co-rider counts, step debt, swap drains) and verify
+    the trace invariants; `diff`: span-percentile drift between two
+    runs; `slo`: whole-run SLO attainment per step class. No JAX, no
+    device — these read what obs/reqtrace.py defines and the service
+    emitted, so they work on a laptop against rsync'd artifacts.
+    """
+    from novel_view_synthesis_3d_tpu.obs import reqtrace
+
+    sub = args.obs_command
+
+    if sub == "trace":
+        rows = reqtrace.load_rows(args.run)
+        if not rows:
+            raise SystemExit(
+                f"no telemetry rows under {args.run!r} — was the run "
+                "recorded with obs.jsonl=true?")
+        timelines = reqtrace.reconstruct(rows)
+        if not timelines:
+            raise SystemExit(
+                f"{len(rows)} telemetry rows but no request_submit "
+                "spans — not a serving run, or pre-tracing telemetry")
+        problems = reqtrace.verify_timelines(timelines, rows)
+        if args.trace_id:
+            sel = {t: tl for t, tl in timelines.items()
+                   if t == args.trace_id}
+            if not sel:
+                raise SystemExit(
+                    f"trace {args.trace_id!r} not found (known: "
+                    f"{', '.join(sorted(timelines)[:10])}...)")
+        else:
+            sel = timelines
+        if args.json:
+            print(json.dumps({"timelines": list(sel.values()),
+                              "problems": problems}))
+        else:
+            for tid in sorted(sel):
+                print(reqtrace.format_timeline(sel[tid]))
+                print()
+            for p in problems:
+                print(f"PROBLEM: {p}")
+        if args.perfetto:
+            if args.trace_id:
+                out = reqtrace.export_perfetto(
+                    sel[args.trace_id], args.perfetto)
+                print(f"wrote {out}")
+            else:
+                os.makedirs(args.perfetto, exist_ok=True)
+                for tid, tl in sorted(sel.items()):
+                    reqtrace.export_perfetto(tl, os.path.join(
+                        args.perfetto, f"request_{tid}.json"))
+                print(f"wrote {len(sel)} per-request tracks under "
+                      f"{args.perfetto}")
+        return 1 if problems else 0
+
+    if sub == "diff":
+        pa = reqtrace.span_percentiles(reqtrace.load_rows(args.a))
+        pb = reqtrace.span_percentiles(reqtrace.load_rows(args.b))
+        if not pa or not pb:
+            raise SystemExit("no span rows in "
+                             + (args.a if not pa else args.b))
+        diff = reqtrace.diff_percentiles(
+            pa, pb, threshold_pct=args.threshold_pct)
+        drifted = [d for d in diff if d["drift"]]
+        if args.json:
+            print(json.dumps({"diff": diff,
+                              "drifted": [d["name"] for d in drifted]}))
+        else:
+            for d in diff:
+                flag = "DRIFT" if d["drift"] else "     "
+                deltas = " ".join(
+                    f"{k.split('_')[0]}{v:+.1f}%"
+                    for k, v in d["deltas_pct"].items()) or d.get(
+                        "note", "")
+                print(f"{flag} {d['name']:<24s} {deltas}")
+            print(f"{len(drifted)}/{len(diff)} span names drifted "
+                  f">{args.threshold_pct:.0f}% (B vs A)")
+        return 1 if drifted else 0
+
+    if sub == "slo":
+        from novel_view_synthesis_3d_tpu.obs import slo as slo_lib
+
+        spec = args.targets
+        if spec is None:
+            cfg = build_config(args, overrides)
+            spec = cfg.serve.slo.targets
+        targets = slo_lib.parse_targets(spec)
+        if not targets:
+            raise SystemExit(
+                "no SLO targets: pass --targets '4:500,64:2000' or set "
+                "serve.slo.targets")
+        rows = reqtrace.load_rows(args.run)
+        snap = slo_lib.attainment_from_rows(rows, targets)
+        print(json.dumps({"run": args.run, "slo": snap}))
+        missed = [c for c, s in snap.items()
+                  if s["total"] and s["attainment"] < s["objective"]]
+        return 1 if missed else 0
+
+    raise SystemExit(f"unknown obs command {sub!r}")
+
+
+# ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -1293,7 +1403,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--requests", default=None, metavar="JSONL",
                    help="JSON-lines request file (fields: instance, "
                         "cond_view, target_view, seed, sample_steps, "
-                        "guidance_weight, deadline_ms; trajectory "
+                        "guidance_weight, deadline_ms, trace_id "
+                        "(client-chosen id for nvs3d obs trace); "
+                        "trajectory "
                         "requests add poses=[[4x4],...] or orbit=N plus "
                         "optional k_max — responses then stream one "
                         "line per frame with frame_index/model_version);"
@@ -1500,6 +1612,45 @@ def make_parser() -> argparse.ArgumentParser:
     q.add_argument("--keep", type=int, default=None,
                    help="versions to keep (default registry.keep)")
 
+    p = sub.add_parser(
+        "obs",
+        help="postmortem tooling over a run's telemetry.jsonl: "
+             "per-request trace reconstruction, cross-run span-"
+             "percentile diff, whole-run SLO attainment")
+    obs_sub = p.add_subparsers(dest="obs_command", required=True)
+    q = obs_sub.add_parser(
+        "trace",
+        help="reconstruct per-request causal timelines (dispatches "
+             "ridden, co-riders, step debt, swap drains) and verify "
+             "the trace invariants; rc=1 on a broken trace")
+    q.add_argument("run", help="run dir holding telemetry.jsonl")
+    q.add_argument("--trace-id", default=None,
+                   help="show one request (default: all)")
+    q.add_argument("--json", action="store_true")
+    q.add_argument("--perfetto", default=None, metavar="PATH",
+                   help="export Perfetto/Chrome-trace track(s): a file "
+                        "with --trace-id, else a directory of "
+                        "per-request tracks")
+    q = obs_sub.add_parser(
+        "diff",
+        help="span-percentile drift between two runs (p50/p90/p99 per "
+             "span name); rc=1 when any span drifted past the "
+             "threshold")
+    q.add_argument("a", help="baseline run dir")
+    q.add_argument("b", help="candidate run dir")
+    q.add_argument("--threshold-pct", type=float, default=20.0)
+    q.add_argument("--json", action="store_true")
+    q = obs_sub.add_parser(
+        "slo",
+        help="whole-run SLO attainment per step class from the "
+             "request_respond spans; rc=1 when a class missed its "
+             "objective")
+    _add_common(q)
+    q.add_argument("run", help="run dir holding telemetry.jsonl")
+    q.add_argument("--targets", default=None,
+                   help="step-class targets, e.g. '4:500,64:2000' "
+                        "(default: serve.slo.targets from config)")
+
     return parser
 
 
@@ -1514,6 +1665,7 @@ _COMMANDS = {
     "export": cmd_export,
     "registry": cmd_registry,
     "distill": cmd_distill,
+    "obs": cmd_obs,
 }
 
 

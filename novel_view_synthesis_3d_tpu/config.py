@@ -411,6 +411,32 @@ class BrownoutConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Serving SLOs (obs/slo.py; docs/DESIGN.md "Request tracing, SLOs
+    & flight recorder"): declarative per-step-class latency objectives
+    scored live against every completed request, with multi-window
+    burn-rate breach detection (`nvs3d_slo_*` gauges, `slo_breach`
+    events)."""
+
+    # Per-step-class latency budgets: "<steps>:<latency_ms>,..." e.g.
+    # "4:500,64:2000" — a 4-step request owes a response in 500 ms.
+    # Requests are scored against the smallest class covering their
+    # step count. "" (default) disables the engine entirely.
+    targets: str = ""
+    # Availability objective per class: the fraction of requests that
+    # must meet their latency budget (and succeed at all).
+    objective: float = 0.99
+    # Multi-window burn-rate alerting: a breach needs BOTH the fast
+    # window burning above fast_burn (paging-fast, noisy alone) AND the
+    # slow window above slow_burn (sustained, slow alone). The default
+    # thresholds are the standard 14x/2x pairing for a 99% objective.
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    fast_burn: float = 14.0
+    slow_burn: float = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Sampling-service front-end (sample/service.py; `nvs3d serve`).
 
@@ -513,6 +539,8 @@ class ServeConfig:
     # Brownout degradation ladder (off by default).
     brownout: BrownoutConfig = dataclasses.field(
         default_factory=BrownoutConfig)
+    # Per-step-class latency SLOs + burn-rate alerting (off by default).
+    slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -624,6 +652,11 @@ class ObsConfig:
     # telemetry.jsonl sink: machine-readable span/gauge/event stream in
     # the results folder (tools/summarize_bench.py reads it).
     jsonl: bool = True
+    # Size cap on telemetry.jsonl: past this many MB the file rotates
+    # aside to telemetry.jsonl.old (one generation kept, the events.csv
+    # stale-schema convention) so a multi-day serve run cannot fill the
+    # disk. 0 = unbounded.
+    telemetry_max_mb: float = 256.0
     # Device-memory poll period (seconds) for the bytes-in-use/peak/limit
     # gauges; 0 disables the monitor thread.
     device_poll_s: float = 10.0
@@ -1019,6 +1052,33 @@ class Config:
                 f"serve.brownout.k_cap={bo.k_cap} must be <= "
                 f"serve.k_max={sv.k_max} (a degraded admission cannot "
                 "widen the bank window)")
+        slo = sv.slo
+        try:
+            from novel_view_synthesis_3d_tpu.obs.slo import parse_targets
+
+            targets = parse_targets(slo.targets)
+        except ValueError as e:
+            targets = {}
+            errors.append(str(e))
+        if targets and any(v <= 0 for v in targets.values()):
+            errors.append(
+                f"serve.slo.targets={slo.targets!r}: latency budgets "
+                "must be > 0 ms")
+        if not (0.0 < slo.objective < 1.0):
+            errors.append(
+                f"serve.slo.objective={slo.objective} must be in (0, 1)")
+        if slo.fast_window_s <= 0 or slo.slow_window_s < slo.fast_window_s:
+            errors.append(
+                f"serve.slo windows ({slo.fast_window_s}, "
+                f"{slo.slow_window_s}) must satisfy 0 < fast <= slow")
+        if slo.fast_burn <= 0 or slo.slow_burn <= 0:
+            errors.append(
+                f"serve.slo burn thresholds ({slo.fast_burn}, "
+                f"{slo.slow_burn}) must be > 0")
+        if self.obs.telemetry_max_mb < 0:
+            errors.append(
+                f"obs.telemetry_max_mb={self.obs.telemetry_max_mb} must "
+                "be >= 0 (0 = unbounded)")
         sc = self.diffusion.stochastic_cond
         if sc not in (True, False):
             errors.append(

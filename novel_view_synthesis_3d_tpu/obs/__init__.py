@@ -30,6 +30,10 @@ from novel_view_synthesis_3d_tpu.obs.bus import (  # noqa: F401
     EventBus,
     append_event,
 )
+from novel_view_synthesis_3d_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    NullFlightRecorder,
+)
 from novel_view_synthesis_3d_tpu.obs.registry import (  # noqa: F401
     MetricsRegistry,
     get_registry,
@@ -61,6 +65,7 @@ class RunTelemetry:
     devmon: Optional[object] = None
     xprof: Optional[XProfWindow] = None
     server: Optional[MetricsServer] = None
+    flight: object = None
     results_folder: str = "."
     _finalized: bool = False
 
@@ -76,13 +81,24 @@ class RunTelemetry:
         NullTracer, a bus with the JSONL sink off, no monitor/server.
         """
         registry = registry if registry is not None else get_registry()
+        max_mb = float(getattr(ocfg, "telemetry_max_mb", 0) or 0)
         bus = EventBus(results_folder,
-                       jsonl=ocfg.enabled and ocfg.jsonl)
+                       jsonl=ocfg.enabled and ocfg.jsonl,
+                       jsonl_max_bytes=int(max_mb * 1024 * 1024))
+        # Flight recorder is ALWAYS on (even with obs.enabled=False):
+        # its tap sits in front of the bus's jsonl-enabled check, so
+        # the last ~512 rows are dumpable at any failure site for the
+        # cost of a deque append per row.
+        flight = FlightRecorder(results_folder)
+        bus.tap = flight.record
         if ocfg.enabled and ocfg.trace:
+            # on_complete feeds the bus even with the JSONL sink off:
+            # the sink check happens inside the bus, AFTER the flight
+            # recorder's tap has seen the row.
             tracer = Tracer(
                 max_events=ocfg.trace_max_events,
                 registry=registry,
-                on_complete=(bus.span_record if ocfg.jsonl else None))
+                on_complete=bus.span_record)
         else:
             tracer = NullTracer()
         devmon = None
@@ -105,7 +121,7 @@ class RunTelemetry:
                   f"{server.url('')} (obs.metrics_port)")
         return cls(tracer=tracer, bus=bus, registry=registry,
                    devmon=devmon, xprof=xprof, server=server,
-                   results_folder=results_folder)
+                   flight=flight, results_folder=results_folder)
 
     def export_trace(self, path: Optional[str] = None) -> Optional[str]:
         if isinstance(self.tracer, NullTracer):

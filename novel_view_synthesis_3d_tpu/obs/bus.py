@@ -38,7 +38,7 @@ import json
 import os
 import threading
 import time
-from typing import IO, Optional, Sequence
+from typing import IO, Callable, Optional, Sequence
 
 # model_version (PR 5): which registry version was live when the event
 # fired — "" for events outside a versioned-serving context. Consumers
@@ -135,9 +135,19 @@ class EventBus:
     bus constructed for a run that never emits JSONL leaves no empty
     file behind."""
 
-    def __init__(self, results_folder: str, *, jsonl: bool = True):
+    def __init__(self, results_folder: str, *, jsonl: bool = True,
+                 jsonl_max_bytes: int = 0):
         self.results_folder = results_folder
         self._jsonl_enabled = jsonl
+        # Size cap: past this many bytes telemetry.jsonl rotates aside
+        # to .old (one generation kept — the _CsvTable stale-schema
+        # convention) so a multi-day serve run cannot fill the disk.
+        # 0 = unbounded.
+        self._jsonl_max_bytes = int(jsonl_max_bytes)
+        # Pre-serialization tap (the flight recorder): sees EVERY row,
+        # including when the JSONL sink is off, and must never fault
+        # the producer.
+        self.tap: Optional[Callable[[dict], None]] = None
         self._lock = threading.Lock()
         self._metrics: Optional[_CsvTable] = None
         self._jsonl_fh: Optional[IO] = None
@@ -167,10 +177,16 @@ class EventBus:
 
     # -- telemetry.jsonl -----------------------------------------------
     def jsonl_row(self, obj: dict) -> None:
+        row = dict(obj, t=round(time.time(), 3))
+        if self.tap is not None:
+            try:
+                self.tap(row)
+            except Exception:
+                pass  # a forensics sink fault is never the run's fault
         if not self._jsonl_enabled:
             return
         try:
-            line = json.dumps(dict(obj, t=round(time.time(), 3)))
+            line = json.dumps(row)
         except (TypeError, ValueError):
             return  # non-serializable telemetry is dropped, never fatal
         with self._lock:
@@ -180,6 +196,12 @@ class EventBus:
                     jsonl_path(self.results_folder), "a")
             self._jsonl_fh.write(line + "\n")
             self._jsonl_fh.flush()
+            if (self._jsonl_max_bytes
+                    and self._jsonl_fh.tell() >= self._jsonl_max_bytes):
+                path = jsonl_path(self.results_folder)
+                self._jsonl_fh.close()
+                self._jsonl_fh = None
+                os.replace(path, path + ".old")
 
     def span_record(self, rec: dict) -> None:
         """JSONL row for one tracer span record: {"kind":"span", name,

@@ -1,0 +1,131 @@
+"""Always-on flight recorder: a bounded in-memory ring of the most
+recent telemetry rows, dumped to disk when something goes wrong.
+
+The PR 2 stall bundles answered "what was the process doing when the
+watchdog fired"; this answers the more common postmortem question:
+"what happened in the seconds BEFORE the anomaly/restart/drain
+timeout" — the spans, events, and gauge samples that already flow
+through the EventBus, retained even when `obs.jsonl` is off (the tap
+sits in front of the enabled check) and even when the full
+telemetry.jsonl has long since rotated aside.
+
+Design constraints, in order:
+
+  - Recording must be cheap enough to leave on in production serving:
+    one lock + deque append per row, no serialization until dump time.
+  - A dump must never take down the run it is diagnosing: every
+    public method swallows its own faults; the dump is written to a
+    temp file and atomically renamed, so a crash mid-dump leaves no
+    truncated JSON for the postmortem tooling to choke on.
+  - Dumps are individually numbered (``flight_<reason>_<n>.json``)
+    rather than overwritten: a restart loop that dumps five times
+    leaves five files, and the ordering IS the story.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of recent telemetry entries.
+
+    Wire into a bus with ``bus.tap = recorder.record`` (the EventBus
+    calls its tap before — and regardless of — the JSONL enabled
+    check), or feed it directly via `record` / `note`.
+    """
+
+    def __init__(self, results_folder: str,
+                 capacity: int = DEFAULT_CAPACITY):
+        self.results_folder = results_folder
+        self._lock = threading.Lock()
+        import collections
+
+        self._ring: "collections.deque" = collections.deque(
+            maxlen=max(8, int(capacity)))
+        self._n_recorded = 0
+        self._n_dumped = 0
+        self.dumps: List[str] = []
+
+    # -- recording -----------------------------------------------------
+    def record(self, entry: dict) -> None:
+        """Retain one telemetry row (shallow-copied, wall-stamped)."""
+        try:
+            row = dict(entry)
+            row.setdefault("t", round(time.time(), 3))
+            with self._lock:
+                self._ring.append(row)
+                self._n_recorded += 1
+        except Exception:
+            pass  # the recorder must never become the run's fault
+
+    def note(self, kind: str, **fields) -> None:
+        """Record an entry authored by the recorder's owner (e.g. the
+        service's event mirror) rather than tapped off the bus."""
+        self.record({"kind": kind, **fields})
+
+    def entries(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    # -- dumping -------------------------------------------------------
+    def dump(self, reason: str, **context) -> Optional[str]:
+        """Atomically write the ring as ``flight_<reason>_<n>.json``
+        under the results folder; returns the path (None on failure —
+        a forensics miss, never a crash). The newest entries sit at the
+        END of ``entries``, so the triggering event is the tail."""
+        reason = "".join(
+            c if (c.isalnum() or c in "._-") else "_" for c in reason
+        ) or "unknown"
+        try:
+            with self._lock:
+                entries = list(self._ring)
+                n = self._n_dumped
+                self._n_dumped += 1
+                recorded = self._n_recorded
+            os.makedirs(self.results_folder, exist_ok=True)
+            path = os.path.join(self.results_folder,
+                                f"flight_{reason}_{n}.json")
+            doc = {
+                "reason": reason,
+                "dumped_at": round(time.time(), 3),
+                "n_recorded_total": recorded,
+                "n_entries": len(entries),
+                "context": {k: v for k, v in context.items()
+                            if isinstance(v, (int, float, str, bool))},
+                "entries": entries,
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, path)
+            with self._lock:
+                self.dumps.append(path)
+            return path
+        except Exception:
+            return None
+
+
+class NullFlightRecorder:
+    """Disabled recorder with the same surface (keeps call sites free
+    of None checks when no results folder exists to dump into)."""
+
+    dumps: List[str] = []
+
+    def record(self, entry: dict) -> None:
+        pass
+
+    def note(self, kind: str, **fields) -> None:
+        pass
+
+    def entries(self) -> List[dict]:
+        return []
+
+    def dump(self, reason: str, **context) -> Optional[str]:
+        return None

@@ -1,0 +1,357 @@
+"""Request-scoped tracing: mint, thread, and reconstruct per-request
+causal timelines through the serving stack.
+
+The PR 4 tracer sees *phases* (queue_wait, batch_form, ring_step); a
+latency postmortem needs *requests*: which dispatches did request 17
+ride, who were its co-riders, how much step debt did its ring carry,
+did a model swap drain or a brownout decision sit in its path. This
+module defines the contract that makes that reconstructable from
+telemetry.jsonl alone, without touching device programs (program
+identity, bit-identity, and the zero-recompile contract are host-side
+invariants this layer must not disturb).
+
+Trace-context contract (all attrs ride the existing
+``bus.span_record`` scalar-attr path — nothing new on the wire):
+
+  - ``request_submit`` (zero-duration marker, emitted at admission):
+    ``trace_id`` (client-suppliable via the serve JSONL schema, else
+    minted from the request id), ``span_id`` (the causal root),
+    ``request_id``, ``req_kind`` ('single' | 'trajectory'),
+    ``steps``, ``brownout`` (ladder level at admission), and for
+    trajectories ``frames``.
+  - request-scoped child spans (``queue_wait``, ``step_wait``,
+    ``trajectory_frame``) carry ``trace_id`` + ``parent_id`` pointing
+    at the root ``span_id``.
+  - shared dispatch spans (``ring_step`` / ``compile`` in the stepper
+    ring, ``device`` in the request scheduler) carry ``dispatch`` (a
+    service-global ordinal), ``riders`` (comma-joined request ids —
+    one row per dispatch, NOT one per rider, so tracing cost does not
+    scale with batch size), and ``debt`` (the ring's step debt).
+  - ``request_respond`` (retrospective span covering submit→response):
+    ``trace_id``, ``parent_id``, ``outcome`` ('ok' | 'anomaly' |
+    'expired' | 'failed'), ``latency_s``, ``dispatches`` (rides
+    counted by the service — reconstruction cross-checks it),
+    ``swap_drains`` (param swaps that drained between submit and
+    admission), ``steps``, and for trajectories ``frames_done``.
+
+Everything below `load_rows` is the offline half: ``nvs3d obs trace``
+(timeline reconstruction + per-request Perfetto track), ``nvs3d obs
+diff`` (span-percentile drift between runs), and the serve_bench
+reqtrace assertions all run on these functions, so the CLI and the
+bench judge the exact same reconstruction the tests pin down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import bus as _bus
+
+_SAFE = re.compile(r"[^A-Za-z0-9._-]")
+
+# Span names whose rows are request-scoped (carry trace_id) vs shared
+# dispatch rows (carry riders). Reconstruction keys off these. A cold
+# dispatch is named "compile" in both schedulers (the PR 3 convention)
+# but is still a dispatch its riders rode.
+REQUEST_SPAN_NAMES = ("queue_wait", "step_wait", "trajectory_frame")
+DISPATCH_SPAN_NAMES = ("ring_step", "device", "compile")
+
+
+def mint(request_id: int, client: Optional[str] = None) -> str:
+    """Trace id for one request: the client's (sanitized to
+    ``[A-Za-z0-9._-]{1,64}`` so it is safe in filenames and CSV cells)
+    or a deterministic run-local default."""
+    if client:
+        safe = _SAFE.sub("_", str(client))[:64]
+        if safe:
+            return safe
+    return f"t-{int(request_id)}"
+
+
+def root_span_id(trace_id: str) -> str:
+    return f"{trace_id}/0"
+
+
+# ---------------------------------------------------------------------------
+# Offline reconstruction (telemetry.jsonl → per-request timelines)
+# ---------------------------------------------------------------------------
+def load_rows(run_dir: str) -> List[dict]:
+    """All telemetry rows for a run dir, oldest first — reads the
+    rotated-aside ``telemetry.jsonl.old`` (if any) before the live
+    file, so a run that crossed the size cap still reconstructs."""
+    rows: List[dict] = []
+    live = _bus.jsonl_path(run_dir)
+    for path in (live + ".old", live):
+        if not os.path.exists(path):
+            continue
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail line from a crash
+    return rows
+
+
+def _riders_of(row: dict) -> List[int]:
+    out = []
+    for part in str(row.get("riders", "")).split(","):
+        part = part.strip()
+        if part:
+            try:
+                out.append(int(part))
+            except ValueError:
+                pass
+    return out
+
+
+def reconstruct(rows: List[dict]) -> Dict[str, dict]:
+    """telemetry rows → {trace_id: timeline}. A timeline is complete
+    when both its root (``request_submit``) and its ``request_respond``
+    landed; dispatch rows attach to every rider's timeline with the
+    co-rider count observed on that dispatch."""
+    timelines: Dict[str, dict] = {}
+    by_request: Dict[int, str] = {}
+    spans = [r for r in rows if r.get("kind") == "span"]
+    for row in spans:
+        if row.get("name") != "request_submit":
+            continue
+        tid = str(row.get("trace_id", ""))
+        if not tid:
+            continue
+        rid = int(row.get("request_id", -1))
+        timelines[tid] = {
+            "trace_id": tid,
+            "request_id": rid,
+            "req_kind": row.get("req_kind", "single"),
+            "steps": row.get("steps"),
+            "frames": row.get("frames"),
+            "brownout": row.get("brownout"),
+            "submit_t": row.get("t"),
+            "spans": [],
+            "dispatches": [],
+            "respond": None,
+        }
+        by_request[rid] = tid
+    for row in spans:
+        name = row.get("name")
+        tid = str(row.get("trace_id", ""))
+        if name == "request_respond" and tid in timelines:
+            timelines[tid]["respond"] = row
+        elif name in REQUEST_SPAN_NAMES and tid in timelines:
+            timelines[tid]["spans"].append(row)
+        elif name in DISPATCH_SPAN_NAMES and "riders" in row:
+            riders = _riders_of(row)
+            for rid in riders:
+                tid = by_request.get(rid)
+                if tid is None:
+                    continue
+                timelines[tid]["dispatches"].append({
+                    "dispatch": row.get("dispatch"),
+                    "name": name,
+                    "t": row.get("t"),
+                    "dur_s": row.get("dur_s"),
+                    "co_riders": len(riders),
+                    "debt": row.get("debt"),
+                    "bucket": row.get("bucket"),
+                })
+    for tl in timelines.values():
+        tl["spans"].sort(key=lambda r: r.get("t") or 0.0)
+        tl["dispatches"].sort(key=lambda d: (d["dispatch"] is None,
+                                             d["dispatch"]))
+        tl["complete"] = tl["respond"] is not None
+        tl["outcome"] = (tl["respond"] or {}).get("outcome")
+    return timelines
+
+
+def verify_timelines(timelines: Dict[str, dict],
+                     rows: List[dict]) -> List[str]:
+    """Invariant check behind the serve_bench reqtrace assertion and
+    the tier-1 reconstruction test. Returns human-readable problems
+    (empty == the trace is sound):
+
+      - every request that responded has a causal chain back to a
+        submit root (guaranteed by construction) and, when it did work
+        on-device, at least one dispatch;
+      - no dispatch ordinal appears twice in one request's timeline
+        (a request rides each dispatch exactly once);
+      - the service's own ride count (``dispatches`` on the respond
+        span) agrees with reconstruction;
+      - every rider named on a dispatch row maps to a known submit.
+    """
+    problems: List[str] = []
+    known = {tl["request_id"] for tl in timelines.values()}
+    for row in rows:
+        if row.get("kind") != "span" or "riders" not in row:
+            continue
+        if row.get("name") not in DISPATCH_SPAN_NAMES:
+            continue
+        for rid in _riders_of(row):
+            if rid not in known:
+                problems.append(
+                    f"dispatch {row.get('dispatch')} names rider "
+                    f"{rid} with no request_submit root")
+    for tid, tl in sorted(timelines.items()):
+        ords = [d["dispatch"] for d in tl["dispatches"]
+                if d["dispatch"] is not None]
+        if len(ords) != len(set(ords)):
+            problems.append(f"{tid}: dispatch ordinal appears twice "
+                            f"in one timeline ({sorted(ords)})")
+        resp = tl["respond"]
+        if resp is None:
+            continue
+        claimed = resp.get("dispatches")
+        if claimed is not None and int(claimed) != len(ords):
+            problems.append(
+                f"{tid}: service counted {claimed} rides, "
+                f"reconstruction found {len(ords)}")
+        if resp.get("outcome") == "ok" and claimed and not ords:
+            problems.append(f"{tid}: responded ok after "
+                            f"{claimed} rides but no dispatch row "
+                            "names it as a rider")
+    return problems
+
+
+def format_timeline(tl: dict) -> str:
+    """One request's story as text — the ``nvs3d obs trace`` output."""
+    lines = [
+        f"trace {tl['trace_id']}  request_id={tl['request_id']}  "
+        f"kind={tl['req_kind']}  steps={tl.get('steps')}"
+        + (f"  frames={tl['frames']}" if tl.get("frames") else "")
+        + (f"  brownout={tl['brownout']}" if tl.get("brownout")
+           else "")]
+    t0 = tl.get("submit_t") or 0.0
+
+    def rel(t):
+        return f"+{(t or t0) - t0:8.3f}s"
+
+    lines.append(f"  {rel(t0)}  submit")
+    merged: List[Tuple[float, str]] = []
+    for row in tl["spans"]:
+        extra = ""
+        if row.get("name") == "trajectory_frame":
+            extra = f" frame={row.get('frame_index')}"
+        merged.append((row.get("t") or t0,
+                       f"{row['name']}{extra} "
+                       f"dur={1e3 * (row.get('dur_s') or 0.0):.1f}ms"))
+    for d in tl["dispatches"]:
+        merged.append((d.get("t") or t0,
+                       f"{d['name']} #{d['dispatch']} "
+                       f"co_riders={d['co_riders']} "
+                       f"debt={d.get('debt')} "
+                       f"dur={1e3 * (d.get('dur_s') or 0.0):.1f}ms"))
+    for t, text in sorted(merged, key=lambda p: p[0]):
+        lines.append(f"  {rel(t)}  {text}")
+    resp = tl.get("respond")
+    if resp is None:
+        lines.append("  [incomplete: no request_respond recorded]")
+    else:
+        lines.append(
+            f"  {rel(resp.get('t'))}  respond outcome={resp.get('outcome')} "
+            f"latency={1e3 * (resp.get('latency_s') or 0.0):.1f}ms "
+            f"rides={resp.get('dispatches')} "
+            f"swap_drains={resp.get('swap_drains')}")
+    return "\n".join(lines)
+
+
+def export_perfetto(tl: dict, path: str) -> str:
+    """One request's timeline as a Chrome-trace file: a single track
+    whose ``X`` events are the request's spans and the dispatches it
+    rode — the per-request counterpart of the run-wide trace.json."""
+    t0 = tl.get("submit_t") or 0.0
+    events = [{"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+               "args": {"name": f"request[{tl['trace_id']}]"}}]
+
+    def ev(name, t, dur_s, **args):
+        events.append({"ph": "X", "name": name, "pid": 0, "tid": 0,
+                       "ts": max(0.0, ((t or t0) - t0)) * 1e6,
+                       "dur": max(0.0, dur_s or 0.0) * 1e6,
+                       "args": args})
+
+    ev("request_submit", t0, 0.0, trace_id=tl["trace_id"],
+       request_id=tl["request_id"], req_kind=tl["req_kind"])
+    for row in tl["spans"]:
+        # dur'd spans END at their stamp; draw them leading up to it.
+        t_end = row.get("t") or t0
+        dur = row.get("dur_s") or 0.0
+        ev(row["name"], t_end - dur, dur,
+           **{k: v for k, v in row.items()
+              if k not in ("kind", "name", "t", "dur_s")
+              and isinstance(v, (int, float, str, bool))})
+    for d in tl["dispatches"]:
+        t_end = d.get("t") or t0
+        dur = d.get("dur_s") or 0.0
+        ev(f"{d['name']}#{d['dispatch']}", t_end - dur, dur,
+           co_riders=d["co_riders"], debt=d.get("debt"),
+           bucket=d.get("bucket"))
+    resp = tl.get("respond")
+    if resp is not None:
+        ev("request_respond", (resp.get("t") or t0)
+           - (resp.get("latency_s") or 0.0), resp.get("latency_s"),
+           outcome=resp.get("outcome"),
+           dispatches=resp.get("dispatches"))
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Cross-run span-percentile diff (``nvs3d obs diff``)
+# ---------------------------------------------------------------------------
+def span_percentiles(rows: List[dict]) -> Dict[str, dict]:
+    """Per-span-name {count, p50_ms, p90_ms, p99_ms} over a run's
+    telemetry rows — same shape as Tracer.summary but computed offline
+    so two finished runs can be compared."""
+    import numpy as np
+
+    by_name: Dict[str, list] = {}
+    for row in rows:
+        if row.get("kind") != "span":
+            continue
+        dur = row.get("dur_s")
+        if dur is None:
+            continue
+        by_name.setdefault(row["name"], []).append(float(dur))
+    out = {}
+    for name, durs in sorted(by_name.items()):
+        arr = np.asarray(durs)
+        out[name] = {
+            "count": int(arr.size),
+            "p50_ms": float(np.percentile(arr, 50) * 1e3),
+            "p90_ms": float(np.percentile(arr, 90) * 1e3),
+            "p99_ms": float(np.percentile(arr, 99) * 1e3),
+        }
+    return out
+
+
+def diff_percentiles(a: Dict[str, dict], b: Dict[str, dict],
+                     threshold_pct: float = 20.0) -> List[dict]:
+    """Percentile drift B vs A per span name. ``drift`` is set when
+    any percentile moved more than threshold_pct in either direction
+    (regressions AND suspicious speedups both warrant a look)."""
+    out: List[dict] = []
+    for name in sorted(set(a) | set(b)):
+        ra, rb = a.get(name), b.get(name)
+        row = {"name": name, "a": ra, "b": rb, "drift": False,
+               "deltas_pct": {}}
+        if ra is None or rb is None:
+            row["drift"] = True
+            row["note"] = ("only in B" if ra is None else "only in A")
+        else:
+            for key in ("p50_ms", "p90_ms", "p99_ms"):
+                base = ra[key]
+                if base <= 0.0:
+                    continue
+                pct = 100.0 * (rb[key] - base) / base
+                row["deltas_pct"][key] = round(pct, 1)
+                if abs(pct) > threshold_pct:
+                    row["drift"] = True
+        out.append(row)
+    return out

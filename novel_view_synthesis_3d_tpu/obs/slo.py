@@ -1,0 +1,217 @@
+"""Live SLO engine: per-step-class latency objectives with
+multi-window burn-rate alerting.
+
+The serving stack's aggregate RPS says nothing about whether the
+4-step distilled tier is meeting its 500 ms promise while the 64-step
+tier quietly burns its error budget (cf. the Gemma-on-TPU serving
+comparison in PAPERS.md, which reports per-class SLO attainment, not
+throughput). This module scores every completed/failed request against
+a declarative target table (``serve.slo.targets``, e.g.
+``"4:500,64:2000"`` — step class → latency budget in ms) and computes
+the standard multi-window burn rate:
+
+    burn(window) = error_rate(window) / (1 - objective)
+
+A breach fires only when BOTH the fast window (paging-fast, e.g. 60 s
+at 14x) and the slow window (sustained, e.g. 600 s at 2x) exceed their
+thresholds — the fast window alone is too noisy at serve-bench request
+counts, the slow window alone pages an hour late. Breach and recovery
+transitions are emitted as events (``slo_breach`` / ``slo_recovered``)
+through whatever callback the owner wires (the service routes them to
+the EventBus), and the live values are exported as ``nvs3d_slo_*``
+gauges on /metrics.
+
+The clock is injectable so burn-rate dynamics are unit-testable
+without sleeping through a 10-minute window.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def parse_targets(spec: str) -> Dict[int, float]:
+    """``"4:500,64:2000"`` → {4: 0.5, 64: 2.0} (ms in, seconds out).
+    Empty/blank spec → {} (engine disabled). Raises ValueError on a
+    malformed entry so a config typo fails at startup, not silently."""
+    out: Dict[int, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            cls, ms = part.split(":")
+            out[int(cls)] = float(ms) / 1000.0
+        except Exception:
+            raise ValueError(
+                f"bad serve.slo.targets entry {part!r} "
+                "(want '<steps>:<latency_ms>', e.g. '4:500,64:2000')")
+    return out
+
+
+class SLOEngine:
+    """Scores request completions against per-step-class objectives.
+
+    ``record(steps, latency_s, ok=...)`` is the whole producer surface:
+    the service calls it once per resolved/failed request. Everything
+    else (burn windows, gauges, breach events) is derived."""
+
+    def __init__(self, *, targets: Dict[int, float],
+                 objective: float = 0.99,
+                 fast_window_s: float = 60.0,
+                 slow_window_s: float = 600.0,
+                 fast_burn: float = 14.0,
+                 slow_burn: float = 2.0,
+                 registry=None,
+                 event_cb: Optional[Callable[[str, str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.targets = dict(targets)
+        self.objective = min(max(float(objective), 0.0), 0.999999)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self._clock = clock
+        self._event_cb = event_cb
+        self._lock = threading.Lock()
+        # per class: deque of (t, good) — pruned past the slow window
+        self._samples: Dict[int, "collections.deque"] = {
+            cls: collections.deque() for cls in self.targets}
+        self._breached: Dict[int, bool] = {cls: False
+                                           for cls in self.targets}
+        self._g_attain = self._g_burn = self._g_breach = None
+        if registry is not None and self.targets:
+            self._g_attain = registry.gauge(
+                "nvs3d_slo_attainment",
+                "fraction of requests meeting their latency target "
+                "(slow window)")
+            self._g_burn = registry.gauge(
+                "nvs3d_slo_burn_rate",
+                "error-budget burn rate per step class and window")
+            self._g_breach = registry.gauge(
+                "nvs3d_slo_breach",
+                "1 while a step class is in multi-window breach")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.targets)
+
+    def classify(self, steps: int) -> Optional[int]:
+        """Map a request's step count onto a target class: exact match,
+        else the smallest class that covers it, else the largest (a
+        1024-step request is judged against the loosest budget rather
+        than dropped from the books)."""
+        if not self.targets:
+            return None
+        if steps in self.targets:
+            return steps
+        above = [c for c in self.targets if c >= steps]
+        return min(above) if above else max(self.targets)
+
+    # -- producer surface ----------------------------------------------
+    def record(self, steps: int, latency_s: float, *,
+               ok: bool = True) -> None:
+        """Score one finished request. ``ok=False`` (anomaly, expiry,
+        worker failure) always burns budget; an ok request burns when
+        it misses its class's latency budget."""
+        cls = self.classify(int(steps))
+        if cls is None:
+            return
+        good = bool(ok) and float(latency_s) <= self.targets[cls]
+        now = self._clock()
+        with self._lock:
+            dq = self._samples[cls]
+            dq.append((now, good))
+            cutoff = now - self.slow_window_s
+            while dq and dq[0][0] < cutoff:
+                dq.popleft()
+        self._evaluate(cls, now)
+
+    # -- derived state -------------------------------------------------
+    def _window_stats(self, cls: int, window_s: float,
+                      now: float) -> Tuple[int, int]:
+        """(total, errors) over the trailing window for one class."""
+        cutoff = now - window_s
+        with self._lock:
+            samples = [s for s in self._samples[cls] if s[0] >= cutoff]
+        return len(samples), sum(1 for _, good in samples if not good)
+
+    def burn_rate(self, cls: int, window_s: float,
+                  now: Optional[float] = None) -> float:
+        now = self._clock() if now is None else now
+        total, errors = self._window_stats(cls, window_s, now)
+        if total == 0:
+            return 0.0
+        return (errors / total) / (1.0 - self.objective)
+
+    def _evaluate(self, cls: int, now: float) -> None:
+        fast = self.burn_rate(cls, self.fast_window_s, now)
+        slow = self.burn_rate(cls, self.slow_window_s, now)
+        total, errors = self._window_stats(cls, self.slow_window_s, now)
+        attain = 1.0 - (errors / total) if total else 1.0
+        breached = fast >= self.fast_burn and slow >= self.slow_burn
+        if self._g_attain is not None:
+            label = str(cls)
+            self._g_attain.set(attain, step_class=label)
+            self._g_burn.set(fast, step_class=label, window="fast")
+            self._g_burn.set(slow, step_class=label, window="slow")
+            self._g_breach.set(1.0 if breached else 0.0,
+                               step_class=label)
+        with self._lock:
+            was = self._breached[cls]
+            self._breached[cls] = breached
+        if breached != was and self._event_cb is not None:
+            try:
+                kind = "slo_breach" if breached else "slo_recovered"
+                self._event_cb(kind,
+                               f"class={cls} fast_burn={fast:.1f} "
+                               f"slow_burn={slow:.1f} "
+                               f"attainment={attain:.4f}")
+            except Exception:
+                pass  # alerting faults must not take down serving
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-class attainment/burn summary for service.summary(),
+        serve_bench artifacts, and ``nvs3d obs slo``."""
+        now = self._clock()
+        out: Dict[str, dict] = {}
+        for cls in sorted(self.targets):
+            total, errors = self._window_stats(
+                cls, self.slow_window_s, now)
+            out[str(cls)] = {
+                "target_ms": round(self.targets[cls] * 1000.0, 3),
+                "objective": self.objective,
+                "total": total,
+                "errors": errors,
+                "attainment": (1.0 - errors / total) if total else 1.0,
+                "fast_burn": self.burn_rate(cls, self.fast_window_s,
+                                            now),
+                "slow_burn": self.burn_rate(cls, self.slow_window_s,
+                                            now),
+                "breached": self._breached[cls],
+            }
+        return out
+
+
+def attainment_from_rows(rows: List[dict],
+                         targets: Dict[int, float]) -> Dict[str, dict]:
+    """Offline SLO attainment over telemetry.jsonl span rows — the
+    whole-run view behind ``nvs3d obs slo`` (the live engine only sees
+    its sliding window). Scores ``request_respond`` spans: latency from
+    ``latency_s``, class from ``steps``, error when outcome != 'ok'."""
+    eng = SLOEngine(targets=targets, slow_window_s=float("inf"),
+                    clock=lambda: 0.0)
+    for row in rows:
+        if row.get("kind") != "span" or row.get(
+                "name") != "request_respond":
+            continue
+        try:
+            eng.record(int(row.get("steps", 0)),
+                       float(row.get("latency_s", 0.0)),
+                       ok=row.get("outcome") == "ok")
+        except (TypeError, ValueError):
+            continue
+    return eng.snapshot()

@@ -114,6 +114,8 @@ import jax
 import numpy as np
 
 from novel_view_synthesis_3d_tpu import obs
+from novel_view_synthesis_3d_tpu.obs import reqtrace
+from novel_view_synthesis_3d_tpu.obs import slo as slo_lib
 from novel_view_synthesis_3d_tpu.utils import faultinject
 from novel_view_synthesis_3d_tpu.config import DiffusionConfig, ServeConfig
 from novel_view_synthesis_3d_tpu.diffusion.schedules import sampling_schedule
@@ -371,7 +373,8 @@ class TrajectoryTicket:
 
 class _Request:
     __slots__ = ("ticket", "cond", "key", "program_key", "t_submit",
-                 "deadline_s")
+                 "deadline_s", "trace_id", "swaps_at_submit",
+                 "swap_drains", "rides", "responded")
 
     def __init__(self, ticket: Ticket, cond: Dict[str, np.ndarray],
                  key: np.ndarray, program_key: tuple, t_submit: float,
@@ -382,6 +385,16 @@ class _Request:
         self.program_key = program_key
         self.t_submit = t_submit
         self.deadline_s = deadline_s  # 0 = none
+        # Request-scoped trace context (obs/reqtrace.py): the trace id
+        # minted (or client-supplied) at submission, the swap counter
+        # snapshot for swap-drain attribution, the number of ring
+        # dispatches this request rode, and the responded latch (one
+        # request_respond span per request, whatever path ends it).
+        self.trace_id = ""
+        self.swaps_at_submit = 0
+        self.swap_drains = 0
+        self.rides = 0
+        self.responded = False
 
     @property
     def shape(self) -> tuple:
@@ -551,12 +564,19 @@ class SamplingService:
     def __init__(self, model, params, diffusion: DiffusionConfig,
                  serve: Optional[ServeConfig] = None, *,
                  mesh=None, results_folder: Optional[str] = None,
-                 start: bool = True, tracer=None,
+                 start: bool = True, tracer=None, flight=None,
                  model_version: str = ""):
         self.model = model
         self.diffusion = diffusion
         self.serve = serve or ServeConfig()
         self.mesh = mesh
+        self._results_folder = results_folder or self.serve.results_folder
+        # Flight recorder (obs/flight.py): always on. `nvs3d serve`
+        # passes RunTelemetry's (whose bus tap already sees every span);
+        # embedded/test use gets its own ring fed by _append_event and
+        # the self-constructed tracer below.
+        self.flight = (flight if flight is not None
+                       else obs.FlightRecorder(self._results_folder))
         # Serving precision (sample/precision.py): how _stage_params
         # representations weights on device (f32 as-published / bf16
         # cast / weight-only int8 + in-jit dequant), folded into every
@@ -573,7 +593,7 @@ class SamplingService:
         # own tracer so trace.json lands next to the request PNGs;
         # embedded/test use gets a default one.
         self.tracer = tracer if tracer is not None else obs.Tracer(
-            registry=obs.get_registry())
+            registry=obs.get_registry(), on_complete=self._flight_span)
         self._requests_total = obs.get_registry().counter(
             "nvs3d_requests_total", "requests served (resolved tickets)")
         self._rejects_total = obs.get_registry().counter(
@@ -622,8 +642,21 @@ class SamplingService:
         self._drained_ev = threading.Event()
         self._brownout_level = 0
         self._ring_debt = 0
-        self._results_folder = results_folder or self.serve.results_folder
         self._events_lock = threading.Lock()
+        # SLO engine (obs/slo.py): scores every finished request
+        # against serve.slo.targets; None when no targets are declared.
+        slo_cfg = self.serve.slo
+        slo_targets = slo_lib.parse_targets(slo_cfg.targets)
+        self.slo: Optional[slo_lib.SLOEngine] = None
+        if slo_targets:
+            self.slo = slo_lib.SLOEngine(
+                targets=slo_targets, objective=slo_cfg.objective,
+                fast_window_s=slo_cfg.fast_window_s,
+                slow_window_s=slo_cfg.slow_window_s,
+                fast_burn=slo_cfg.fast_burn,
+                slow_burn=slo_cfg.slow_burn,
+                registry=obs.get_registry(),
+                event_cb=self._slo_event)
         # Live (params, model_version) pair — ONE attribute so readers
         # (the dispatch loop, _log_event) always see a consistent pair;
         # swaps stage a replacement and the worker flips it between
@@ -733,6 +766,7 @@ class SamplingService:
             self._queue.clear()
         for req in leftovers:
             req.ticket._fail(make_error())
+            self._respond_span(req, "failed")
 
     def _dump_stop_stall(self, worker: threading.Thread,
                          timeout: float) -> None:
@@ -746,6 +780,8 @@ class SamplingService:
             f"stop(): worker {worker.name!r} wedged past the "
             f"{timeout:.1f}s join (serve.stop_timeout_s); diagnosis "
             "stall_serve_stop_*.txt", model_version=self.model_version)
+        self.flight.dump("stall", worker=worker.name,
+                         timeout_s=timeout, dispatches=self.dispatches)
         body = (f"sampling-service stop(): worker {worker.name!r} still "
                 f"alive after join timeout {timeout:.1f}s\n"
                 f"time: {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}"
@@ -810,6 +846,9 @@ class SamplingService:
              f"draining -> stopped (TIMEOUT after {timeout_s:.1f}s; "
              "leftover requests fail retryably)"),
             model_version=self.model_version)
+        if not drained:
+            self.flight.dump("drain_timeout", timeout_s=timeout_s,
+                             dispatches=self.dispatches)
         self.stop()
         return drained
 
@@ -985,13 +1024,16 @@ class SamplingService:
     def submit(self, cond: Dict[str, np.ndarray], *, seed: int = 0,
                sample_steps: Optional[int] = None,
                guidance_weight: Optional[float] = None,
-               deadline_ms: Optional[float] = None) -> Ticket:
+               deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Ticket:
         """Enqueue one request; returns immediately with a Ticket.
 
         `cond` holds UNBATCHED conditioning: x (H, W, 3), R1/R2 (3, 3),
         t1/t2 (3,), K (3, 3) — the service stacks requests into the
         batch axis. Raises Rejected when the queue is full (the events
-        log records why), or on malformed conditioning.
+        log records why), or on malformed conditioning. `trace_id`
+        names the request's trace (obs/reqtrace.py; sanitized);
+        default: minted from the request id.
         """
         missing = [k for k in COND_KEYS if k not in cond]
         if missing:
@@ -1012,7 +1054,8 @@ class SamplingService:
             deadline_ms = self.serve.default_deadline_ms
         program_key = (int(x.shape[0]), int(x.shape[1]), int(steps), w)
         ticket = Ticket(self._claim_id())
-        if self._brownout_check(ticket.request_id) >= 2:
+        level = self._brownout_check(ticket.request_id)
+        if level >= 2:
             self._log_event(
                 ticket.request_id, "reject",
                 "brownout shed (level 2): load above "
@@ -1027,6 +1070,8 @@ class SamplingService:
             np.asarray(jax.random.PRNGKey(seed)),
             program_key, time.monotonic(),
             float(deadline_ms) / 1000.0 if deadline_ms else 0.0)
+        req.trace_id = reqtrace.mint(ticket.request_id, trace_id)
+        req.swaps_at_submit = self._swaps
         with self._queue_cv:
             if self._stop.is_set():
                 raise Rejected("service stopped")
@@ -1042,14 +1087,33 @@ class SamplingService:
                     retryable=True, retry_after_s=0.05)
             self._queue.append(req)
             self._queue_cv.notify_all()
+        self._submit_span(req, "single", int(steps), level)
         return ticket
+
+    def _submit_span(self, req: _Request, req_kind: str, steps: int,
+                     brownout_level: int,
+                     frames: Optional[int] = None) -> None:
+        """The trace root (obs/reqtrace.py contract): a zero-duration
+        request_submit marker carrying the span_id every request-scoped
+        child points back at. Emitted AFTER the enqueue commits —
+        rejected submissions have no trace."""
+        attrs = dict(trace_id=req.trace_id,
+                     span_id=reqtrace.root_span_id(req.trace_id),
+                     request_id=req.ticket.request_id,
+                     req_kind=req_kind, steps=steps,
+                     brownout=brownout_level)
+        if frames is not None:
+            attrs["frames"] = int(frames)
+        self.tracer.add_span("request_submit", 0.0, **attrs)
 
     def submit_trajectory(self, cond: Dict[str, np.ndarray], *,
                           poses, seed: int = 0,
                           sample_steps: Optional[int] = None,
                           guidance_weight: Optional[float] = None,
                           deadline_ms: Optional[float] = None,
-                          k_max: Optional[int] = None) -> TrajectoryTicket:
+                          k_max: Optional[int] = None,
+                          trace_id: Optional[str] = None
+                          ) -> TrajectoryTicket:
         """Enqueue one N-frame trajectory; returns a streaming ticket.
 
         `cond` holds the UNBATCHED source view: x (H, W, 3), R1 (3, 3),
@@ -1140,6 +1204,8 @@ class SamplingService:
             program_key, time.monotonic(),
             float(deadline_ms) / 1000.0 if deadline_ms else 0.0,
             poses_R, poses_t, cap)
+        req.trace_id = reqtrace.mint(ticket_id, trace_id)
+        req.swaps_at_submit = self._swaps
         with self._queue_cv:
             if self._stop.is_set():
                 raise Rejected("service stopped")
@@ -1155,6 +1221,8 @@ class SamplingService:
                     retryable=True, retry_after_s=0.05)
             self._queue.append(req)
             self._queue_cv.notify_all()
+        self._submit_span(req, "trajectory", int(steps), level,
+                          frames=n_frames)
         return ticket
 
     def _claim_id(self) -> int:
@@ -1180,13 +1248,19 @@ class SamplingService:
             fused = resolve_fused_step(self.diffusion.fused_step)
         except ValueError:
             fused = self.diffusion.fused_step
-        return dict(self.stats.summary(), **self.compile_counters(),
-                    model_version=self.model_version,
-                    model_swaps=self._swaps,
-                    precision=self.precision, fused_step=fused,
-                    anomalies=self.anomalies,
-                    worker_restarts=self.worker_restarts,
-                    brownout_level=self._brownout_level)
+        out = dict(self.stats.summary(), **self.compile_counters(),
+                   model_version=self.model_version,
+                   model_swaps=self._swaps,
+                   precision=self.precision, fused_step=fused,
+                   anomalies=self.anomalies,
+                   worker_restarts=self.worker_restarts,
+                   brownout_level=self._brownout_level,
+                   flight_dumps=len(self.flight.dumps))
+        if self._banks is not None:
+            out["schedule_bank"] = self._banks.counters()
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
+        return out
 
     def _log_event(self, request_id: int, kind: str, detail: str) -> None:
         """Event-log append via the obs bus, schema-compatible with the
@@ -1198,12 +1272,62 @@ class SamplingService:
 
     def _append_event(self, step: int, kind: str, detail: str, *,
                       model_version: str = "") -> None:
+        # Events also land in the flight ring, so a dump's tail holds
+        # the event that triggered it (anomaly/restart/drain/stall).
+        self.flight.note("event", step=step, event=kind, detail=detail,
+                         model_version=model_version)
         try:
             with self._events_lock:
                 obs.append_event(self._results_folder, step, kind,
                                  detail, model_version=model_version)
         except OSError:
             pass  # the event log must never be the serving fault
+
+    def _flight_span(self, rec: dict) -> None:
+        """on_complete sink for the self-constructed tracer: flatten a
+        span record into the flight ring (the bus.span_record shape,
+        minus the JSONL file). `nvs3d serve` doesn't use this — its
+        tracer feeds RunTelemetry's bus, whose tap IS the recorder."""
+        self.flight.record(
+            {"kind": "span", "name": rec["name"],
+             "dur_s": round(rec["dur"], 6),
+             **{k: v for k, v in rec.get("attrs", {}).items()
+                if isinstance(v, (int, float, str, bool))}})
+
+    def _slo_event(self, kind: str, detail: str) -> None:
+        self._append_event(0, kind, detail,
+                           model_version=self.model_version)
+
+    def _respond_span(self, req: _Request, outcome: str, *,
+                      steps_done: int = 0,
+                      frames_done: Optional[int] = None) -> None:
+        """Close a request's trace: ONE request_respond span covering
+        submit→now, whatever path ended it (resolution, anomaly,
+        expiry, worker failure), plus the SLO sample. Idempotent per
+        request — the first closer wins (a quarantined slot must not be
+        re-closed by a later ring unwind)."""
+        if req.responded or not req.trace_id:
+            req.responded = True
+            return
+        req.responded = True
+        latency = max(0.0, time.monotonic() - req.t_submit)
+        attrs = dict(
+            trace_id=req.trace_id,
+            parent_id=reqtrace.root_span_id(req.trace_id),
+            request_id=req.ticket.request_id,
+            outcome=outcome,
+            latency_s=round(latency, 6),
+            steps=int(req.program_key[2]),
+            steps_done=int(steps_done),
+            dispatches=req.rides,
+            swap_drains=req.swap_drains,
+            model_version=self.model_version)
+        if frames_done is not None:
+            attrs["frames_done"] = int(frames_done)
+        self.tracer.add_span("request_respond", latency, **attrs)
+        if self.slo is not None:
+            self.slo.record(int(req.program_key[2]), latency,
+                            ok=(outcome == "ok"))
 
     # -- batching worker -----------------------------------------------
     def _run_supervised(self) -> None:
@@ -1236,6 +1360,8 @@ class SamplingService:
                     print(f"[serve] worker died ({exc!r}); restart "
                           f"budget {budget} exhausted — stopping",
                           file=sys.stderr, flush=True)
+                    self.flight.dump("worker_restart", restart=n,
+                                     budget=budget, exhausted=True)
                     self._stop.set()
                     self._fail_queue(lambda: Rejected(
                         "service worker dead (restart budget "
@@ -1250,6 +1376,8 @@ class SamplingService:
                     f"{n}/{budget} in {delay:.2f}s — undispatched "
                     "requests stay queued",
                     model_version=self.model_version)
+                self.flight.dump("worker_restart", restart=n,
+                                 budget=budget, exhausted=False)
                 if delay > 0 and self._stop.wait(delay):
                     return
 
@@ -1279,6 +1407,7 @@ class SamplingService:
                 for req in group:
                     req.ticket._fail(
                         ServeError(f"dispatch failed: {exc!r}"))
+                    self._respond_span(req, "failed")
         self._drained_ev.set()
 
     # -- step-level continuous batching (serve.scheduler='step') --------
@@ -1321,6 +1450,8 @@ class SamplingService:
                     for slot in ring:
                         slot.req.ticket._fail(
                             ServeError(f"ring step failed: {exc!r}"))
+                        self._respond_span(slot.req, "failed",
+                                           steps_done=slot.steps_done)
                         if slot.is_traj:
                             self._traj_exit()
                     ring.clear()
@@ -1341,6 +1472,8 @@ class SamplingService:
             for slot in ring:
                 slot.req.ticket._fail(Rejected(
                     err_msg, retryable=True, retry_after_s=after))
+                self._respond_span(slot.req, "failed",
+                                   steps_done=slot.steps_done)
                 if slot.is_traj:
                     self._traj_exit()
             self._ring_debt = 0
@@ -1408,11 +1541,16 @@ class SamplingService:
             r.ticket._fail(
                 TrajectoryExpired(msg, frames=[], frame_index=0)
                 if r.is_traj else DeadlineExceeded(msg))
+            self._respond_span(r, "expired")
         if not admitted:
             return False
         now = time.monotonic()
         version = self._live[1]
         for r in admitted:
+            # Swap-drain attribution: every swap applied between this
+            # request's submission and its ring admission drained the
+            # ring in its path (the drain-on-swap contract).
+            r.swap_drains = self._swaps - r.swaps_at_submit
             steps = int(r.program_key[2])
             try:
                 bank = self._banks.get(steps)
@@ -1431,6 +1569,7 @@ class SamplingService:
                 r.ticket._fail(Rejected(
                     f"admission failed for request "
                     f"{r.ticket.request_id}: {exc!r}"))
+                self._respond_span(r, "failed")
                 continue
             if r.is_traj:
                 self._traj_in_ring += 1
@@ -1442,7 +1581,11 @@ class SamplingService:
             # requests ahead).
             self.tracer.add_span("step_wait", now - r.t_submit,
                                  request_id=r.ticket.request_id,
-                                 steps=slot.bank.n)
+                                 steps=slot.bank.n,
+                                 trace_id=r.trace_id,
+                                 parent_id=reqtrace.root_span_id(
+                                     r.trace_id),
+                                 swap_drains=r.swap_drains)
         return True
 
     def _place(self, tree, bucket: int):
@@ -1664,8 +1807,24 @@ class SamplingService:
         jax.block_until_ready(z_next)
         elapsed = time.perf_counter() - t0
         entry["warm"] = True
+        # Rider attribution (obs/reqtrace.py contract): ONE row per
+        # dispatch naming every rider, the service-global dispatch
+        # ordinal, and the step debt ENTERING this dispatch — per-request
+        # timelines are joined offline, so tracing cost doesn't scale
+        # with batch size.
+        debt_in = sum(
+            (s.t + 1) + ((s.req.num_frames - s.frame_index - 1)
+                         * s.bank.n if s.is_traj else 0)
+            for s in ring)
+        for s in ring:
+            s.req.rides += 1
         self.tracer.add_span("compile" if cold else "ring_step", elapsed,
-                             bucket=bucket, batch_n=n)
+                             bucket=bucket, batch_n=n,
+                             dispatch=self.dispatches,
+                             riders=",".join(
+                                 str(s.req.ticket.request_id)
+                                 for s in ring),
+                             debt=debt_in)
         self.stats.record_span("ring_step", elapsed)
         # In-ring anomaly quarantine: the step program's third output is
         # a per-row finite mask (a device-side reduce — the host reads a
@@ -1806,6 +1965,12 @@ class SamplingService:
             self._traj_exit()
         else:
             req.ticket._fail(SampleAnomaly(msg))
+        self._respond_span(
+            req, "anomaly", steps_done=slot.steps_done,
+            frames_done=slot.frame_index if slot.is_traj else None)
+        self.flight.dump("anomaly", request_id=req.ticket.request_id,
+                         dispatch=self.dispatches,
+                         steps_done=slot.steps_done)
 
     def _frame_boundary(self, slot: _Slot, frame: np.ndarray,
                         frame_dev) -> bool:
@@ -1835,6 +2000,9 @@ class SamplingService:
                 f"frames ({waited * 1e3:.1f}ms elapsed); completed "
                 "frames attached",
                 frames=done_frames, frame_index=slot.frame_index))
+            self._respond_span(req, "expired",
+                               steps_done=slot.steps_done,
+                               frames_done=slot.frame_index)
             self._traj_exit()
             return False
         slot.t = slot.bank.n - 1
@@ -1859,7 +2027,10 @@ class SamplingService:
                              request_id=req.ticket.request_id,
                              frame_index=slot.frame_index,
                              steps=slot.bank.n,
-                             model_version=slot.version)
+                             model_version=slot.version,
+                             trace_id=req.trace_id,
+                             parent_id=reqtrace.root_span_id(
+                                 req.trace_id))
         self.stats.record_span("trajectory_frame", dur)
         self._frames_count += 1
         self._frames_total.inc()
@@ -1892,8 +2063,13 @@ class SamplingService:
         if slot.compile_s:
             self.stats.record_span("compile", slot.compile_s)
         self.tracer.add_span("queue_wait", qw,
-                             request_id=req.ticket.request_id)
+                             request_id=req.ticket.request_id,
+                             trace_id=req.trace_id,
+                             parent_id=reqtrace.root_span_id(
+                                 req.trace_id))
         req.ticket._complete(timing)
+        self._respond_span(req, "ok", steps_done=slot.steps_done,
+                           frames_done=req.num_frames)
         self.stats.count_requests(1)
         self._requests_total.inc(1)
         self._traj_exit()
@@ -1917,8 +2093,12 @@ class SamplingService:
         if slot.compile_s:
             self.stats.record_span("compile", slot.compile_s)
         self.tracer.add_span("queue_wait", qw,
-                             request_id=req.ticket.request_id)
+                             request_id=req.ticket.request_id,
+                             trace_id=req.trace_id,
+                             parent_id=reqtrace.root_span_id(
+                                 req.trace_id))
         req.ticket._resolve(image, timing)
+        self._respond_span(req, "ok", steps_done=slot.steps_done)
         self.stats.count_requests(1)
         self._requests_total.inc(1)
 
@@ -1972,6 +2152,7 @@ class SamplingService:
                 r.ticket._fail(DeadlineExceeded(
                     f"request waited {waited * 1e3:.1f}ms, deadline was "
                     f"{r.deadline_s * 1e3:.0f}ms"))
+                self._respond_span(r, "expired")
             else:
                 live.append(r)
         return live
@@ -2049,8 +2230,14 @@ class SamplingService:
         elapsed = time.perf_counter() - t0
         entry["warm"] = True
         span = "compile" if cold else "device"
+        for r in group:
+            r.swap_drains = self._swaps - r.swaps_at_submit
+            r.rides += 1
         self.tracer.add_span(span, elapsed, bucket=bucket, batch_n=n,
-                             model_version=version)
+                             model_version=version,
+                             dispatch=self.dispatches,
+                             riders=",".join(str(r.ticket.request_id)
+                                             for r in group))
         with self.tracer.span("respond", batch_n=n,
                               model_version=version):
             for i, r in enumerate(group):
@@ -2067,8 +2254,11 @@ class SamplingService:
                 self.stats.record_span(span, elapsed)
                 self.tracer.add_span(
                     "queue_wait", timing["queue_wait_s"],
-                    request_id=r.ticket.request_id)
+                    request_id=r.ticket.request_id,
+                    trace_id=r.trace_id,
+                    parent_id=reqtrace.root_span_id(r.trace_id))
                 r.ticket._resolve(imgs[i], timing)
+                self._respond_span(r, "ok", steps_done=int(steps))
         self.stats.count_requests(n)
         self._requests_total.inc(n)
 

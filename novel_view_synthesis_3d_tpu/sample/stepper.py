@@ -167,10 +167,24 @@ class ScheduleBank:
         self._config = config
         self._banks: Dict[int, StepBank] = {}
         self._lock = threading.Lock()
+        # Build/hit counters: a bank build is a host-side schedule
+        # respace (cheap, but each one is a NEW step count seen — the
+        # service summary surfaces them so a bench run can show its
+        # step-class mix at a glance).
+        self.builds = 0
+        self.hits = 0
 
     def get(self, steps: int) -> StepBank:
         with self._lock:
             bank = self._banks.get(steps)
             if bank is None:
                 bank = self._banks[steps] = StepBank(self._config, steps)
+                self.builds += 1
+            else:
+                self.hits += 1
             return bank
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"banks_built": self.builds, "bank_hits": self.hits,
+                    "step_classes": sorted(self._banks)}

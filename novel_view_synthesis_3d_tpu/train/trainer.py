@@ -529,6 +529,13 @@ class Trainer:
             f"{diagnosis_path}"
             + ("; degrading (save retries continue)" if degrade
                else "; checkpoint-and-exit requested"))
+        # Flight-recorder dump next to the watchdog's stall bundle: the
+        # last ~512 spans/events/gauges BEFORE the stall (no JAX calls —
+        # safe on the monitor thread).
+        if self.telemetry.flight is not None:
+            self.telemetry.flight.dump(
+                "stall", phase=phase, step=self.step_host_estimate,
+                degrade=degrade)
         if not degrade:
             self._stalled = True
 
@@ -795,6 +802,16 @@ class Trainer:
             self._make_device_batch, depth=self.config.data.prefetch)
         try:
             self._train_loop(tcfg, last_metrics, profiling)
+        except BaseException as exc:
+            # Fatal exit (incl. KeyboardInterrupt/SystemExit): dump the
+            # flight ring BEFORE the telemetry teardown below, so the
+            # postmortem has the last spans/events leading into the
+            # fault even when the process is about to die.
+            if self.telemetry.flight is not None:
+                self.telemetry.flight.dump(
+                    "fatal", error=repr(exc)[:200],
+                    step=self.step_host_estimate)
+            raise
         finally:
             self._prefetcher.stop()
             self._prefetcher = None

@@ -1,0 +1,171 @@
+"""Perf-regression sentry (tools/bench_sentry.py) rc contract: the
+real banked BENCH_r01–r09 archive must trip on r09 (vs_baseline=0.973
+landed with rc=0 and nobody noticed — the motivating miss), synthetic
+improving trajectories must exit 0, infra rounds (rc=3 probe refusals,
+rc=124 timeouts, torn JSON) are skipped not judged, and the MULTICHIP
+contract flags ok=false / skipped / mesh shrink."""
+
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO_ROOT, "tools")
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture()
+def sentry(monkeypatch):
+    monkeypatch.syspath_prepend(TOOLS)
+    import bench_sentry
+
+    return bench_sentry
+
+
+def bank(dirpath, prefix, n, doc):
+    with open(os.path.join(str(dirpath),
+                           f"{prefix}_r{n:02d}.json"), "w") as fh:
+        json.dump(doc, fh)
+
+
+def bench_doc(vs, rc=0, lane="cpu"):
+    parsed = None if vs is None else {"vs_baseline": vs, "lane": lane}
+    return {"rc": rc, "parsed": parsed}
+
+
+def chip_doc(n_devices=8, ok=True, skipped=False, rc=0):
+    return {"rc": rc, "n_devices": n_devices, "ok": ok,
+            "skipped": skipped}
+
+
+# ---------------------------------------------------------------------------
+# The real archive: the miss this tool exists to catch
+# ---------------------------------------------------------------------------
+def test_real_archive_flags_r09(sentry, capsys):
+    verdict = sentry.judge(REPO_ROOT)
+    nb = verdict["newest_bench"]
+    assert nb["round"] == 9 and nb["regressed"]
+    assert nb["vs_baseline"] == pytest.approx(0.973)
+    assert "< 1.0" in nb["note"]
+    # Infra rounds (r02 timeout, r03-r05 probe refusals) were skipped,
+    # not judged against the trajectory.
+    skipped = [p["round"] for p in verdict["bench"] if not p["judged"]]
+    assert set(skipped) >= {2, 3, 4, 5}
+    # MULTICHIP r01-r05 all demonstrated the full mesh.
+    nm = verdict["newest_multichip"]
+    assert nm is not None and not nm["regressed"]
+    assert verdict["regressed"]
+    assert sentry.main(["--dir", REPO_ROOT]) == sentry.REGRESSION_RC
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_regression_rc_distinct_from_infra_rc(sentry):
+    """rc=4 is the sentry's page; bench.py owns rc=3 (probe refusal)
+    and the shell owns rc=124 (timeout) — conflating them would page
+    the wrong on-call."""
+    assert sentry.REGRESSION_RC == 4
+    assert sentry.REGRESSION_RC not in (0, 3, 124)
+
+
+# ---------------------------------------------------------------------------
+# Synthetic trajectories
+# ---------------------------------------------------------------------------
+def test_improving_trajectory_exits_zero(sentry, tmp_path):
+    for n, vs in ((1, 1.01), (2, 1.05), (3, 1.08)):
+        bank(tmp_path, "BENCH", n, bench_doc(vs))
+    assert sentry.main(["--dir", str(tmp_path)]) == 0
+    verdict = sentry.judge(str(tmp_path))
+    assert all(p["judged"] and not p["regressed"]
+               for p in verdict["bench"])
+
+
+def test_empty_archive_is_not_a_regression(sentry, tmp_path):
+    assert sentry.main(["--dir", str(tmp_path)]) == 0
+    verdict = sentry.judge(str(tmp_path))
+    assert verdict["newest_bench"] is None and not verdict["regressed"]
+
+
+def test_sub_one_vs_baseline_regresses_absolutely(sentry, tmp_path):
+    bank(tmp_path, "BENCH", 1, bench_doc(1.05))
+    bank(tmp_path, "BENCH", 2, bench_doc(0.99))
+    assert sentry.main(["--dir", str(tmp_path)]) == sentry.REGRESSION_RC
+
+
+def test_only_the_newest_round_pages(sentry, tmp_path):
+    """An old regression already had its round to page; the sentry
+    judges the NEWEST judgeable round only."""
+    bank(tmp_path, "BENCH", 1, bench_doc(0.90))
+    bank(tmp_path, "BENCH", 2, bench_doc(1.20))
+    assert sentry.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_median_drift_regresses_above_one(sentry, tmp_path):
+    """vs_baseline >= 1.0 can still regress: drifting more than the
+    tolerance below the rolling median of its own trajectory."""
+    for n, vs in ((1, 1.10), (2, 1.12), (3, 1.10), (4, 1.05)):
+        bank(tmp_path, "BENCH", n, bench_doc(vs))
+    assert sentry.main(["--dir", str(tmp_path)]) == sentry.REGRESSION_RC
+    verdict = sentry.judge(str(tmp_path))
+    assert "median" in verdict["newest_bench"]["note"]
+    # A wider tolerance waves the same drift through.
+    assert sentry.main(["--dir", str(tmp_path),
+                        "--tolerance-pct", "10"]) == 0
+
+
+def test_infra_and_torn_rounds_skipped(sentry, tmp_path):
+    bank(tmp_path, "BENCH", 1, bench_doc(1.05))
+    bank(tmp_path, "BENCH", 2, bench_doc(None, rc=3))    # probe refusal
+    bank(tmp_path, "BENCH", 3, bench_doc(None, rc=124))  # timeout
+    with open(os.path.join(str(tmp_path), "BENCH_r04.json"), "w") as fh:
+        fh.write('{"rc": 0, "parsed": {"vs_ba')  # torn mid-write
+    bank(tmp_path, "BENCH", 5, bench_doc(1.06))
+    assert sentry.main(["--dir", str(tmp_path)]) == 0
+    verdict = sentry.judge(str(tmp_path))
+    by_round = {p["round"]: p for p in verdict["bench"]}
+    for n in (2, 3, 4):
+        assert not by_round[n]["judged"]
+        assert "infra" in by_round[n]["note"]
+    assert by_round[5]["judged"] and not by_round[5]["regressed"]
+
+
+def test_fresh_vs_judged_as_newest_round(sentry, tmp_path):
+    """bench.py hands its just-measured vs_baseline to the sentry
+    BEFORE banking: the un-banked datapoint is judged as round N+1."""
+    bank(tmp_path, "BENCH", 1, bench_doc(1.05))
+    good = sentry.judge(str(tmp_path), fresh_vs=1.06)
+    assert not good["regressed"]
+    assert good["newest_bench"]["lane"] == "fresh"
+    bad = sentry.judge(str(tmp_path), fresh_vs=0.98)
+    assert bad["regressed"]
+    assert sentry.main(["--dir", str(tmp_path),
+                        "--fresh-vs", "0.98"]) == sentry.REGRESSION_RC
+
+
+# ---------------------------------------------------------------------------
+# MULTICHIP contract
+# ---------------------------------------------------------------------------
+def test_multichip_mesh_shrink_flagged(sentry, tmp_path):
+    bank(tmp_path, "MULTICHIP", 1, chip_doc(n_devices=8))
+    bank(tmp_path, "MULTICHIP", 2, chip_doc(n_devices=4))
+    assert sentry.main(["--dir", str(tmp_path)]) == sentry.REGRESSION_RC
+    verdict = sentry.judge(str(tmp_path))
+    assert "shrank 8 -> 4" in verdict["newest_multichip"]["note"]
+
+
+def test_multichip_ok_and_skipped_contract(sentry, tmp_path):
+    bank(tmp_path, "MULTICHIP", 1, chip_doc())
+    bank(tmp_path, "MULTICHIP", 2, chip_doc(ok=False))
+    assert sentry.main(["--dir", str(tmp_path)]) == sentry.REGRESSION_RC
+    bank(tmp_path, "MULTICHIP", 3, chip_doc(skipped=True))
+    assert sentry.main(["--dir", str(tmp_path)]) == sentry.REGRESSION_RC
+    bank(tmp_path, "MULTICHIP", 4, chip_doc())
+    assert sentry.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_multichip_infra_round_not_judged(sentry, tmp_path):
+    bank(tmp_path, "MULTICHIP", 1, chip_doc())
+    bank(tmp_path, "MULTICHIP", 2, chip_doc(rc=1, ok=False))
+    # rc!=0 is infra: the newest JUDGEABLE round is the healthy r01.
+    assert sentry.main(["--dir", str(tmp_path)]) == 0

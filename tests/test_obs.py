@@ -471,6 +471,73 @@ def test_memory_and_bubble_gauges_route_through_bus():
                for e in emitters)
 
 
+def test_reqtrace_slo_writer_surfaces_route_through_bus():
+    """The request-trace spans (request_submit/request_respond), the
+    SLO breach events + nvs3d_slo_* gauges, and the flight-dump path
+    (PR 14) are NEW writer surfaces — every module outside obs/ that
+    names one must route through the tracer/bus (the walk above
+    already bans the telemetry-file literals), never a private csv
+    path; and the trace/SLO writer the DESIGN doc promises lives in
+    the sampling service."""
+    import novel_view_synthesis_3d_tpu as pkg
+
+    pkg_root = os.path.dirname(os.path.abspath(pkg.__file__))
+    names = ("request_submit", "request_respond", "slo_breach",
+             "slo_recovered", "nvs3d_slo_attainment",
+             "nvs3d_slo_burn_rate", "nvs3d_slo_breach")
+    emitters = []
+    for root, _, files in os.walk(pkg_root):
+        if os.path.basename(root) == "obs":
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+            names_surface = imports_csv = False
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in names):
+                    names_surface = True
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    mod = getattr(node, "module", None) or ""
+                    if "csv" in [a.name for a in node.names] \
+                            or mod == "csv":
+                        imports_csv = True
+            if names_surface:
+                rel = os.path.relpath(path, pkg_root)
+                emitters.append(rel)
+                assert not imports_csv, (
+                    f"{rel} names trace/SLO surfaces AND imports csv — "
+                    "telemetry writes belong to obs.bus only")
+                assert "tracer" in src or "obs." in src \
+                    or "event_cb" in src, (
+                        f"{rel} names trace/SLO surfaces but has no "
+                        "bus-routed path")
+    assert any(e.endswith(os.path.join("sample", "service.py"))
+               for e in emitters)
+    # The new obs writer modules themselves never open the csv files:
+    # reqtrace/slo/flight produce spans, gauges, and their own JSON
+    # dumps — events.csv/metrics.csv stay the bus's alone.
+    obs_dir = os.path.dirname(os.path.abspath(obs.__file__))
+    for fn in ("reqtrace.py", "slo.py", "flight.py"):
+        tree = ast.parse(open(os.path.join(obs_dir, fn)).read(),
+                         filename=fn)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod = getattr(node, "module", None) or ""
+                assert "csv" not in [a.name for a in node.names] \
+                    and mod != "csv", f"obs/{fn} must not import csv"
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                assert node.value not in ("events.csv", "metrics.csv"), (
+                    f"obs/{fn} names {node.value} — only bus.py opens "
+                    "the csv sinks")
+
+
 # ---------------------------------------------------------------------------
 # Device monitor / MFU
 # ---------------------------------------------------------------------------

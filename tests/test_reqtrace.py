@@ -1,0 +1,446 @@
+"""Request-scoped tracing + always-on flight recorder (PR 14,
+docs/DESIGN.md "Request tracing, SLOs & flight recorder").
+
+Two acceptance contracts pinned at tier-1:
+
+  - every COMPLETED request is reconstructable from telemetry.jsonl
+    alone (obs/reqtrace.py) — including under concurrent mixed
+    single-shot + trajectory traffic, where requests share dispatches
+    as co-riders — and tracing compiles nothing (the zero-recompile
+    host-side invariant);
+  - every chaos failure class (anomaly quarantine, worker restart,
+    drain timeout, wedged-worker stall, trainer fatal) produces a
+    ``flight_<reason>_<n>.json`` dump whose LAST entries include the
+    event that triggered it.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu import obs
+from novel_view_synthesis_3d_tpu.config import (
+    DiffusionConfig,
+    ModelConfig,
+    ObsConfig,
+    ServeConfig,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+from novel_view_synthesis_3d_tpu.obs import reqtrace
+from novel_view_synthesis_3d_tpu.sample.service import (
+    SampleAnomaly,
+    SamplingService,
+    request_cond_from_batch,
+)
+from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.smoke]
+
+TINY = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                   attn_resolutions=(8,), dropout=0.0)
+T = 3
+S = 16
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T)
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=4, sidelength=S, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((4,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((4,)), train=False)["params"]
+    conds = [request_cond_from_batch(mb, i) for i in range(4)]
+    return model, params, dcfg, conds
+
+
+def make_service(setup, tmp, **serve_kw):
+    model, params, dcfg, _ = setup
+    kw = dict(scheduler="step", max_batch=4, flush_timeout_ms=5.0,
+              queue_depth=64, k_max=4)
+    kw.update(serve_kw)
+    return SamplingService(model, params, dcfg, ServeConfig(**kw),
+                           results_folder=str(tmp))
+
+
+def make_traced_service(setup, tmp, **serve_kw):
+    """A service wired the way `nvs3d serve` wires it: RunTelemetry's
+    tracer (spans -> bus -> telemetry.jsonl) and its flight ring."""
+    telem = obs.RunTelemetry.create(
+        ObsConfig(device_poll_s=0.0, metrics_port=0), str(tmp),
+        start_server=False)
+    model, params, dcfg, _ = setup
+    kw = dict(scheduler="step", max_batch=4, flush_timeout_ms=5.0,
+              queue_depth=64, k_max=4)
+    kw.update(serve_kw)
+    svc = SamplingService(model, params, dcfg, ServeConfig(**kw),
+                          results_folder=str(tmp),
+                          tracer=telem.tracer, flight=telem.flight)
+    return svc, telem
+
+
+def traj_cond(cond):
+    return {k: cond[k] for k in ("x", "R1", "t1", "K")}
+
+
+def orbit_for(cond, n):
+    return orbit_poses(n, radius=float(np.linalg.norm(cond["t1"])) or 1.0,
+                       elevation=0.3)
+
+
+def warm(svc, cond, *, seed=990):
+    svc.submit(cond, seed=seed).result(timeout=300)
+
+
+def flight_docs(tmp, reason):
+    paths = sorted(glob.glob(os.path.join(str(tmp),
+                                          f"flight_{reason}_*.json")))
+    return [json.load(open(p)) for p in paths]
+
+
+def wait_for_dump(tmp, reason, *, timeout=30.0):
+    """The ticket fails BEFORE the worker writes the dump — the client
+    waking on ticket._fail can out-race the forensics write."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        docs = flight_docs(tmp, reason)
+        if docs:
+            return docs
+        time.sleep(0.05)
+    return flight_docs(tmp, reason)
+
+
+def tail_has_event(doc, kind, *, detail_substr=None, last=15):
+    """The acceptance criterion: the dump's LAST entries include the
+    triggering event row (the _append_event mirror feeds the ring
+    before every dump call)."""
+    for e in doc["entries"][-last:]:
+        if e.get("kind") == "event" and e.get("event") == kind:
+            if detail_substr is None or detail_substr in str(
+                    e.get("detail", "")):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Trace-id minting
+# ---------------------------------------------------------------------------
+def test_mint_sanitizes_client_trace_ids():
+    assert reqtrace.mint(7, "orbit-3") == "orbit-3"
+    assert reqtrace.mint(7, "a.b_C-9") == "a.b_C-9"
+    # Hostile characters are replaced, never passed into filenames/CSV.
+    assert reqtrace.mint(7, "a b/c\nd") == "a_b_c_d"
+    assert len(reqtrace.mint(7, "x" * 200)) == 64
+    # No client id -> deterministic run-local default.
+    assert reqtrace.mint(7, None) == "t-7"
+    assert reqtrace.mint(7, "") == "t-7"
+    assert reqtrace.root_span_id("t-7") == "t-7/0"
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction under concurrent mixed traffic
+# ---------------------------------------------------------------------------
+def test_trace_reconstruction_concurrent_mixed(setup, tmp_path):
+    """Singles (client-named and service-minted trace ids) and
+    trajectories submitted from concurrent threads share ring
+    dispatches; afterwards EVERY completed request reconstructs from
+    telemetry.jsonl alone — causal chain sound, each dispatch ridden
+    exactly once, co-rider counts consistent across riders — and the
+    tracing added zero compiles."""
+    _, _, _, conds = setup
+    svc, telem = make_traced_service(setup, tmp_path)
+    errors = []
+
+    def mixed_round(tag, seed0):
+        """6 concurrent singles (half client-named, half minted) + 2
+        concurrent 2-frame trajectories; returns the trace ids."""
+        expected = set()
+
+        def run_single(i):
+            try:
+                client = f"cli-{tag}-{i}" if i % 2 else None
+                tk = svc.submit(conds[i % 4], seed=seed0 + i,
+                                trace_id=client)
+                expected.add(client or f"t-{tk.request_id}")
+                img = tk.result(timeout=300)
+                assert np.isfinite(img).all()
+            except Exception as e:  # noqa: BLE001 - thread boundary
+                errors.append(repr(e))
+
+        def run_traj(k):
+            try:
+                tk = svc.submit_trajectory(
+                    traj_cond(conds[k]), poses=orbit_for(conds[k], 2),
+                    seed=seed0 + 50 + k, trace_id=f"orbit-{tag}-{k}")
+                expected.add(f"orbit-{tag}-{k}")
+                frames = tk.result(timeout=300)
+                assert len(frames) == 2
+            except Exception as e:  # noqa: BLE001 - thread boundary
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=run_single, args=(i,))
+                   for i in range(6)]
+        threads += [threading.Thread(target=run_traj, args=(k,))
+                    for k in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        return expected
+
+    try:
+        warm(svc, conds[0])
+        svc.submit_trajectory(traj_cond(conds[0]),
+                              poses=orbit_for(conds[0], 1),
+                              seed=3).result(timeout=300)
+        # Round 1 warms every ring-bucket composition the workload can
+        # form; round 2 then pins the zero-recompile contract (tracing
+        # is host-side only — no program identity change).
+        expected = mixed_round("w", 100)
+        before = svc.compile_counters()
+        expected |= mixed_round("a", 200)
+        after = svc.compile_counters()
+        assert after["programs_built"] == before["programs_built"]
+    finally:
+        svc.stop()
+        telem.finalize()
+
+    rows = reqtrace.load_rows(str(tmp_path))
+    timelines = reqtrace.reconstruct(rows)
+    assert reqtrace.verify_timelines(timelines, rows) == []
+
+    assert expected <= set(timelines)
+    for tid, tl in timelines.items():
+        assert tl["complete"], f"{tid} has no request_respond"
+        assert tl["outcome"] == "ok"
+        assert tl["dispatches"], f"{tid} rode no dispatch"
+        assert tl["respond"]["dispatches"] == len(tl["dispatches"])
+    orbits = [tl for tid, tl in timelines.items()
+              if tid.startswith("orbit-")]
+    assert len(orbits) == 4
+    for tl in orbits:
+        assert tl["req_kind"] == "trajectory" and tl["frames"] == 2
+        frames = [s for s in tl["spans"]
+                  if s["name"] == "trajectory_frame"]
+        assert len(frames) == 2
+        assert tl["respond"]["frames_done"] == 2
+    # Co-rider consistency: for each dispatch ordinal, every rider saw
+    # the same co-rider count, and that count IS the number of
+    # timelines that rode it (one shared row fans out losslessly).
+    rode, co = {}, {}
+    for tl in timelines.values():
+        for d in tl["dispatches"]:
+            rode[d["dispatch"]] = rode.get(d["dispatch"], 0) + 1
+            co.setdefault(d["dispatch"], set()).add(d["co_riders"])
+    for disp, n in rode.items():
+        assert co[disp] == {n}, (
+            f"dispatch {disp}: co_riders {co[disp]} != riders {n}")
+    # The human/Perfetto renderings run off the same timelines.
+    text = reqtrace.format_timeline(timelines["orbit-a-0"])
+    assert "respond outcome=ok" in text and "co_riders=" in text
+    out = reqtrace.export_perfetto(
+        timelines["orbit-a-0"], str(tmp_path / "orbit0_track.json"))
+    doc = json.load(open(out))
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert names[0] == "request_submit" and "request_respond" in names
+
+
+def test_failed_request_reconstructs_with_outcome(
+        setup, tmp_path, monkeypatch):
+    """An anomaly-quarantined request still tells its whole story: the
+    respond span carries outcome='anomaly' and the partial ride list
+    matches reconstruction."""
+    _, _, _, conds = setup
+    svc, telem = make_traced_service(setup, tmp_path, anomaly_strikes=1)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT",
+                           f"{svc.dispatches + 2}:0")
+        tk = svc.submit(conds[0], seed=41, trace_id="poisoned")
+        with pytest.raises(SampleAnomaly):
+            tk.result(timeout=300)
+    finally:
+        svc.stop()
+        telem.finalize()
+    rows = reqtrace.load_rows(str(tmp_path))
+    timelines = reqtrace.reconstruct(rows)
+    assert reqtrace.verify_timelines(timelines, rows) == []
+    tl = timelines["poisoned"]
+    assert tl["complete"] and tl["outcome"] == "anomaly"
+    assert tl["respond"]["dispatches"] == len(tl["dispatches"])
+
+
+# ---------------------------------------------------------------------------
+# Flight dumps: one per chaos failure class, trigger in the tail
+# ---------------------------------------------------------------------------
+def test_flight_dump_on_anomaly(setup, tmp_path, monkeypatch):
+    """The self-constructed (no RunTelemetry) service keeps its own
+    flight ring — always on — and the quarantine dumps it with the
+    anomaly event in the tail."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, anomaly_strikes=1)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT",
+                           f"{svc.dispatches + 2}:0")
+        tk = svc.submit(conds[0], seed=41)
+        with pytest.raises(SampleAnomaly):
+            tk.result(timeout=300)
+        docs = wait_for_dump(tmp_path, "anomaly")
+        assert len(docs) == 1
+        doc = docs[0]
+        assert doc["reason"] == "anomaly" and doc["n_entries"] > 0
+        assert doc["context"]["request_id"] == tk.request_id
+        assert tail_has_event(doc, "anomaly",
+                              detail_substr="quarantined")
+        # The ring also held the request's spans, not just events.
+        assert any(e.get("kind") == "span" for e in doc["entries"])
+        assert svc.summary()["flight_dumps"] == 1
+    finally:
+        svc.stop()
+
+
+def test_flight_dump_on_worker_restart(setup, tmp_path, monkeypatch):
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, worker_backoff_s=0.01,
+                       max_worker_restarts=3, max_batch=2)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_WORKER_DIE_AT",
+                           str(svc.dispatches + 1))
+        tickets = [svc.submit(conds[i], seed=21 + i) for i in range(3)]
+        for t in tickets:
+            try:
+                t.result(timeout=300)
+            except Exception:
+                pass
+        assert svc.summary()["worker_restarts"] == 1
+        docs = wait_for_dump(tmp_path, "worker_restart")
+        assert len(docs) == 1
+        assert docs[0]["context"]["exhausted"] is False
+        assert tail_has_event(docs[0], "worker_restart",
+                              detail_substr="supervised restart")
+    finally:
+        svc.stop()
+
+
+def test_flight_dump_on_drain_timeout(setup, tmp_path, monkeypatch):
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path)
+    try:
+        warm(svc, conds[0])
+        monkeypatch.setenv("NVS3D_FI_SERVE_SLOW_STEP",
+                           f"{svc.dispatches + 1}:1.5")
+        tk = svc.submit(conds[0], seed=71)
+        time.sleep(0.3)  # worker asleep inside the dispatch
+        assert svc.drain(timeout_s=0.2) is False
+        with pytest.raises(Exception):
+            tk.result(timeout=30)
+        docs = flight_docs(tmp_path, "drain_timeout")
+        assert len(docs) == 1
+        assert tail_has_event(docs[0], "drain", detail_substr="TIMEOUT")
+    finally:
+        if svc._worker is not None:
+            svc.stop()
+
+
+def test_flight_dump_on_stall(setup, tmp_path, monkeypatch):
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path)
+    warm(svc, conds[0])
+    monkeypatch.setenv("NVS3D_FI_SERVE_SLOW_STEP",
+                       f"{svc.dispatches + 1}:1.5")
+    svc.submit(conds[0], seed=81)
+    time.sleep(0.3)
+    with pytest.raises(RuntimeError, match="still alive"):
+        svc.stop(timeout=0.2)
+    docs = flight_docs(tmp_path, "stall")
+    assert len(docs) == 1
+    assert tail_has_event(docs[0], "stall",
+                          detail_substr="wedged past")
+    time.sleep(1.6)  # let the injected sleep end, then stop clean
+    svc.stop()
+
+
+def test_flight_dump_on_trainer_fatal(tmp_path):
+    """The trainer's except-path dumps a `fatal` flight record before
+    re-raising: the postmortem holds the seconds of telemetry leading
+    into the crash plus the error itself."""
+    from novel_view_synthesis_3d_tpu.config import (
+        Config, TrainConfig)
+    from novel_view_synthesis_3d_tpu.data.pipeline import iter_batches
+    from novel_view_synthesis_3d_tpu.data.srn import SRNDataset
+    from novel_view_synthesis_3d_tpu.data.synthetic import (
+        write_synthetic_srn)
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+    res = tmp_path / "results"
+    cfg = Config(
+        model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
+                          attn_resolutions=()),
+        diffusion=DiffusionConfig(timesteps=10, sample_timesteps=10),
+        train=TrainConfig(batch_size=8, num_steps=4, save_every=100,
+                          log_every=100,
+                          checkpoint_dir=str(tmp_path / "ckpt"),
+                          results_folder=str(res)),
+        obs=ObsConfig(metrics_port=0, device_poll_s=0.0))
+    root = str(tmp_path / "srn")
+    write_synthetic_srn(root, num_instances=2, views_per_instance=4,
+                        image_size=16)
+    ds = SRNDataset(root, img_sidelength=16)
+
+    def poisoned_batches():
+        it = iter_batches(ds, 8, seed=0)
+        yield next(it)
+        yield next(it)
+        raise RuntimeError("injected data-plane failure")
+
+    trainer = Trainer(config=cfg, data_iter=poisoned_batches())
+    with pytest.raises(RuntimeError, match="injected data-plane"):
+        trainer.train()
+    docs = flight_docs(res, "fatal")
+    assert len(docs) == 1
+    assert "injected data-plane failure" in docs[0]["context"]["error"]
+    assert docs[0]["n_entries"] > 0
+
+
+def test_flight_recorder_ring_bounded_and_atomic(tmp_path):
+    """Unit-level: the ring keeps only the newest `capacity` entries
+    (the tail IS the story), dumps are numbered not overwritten, and a
+    dump never leaves a torn temp file behind."""
+    fr = obs.FlightRecorder(str(tmp_path), capacity=16)
+    for i in range(100):
+        fr.record({"kind": "span", "i": i})
+    fr.note("event", event="anomaly", detail="the trigger")
+    entries = fr.entries()
+    assert len(entries) == 16
+    assert entries[-1]["event"] == "anomaly"
+    assert entries[0]["i"] == 85  # oldest surviving row
+    p1 = fr.dump("anomaly", request_id=9)
+    p2 = fr.dump("anomaly", request_id=9)
+    assert os.path.basename(p1) == "flight_anomaly_0.json"
+    assert os.path.basename(p2) == "flight_anomaly_1.json"
+    assert fr.dumps == [p1, p2]
+    doc = json.load(open(p1))
+    assert doc["n_recorded_total"] == 101
+    assert doc["context"] == {"request_id": 9}
+    # Hostile reason strings cannot escape the results folder.
+    p3 = fr.dump("../../etc/passwd")
+    assert os.path.dirname(p3) == str(tmp_path)
+    assert not glob.glob(os.path.join(str(tmp_path), "*.tmp"))

@@ -1,0 +1,203 @@
+"""SLO burn-rate engine (obs/slo.py): target parsing, step-class
+classification, multi-window burn-rate dynamics under a synthetic
+clock (no sleeping through 10-minute windows), breach/recovery events,
+gauge export, and the offline telemetry.jsonl attainment scorer behind
+``nvs3d obs slo``."""
+
+import pytest
+
+from novel_view_synthesis_3d_tpu import obs
+from novel_view_synthesis_3d_tpu.config import SLOConfig
+from novel_view_synthesis_3d_tpu.obs.slo import (
+    SLOEngine,
+    attainment_from_rows,
+    parse_targets,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_engine(spec="4:100,64:1000", **kw):
+    events = []
+    clock = FakeClock()
+    kw.setdefault("objective", 0.99)
+    eng = SLOEngine(targets=parse_targets(spec),
+                    event_cb=lambda k, d: events.append((k, d)),
+                    clock=clock, **kw)
+    return eng, clock, events
+
+
+# ---------------------------------------------------------------------------
+# Declarative targets
+# ---------------------------------------------------------------------------
+def test_parse_targets():
+    assert parse_targets("4:500,64:2000") == {4: 0.5, 64: 2.0}
+    assert parse_targets(" 4 : 500 , 64 : 2000 ") == {4: 0.5, 64: 2.0}
+    assert parse_targets("") == {}
+    assert parse_targets("  ,  ") == {}
+    for bad in ("4", "4:abc", "x:100", "4:100:200"):
+        with pytest.raises(ValueError, match="serve.slo.targets"):
+            parse_targets(bad)
+
+
+def test_slo_config_validated_at_startup():
+    """A targets typo fails config validation, not the first request."""
+    from novel_view_synthesis_3d_tpu.config import Config, ServeConfig
+
+    Config(serve=ServeConfig(slo=SLOConfig(targets="4:500"))).validate()
+    bad = Config(serve=ServeConfig(slo=SLOConfig(targets="4:oops")))
+    with pytest.raises(ValueError, match="serve.slo.targets"):
+        bad.validate()
+
+
+def test_classify():
+    eng, _, _ = make_engine("4:100,64:1000")
+    assert eng.classify(4) == 4 and eng.classify(64) == 64
+    assert eng.classify(10) == 64  # smallest class that covers it
+    assert eng.classify(1) == 4
+    assert eng.classify(1024) == 64  # judged at the loosest budget
+    empty, _, _ = make_engine("")
+    assert empty.classify(4) is None
+    assert not empty.enabled and eng.enabled
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate dynamics (synthetic clock)
+# ---------------------------------------------------------------------------
+def test_latency_miss_and_failure_both_burn_budget():
+    eng, _, _ = make_engine("4:100")
+    eng.record(4, 0.05)                 # within the 100 ms budget
+    eng.record(4, 0.5)                  # ok but over budget -> error
+    eng.record(4, 0.05, ok=False)       # fast but failed -> error
+    snap = eng.snapshot()["4"]
+    assert snap["total"] == 3 and snap["errors"] == 2
+    assert snap["attainment"] == pytest.approx(1 / 3)
+    # burn = error_rate / (1 - objective) = (2/3) / 0.01
+    assert snap["fast_burn"] == pytest.approx((2 / 3) / 0.01)
+
+
+def test_breach_requires_both_windows_and_recovers():
+    """Errors breach while both windows burn; once the fast window
+    clears (the page-worthy condition has passed) the class recovers
+    even though the slow window is still above its threshold — the
+    standard multi-window semantics, testable only because the clock
+    is injectable."""
+    eng, clock, events = make_engine("4:100")
+    for _ in range(5):
+        eng.record(4, 1.0)  # all budget misses at t=0
+    snap = eng.snapshot()["4"]
+    assert snap["breached"] is True
+    assert snap["fast_burn"] >= eng.fast_burn
+    assert snap["slow_burn"] >= eng.slow_burn
+    assert events and events[0][0] == "slo_breach"
+    assert "class=4" in events[0][1]
+    # 2 minutes later: fast window (60 s) holds only the new good
+    # request; slow window (600 s) still holds the 5 errors.
+    clock.t = 120.0
+    eng.record(4, 0.05)
+    snap = eng.snapshot()["4"]
+    assert snap["fast_burn"] == 0.0
+    assert snap["slow_burn"] >= eng.slow_burn  # sustained burn alone
+    assert snap["breached"] is False           # ... does not page
+    assert events[-1][0] == "slo_recovered"
+    assert [k for k, _ in events] == ["slo_breach", "slo_recovered"]
+
+
+def test_fast_blip_alone_does_not_breach():
+    """A short error burst after a long healthy stretch: the fast
+    window spikes past 14x but the slow window stays under 2x -> no
+    page (the burst has not eaten meaningful budget yet)."""
+    eng, clock, events = make_engine("4:100")
+    for i in range(300):
+        clock.t = i * 2.0  # 598 s of steady good traffic
+        eng.record(4, 0.05)
+    clock.t = 600.0
+    for _ in range(5):
+        eng.record(4, 1.0)  # burst of misses
+    snap = eng.snapshot()["4"]
+    # fast window [540, 600]: 30 goods + 5 errors -> burn 14.3x
+    assert snap["fast_burn"] >= eng.fast_burn
+    # slow window [0, 600]: 300 goods + 5 errors -> burn 1.6x
+    assert snap["slow_burn"] < eng.slow_burn
+    assert snap["breached"] is False and events == []
+
+
+def test_samples_pruned_past_slow_window():
+    eng, clock, _ = make_engine("4:100")
+    for _ in range(5):
+        eng.record(4, 1.0)
+    clock.t = 700.0  # past slow_window_s=600: the errors age out
+    eng.record(4, 0.05)
+    snap = eng.snapshot()["4"]
+    assert snap["total"] == 1 and snap["errors"] == 0
+    assert snap["attainment"] == 1.0 and snap["breached"] is False
+
+
+def test_classes_are_independent():
+    eng, _, _ = make_engine("4:100,64:1000")
+    eng.record(4, 1.0)     # class 4 burns
+    eng.record(64, 0.5)    # class 64 healthy
+    snap = eng.snapshot()
+    assert snap["4"]["errors"] == 1 and snap["64"]["errors"] == 0
+
+
+def test_gauges_exported_per_class_and_window():
+    reg = obs.MetricsRegistry()
+    clock = FakeClock()
+    eng = SLOEngine(targets=parse_targets("4:100"), registry=reg,
+                    clock=clock)
+    eng.record(4, 0.05)
+    eng.record(4, 1.0)
+    samples = {}
+    for line in reg.render_prometheus().splitlines():
+        if line and not line.startswith("#"):
+            key, val = line.rsplit(" ", 1)
+            samples[key] = float(val)
+    assert samples['nvs3d_slo_attainment{step_class="4"}'] == 0.5
+    assert samples[
+        'nvs3d_slo_burn_rate{step_class="4",window="fast"}'] == \
+        pytest.approx(50.0)  # (1/2) / 0.01
+    assert 'nvs3d_slo_burn_rate{step_class="4",window="slow"}' in samples
+    # 50x in both windows -> the breach gauge is up.
+    assert samples['nvs3d_slo_breach{step_class="4"}'] == 1.0
+
+
+def test_event_cb_faults_never_propagate():
+    eng, _, _ = make_engine("4:100")
+    eng._event_cb = lambda k, d: (_ for _ in ()).throw(RuntimeError("x"))
+    for _ in range(5):
+        eng.record(4, 1.0)  # breach transition fires the broken cb
+    assert eng.snapshot()["4"]["breached"] is True
+
+
+# ---------------------------------------------------------------------------
+# Offline attainment (nvs3d obs slo / serve_bench artifact)
+# ---------------------------------------------------------------------------
+def test_attainment_from_rows():
+    rows = [
+        {"kind": "span", "name": "request_respond", "steps": 4,
+         "latency_s": 0.05, "outcome": "ok"},
+        {"kind": "span", "name": "request_respond", "steps": 4,
+         "latency_s": 0.5, "outcome": "ok"},          # budget miss
+        {"kind": "span", "name": "request_respond", "steps": 64,
+         "latency_s": 0.1, "outcome": "anomaly"},     # failure
+        {"kind": "span", "name": "request_respond", "steps": 7,
+         "latency_s": 0.2, "outcome": "ok"},          # classed as 64
+        {"kind": "span", "name": "queue_wait", "dur_s": 0.01},  # noise
+        {"kind": "event", "event": "anomaly"},                  # noise
+        {"kind": "span", "name": "request_respond", "steps": 4,
+         "latency_s": "torn", "outcome": "ok"},       # tolerated
+    ]
+    snap = attainment_from_rows(rows, parse_targets("4:100,64:1000"))
+    assert snap["4"]["total"] == 2 and snap["4"]["errors"] == 1
+    assert snap["4"]["attainment"] == 0.5
+    assert snap["64"]["total"] == 2 and snap["64"]["errors"] == 1
+    assert snap["64"]["target_ms"] == 1000.0

@@ -253,7 +253,7 @@ def cpu_lane_lines(repo_root: str):
                          parsed.get("update_sharding", "-"),
                          parsed.get("pipeline_stages", "-")))
             good.append((name, lane, parsed.get("metric"),
-                         parsed.get("value")))
+                         parsed.get("value"), parsed.get("vs_baseline")))
         else:
             rows.append((name, d.get("rc"), "-",
                          "(no parsed datapoint)", None, None, "-", "-",
@@ -280,19 +280,69 @@ def cpu_lane_lines(repo_root: str):
     lines.append("")
     if good:
         by_lane = {}
-        for name, lane, metric, value in good:
+        regressions = []  # sub-1.0x rounds — named LOUDLY, not buried
+        for name, lane, metric, value, vsb in good:
+            short = name.replace("BENCH_", "").replace(".json", "")
+            flag = ""
+            if isinstance(vsb, (int, float)) and vsb < 1.0:
+                flag = " [REGRESSION]"
+                regressions.append(f"{short} (vs_baseline={fmt(vsb)})")
             by_lane.setdefault(lane, []).append(
-                f"{name.replace('BENCH_', '').replace('.json', '')} "
-                f"{fmt(value)}")
+                f"{short} {fmt(value)}{flag}")
         for lane, pts in sorted(by_lane.items()):
             lines.append(f"- {lane} lane trajectory: "
                          + " -> ".join(pts))
+        # BENCH_r09 landed 0.973x with rc=0 and nobody noticed — a
+        # sub-1.0x round now gets its own line (and tools/
+        # bench_sentry.py gets its own rc).
+        if regressions:
+            lines.append("- **REGRESSION: sub-1.0x vs_baseline round(s): "
+                         + "; ".join(regressions)
+                         + "** (see tools/bench_sentry.py)")
     else:
         lines.append("- lane trajectory: NO parsed datapoints in any "
                      "round")
     if skipped:
         lines.append("- skipped rounds (no datapoint): "
                      + "; ".join(f"{n} ({r})" for n, r in skipped))
+    return lines
+
+
+def multichip_lines(repo_root: str):
+    """The MULTICHIP_r*.json trajectory: mesh dry-run contract rounds
+    (ok/skipped/n_devices + the mesh line from the tail) — previously
+    banked at the repo root but rendered nowhere."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(repo_root, "MULTICHIP_r*.json")))
+    if not paths:
+        return []
+    lines = ["", "## Multichip trajectory (MULTICHIP_r*.json)", "",
+             "| round | rc | ok | skipped | n_devices | tail |",
+             "|---|---|---|---|---|---|"]
+    problems = []
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            with open(path) as fh:
+                d = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            lines.append(f"| {name} | ? | | | | (malformed archive) |")
+            problems.append(f"{name} (malformed)")
+            continue
+        tail = " ".join(str(d.get("tail", "")).split())[:80]
+        lines.append("| {} | {} | {} | {} | {} | {} |".format(
+            name, d.get("rc"), d.get("ok"), d.get("skipped"),
+            d.get("n_devices"), tail))
+        if d.get("rc") != 0 or not d.get("ok") or d.get("skipped"):
+            problems.append(f"{name} (rc={d.get('rc')} ok={d.get('ok')} "
+                            f"skipped={d.get('skipped')})")
+    lines.append("")
+    if problems:
+        lines.append("- **PROBLEM round(s): " + "; ".join(problems) + "**")
+    else:
+        lines.append(f"- all {len(paths)} rounds ok (dry-run mesh "
+                     "contract held)")
     return lines
 
 
@@ -498,9 +548,11 @@ def main() -> int:
     lines += trajectory_serving_lines(rows)
     # Survivability drill tables for any --chaos artifacts.
     lines += chaos_lines(rows)
-    # The restored CPU-lane trajectory from the repo-root BENCH archives.
-    lines += cpu_lane_lines(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+    # The restored CPU-lane trajectory from the repo-root BENCH archives,
+    # and the multichip dry-run contract trajectory next to it.
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lines += cpu_lane_lines(repo_root)
+    lines += multichip_lines(repo_root)
     # Recovery events: every training metrics.csv under the bench dir (and
     # the quality sibling dirs) that recorded anomaly-guard skips or
     # checkpoint rollbacks. "none" is an explicit claim, not silence.

@@ -165,6 +165,18 @@ def main() -> None:
         else load_matrix(args.matrix)
     out = args.out or out or OUT
     run_watcher(out, matrix, args.max_wait_hours, CACHE)
+    # Post-matrix perf-regression verdict over the banked BENCH_r*/
+    # MULTICHIP_r* archives — printed, never fatal to the watcher (the
+    # matrix artifacts are already banked; the sentry's rc matters when
+    # bench.py itself runs under NVS3D_BENCH_SENTRY=1).
+    try:
+        import bench_sentry
+
+        rc = bench_sentry.main(["--dir", REPO])
+        log(f"bench_sentry verdict rc={rc} "
+            + ("(REGRESSION)" if rc else "(healthy)"))
+    except Exception as e:
+        log(f"bench_sentry skipped: {e}")
 
 
 if __name__ == "__main__":
