@@ -723,16 +723,44 @@ def main():
     # hits the persistent cache when the warm-up has run. Gives the judged
     # line the roofline context VERDICT r2 asked for (MFU, bytes/step) at
     # ~zero extra device time. NVS3D_BENCH_COST=0 disables.
+    from novel_view_synthesis_3d_tpu import obs as _obs
+
     flops = byts = None
+    costmap_rows = []
     if os.environ.get("NVS3D_BENCH_COST", "1") != "0":
         try:
-            flops, byts = _cost_numbers(
-                step.lower(state, device_batch).compile())
+            lowered = step.lower(state, device_batch)
+            # Compile-ledger entry for the bench's one train-step build:
+            # bench rounds on shifting presets are exactly where a
+            # surprise-recompile diff ("batch_size changed", "static
+            # digest changed") pays for itself.
+            _obs.CompileLedger(cfg.train.results_folder).record(
+                "bench_train_step",
+                _obs.fingerprint_args(state, device_batch, static=(
+                    cfg.model, cfg.diffusion, cfg.train, cfg.mesh)),
+                hlo=_obs.hlo_hash(lowered),
+                backend=jax.default_backend())
+            flops, byts = _cost_numbers(lowered.compile())
             # The fused multi-step program's costs cover spd steps.
             flops = flops / spd if flops else flops
             byts = byts / spd if byts else byts
         except Exception as e:  # cost model is bonus context, never fatal
             print(f"note: cost analysis unavailable ({e})", file=sys.stderr)
+        try:
+            # Per-op cost map (obs/compiles.py): FLOPs/bytes per pipeline
+            # op, keyed by the numerics observatory's group labels —
+            # written next to the run's telemetry AND embedded in the
+            # judged JSON so a regression round can be attributed to an
+            # op without rerunning anything.
+            from novel_view_synthesis_3d_tpu.train.trainer import (
+                _sample_model_batch as _smb)
+
+            costmap_rows = _obs.xunet_costmap(cfg, _smb(batch))
+            path = _obs.write_costmap(cfg.train.results_folder,
+                                      costmap_rows)
+            print(f"note: per-op cost map -> {path}", file=sys.stderr)
+        except Exception as e:
+            print(f"note: cost map unavailable ({e})", file=sys.stderr)
 
     # Snapshot params to host BEFORE bench_framework: the jitted step donates
     # `state`, so its device buffers are deleted after the first call.
@@ -823,6 +851,11 @@ def main():
                for k, v in s.items()}
         for name, s in tracer.summary().items()}
     result["telemetry"] = {"spans": spans, "device_memory": mem_snapshot}
+    if costmap_rows:
+        # Per-op attribution rides in the judged record itself: a sentry
+        # trip or a cross-round diff can name the op whose FLOPs moved
+        # without digging up the round's results folder.
+        result["costmap"] = costmap_rows
     _emit(result)
     _run_sentry(result)
 
@@ -844,7 +877,8 @@ def _run_sentry(result: dict) -> None:
         return
     try:
         verdict = bench_sentry.judge(
-            os.path.dirname(os.path.abspath(__file__)), fresh_vs=vs)
+            os.path.dirname(os.path.abspath(__file__)), fresh_vs=vs,
+            fresh_doc=result)
     except Exception as e:  # the sentry must never eat the judged line
         print(f"sentry: skipped ({e})", file=sys.stderr)
         return
@@ -853,6 +887,11 @@ def _run_sentry(result: dict) -> None:
           f"{newest.get('median_prior')} -> "
           + ("REGRESSION" if verdict["regressed"] else "healthy"),
           file=sys.stderr)
+    if verdict["regressed"] and verdict.get("attribution"):
+        # One-line WHERE next to the trip: the span/cost-map group that
+        # moved most vs the banked trajectory.
+        print(f"sentry attribution: {verdict['attribution']}",
+              file=sys.stderr)
     if verdict["regressed"] and os.environ.get(
             "NVS3D_BENCH_SENTRY") == "1":
         sys.exit(bench_sentry.REGRESSION_RC)

@@ -531,6 +531,11 @@ def cmd_serve(args, overrides: List[str]) -> int:
                               tracer=telemetry.tracer,
                               flight=telemetry.flight,
                               model_version=model_version)
+    if telemetry.server is not None:
+        # /healthz progress facts: last_dispatch_age_s + the live
+        # model_version, so a probe (or the registry rollback runbook)
+        # reads the serving plane's heartbeat without scraping.
+        telemetry.server.set_health_provider(service.health_snapshot)
     if store is not None:
         from novel_view_synthesis_3d_tpu.registry import RegistryWatcher
 
@@ -1222,9 +1227,12 @@ def cmd_obs(args, overrides: List[str]) -> int:
     `trace`: reconstruct per-request causal timelines (which dispatches
     a request rode, co-rider counts, step debt, swap drains) and verify
     the trace invariants; `diff`: span-percentile drift between two
-    runs; `slo`: whole-run SLO attainment per step class. No JAX, no
-    device — these read what obs/reqtrace.py defines and the service
-    emitted, so they work on a laptop against rsync'd artifacts.
+    runs; `slo`: whole-run SLO attainment per step class; `numerics`:
+    per-layer-group training stats + spike/anomaly triage from
+    numerics.jsonl; `compiles`: the jit build ledger with recompile
+    culprits from compiles.jsonl. No JAX, no device — these read what
+    obs/ defines and the run emitted, so they work on a laptop against
+    rsync'd artifacts.
     """
     from novel_view_synthesis_3d_tpu.obs import reqtrace
 
@@ -1317,7 +1325,164 @@ def cmd_obs(args, overrides: List[str]) -> int:
                   if s["total"] and s["attainment"] < s["objective"]]
         return 1 if missed else 0
 
+    if sub == "numerics":
+        return _obs_numerics(args)
+
+    if sub == "compiles":
+        return _obs_compiles(args)
+
     raise SystemExit(f"unknown obs command {sub!r}")
+
+
+def _obs_numerics(args) -> int:
+    """Render a run's numerics.jsonl: per-group latest stats, the spike
+    timeline, and anomaly provenance from events.csv. rc=1 when a spike
+    or anomaly is UNRESOLVED — the loss-spike triage runbook's exit code
+    (docs/TPU_VM_SETUP.md)."""
+    from novel_view_synthesis_3d_tpu import obs
+
+    path = obs.numerics_path(args.run)
+    rows, spikes = [], []
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn trailing line
+                if rec.get("kind") == "numerics":
+                    rows.append(rec)
+                elif rec.get("kind") == "numerics_spike":
+                    spikes.append(rec)
+    if not rows:
+        raise SystemExit(
+            f"no numerics rows under {args.run!r} — was the run trained "
+            "with train.numerics.enabled=true?")
+    anomalies = [ev for ev in obs.read_events(args.run)
+                 if ev.get("event") == "anomaly"]
+
+    latest = rows[-1]
+    # A spike is RESOLVED once any later row shows that group's grad
+    # norm back below the spiking sample; otherwise it is still burning.
+    def resolved(spike) -> bool:
+        for row in rows:
+            if row["step"] <= spike["step"]:
+                continue
+            g = row["groups"].get(spike["group"], {})
+            gn = g.get("grad_norm")
+            if gn is not None and gn < spike["grad_norm"]:
+                return True
+        return False
+
+    unresolved_spikes = [s for s in spikes if not resolved(s)]
+    # An anomaly is resolved once a LATER numerics row is clean (every
+    # group finite) — i.e. training demonstrably recovered after it.
+    def clean_after(step: int) -> bool:
+        for row in rows:
+            if row["step"] <= step:
+                continue
+            if all((g.get("nonfinite") or 0) == 0
+                   for g in row["groups"].values()):
+                return True
+        return False
+
+    def anomaly_step(ev) -> int:
+        try:
+            return int(ev.get("step", -1))
+        except (TypeError, ValueError):
+            return -1
+
+    unresolved_anoms = [e for e in anomalies
+                        if not clean_after(anomaly_step(e))]
+
+    if args.json:
+        print(json.dumps({
+            "run": args.run, "rows": len(rows),
+            "last_step": latest["step"], "groups": latest["groups"],
+            "spikes": spikes,
+            "unresolved_spikes": unresolved_spikes,
+            "anomalies": [dict(e) for e in anomalies],
+            "unresolved_anomalies": [dict(e) for e in unresolved_anoms],
+        }))
+        return 1 if unresolved_spikes or unresolved_anoms else 0
+
+    print(f"numerics: {len(rows)} rows, last step {latest['step']} "
+          f"({len(latest['groups'])} layer groups)")
+    print(f"{'group':<16s} {'grad_norm':>10s} {'param_norm':>10s} "
+          f"{'upd_ratio':>10s} {'grad_max':>10s} {'nonfin':>6s}")
+    for label, g in latest["groups"].items():
+        print(f"{label:<16s} {g.get('grad_norm', 0.0):>10.3e} "
+              f"{g.get('param_norm', 0.0):>10.3e} "
+              f"{g.get('update_ratio', 0.0):>10.3e} "
+              f"{g.get('grad_max', 0.0):>10.3e} "
+              f"{int(g.get('nonfinite') or 0):>6d}")
+    if spikes:
+        print(f"\nspike timeline ({len(spikes)}):")
+        for s in spikes:
+            state = ("resolved" if s not in unresolved_spikes
+                     else "UNRESOLVED")
+            print(f"  step {s['step']:>8d} {s['group']:<16s} "
+                  f"z={s['z']:.1f} grad_norm={s['grad_norm']:.3e} "
+                  f"[{state}]")
+    if anomalies:
+        print(f"\nanomaly events ({len(anomalies)}):")
+        for e in anomalies:
+            state = ("resolved" if e not in unresolved_anoms
+                     else "UNRESOLVED")
+            print(f"  step {e.get('step', '?'):>8s} "
+                  f"{e.get('detail', '')} [{state}]")
+    if unresolved_spikes or unresolved_anoms:
+        print(f"\nUNRESOLVED: {len(unresolved_spikes)} spike(s), "
+              f"{len(unresolved_anoms)} anomaly(ies) — triage per "
+              "docs/TPU_VM_SETUP.md 'Loss-spike triage'")
+        return 1
+    return 0
+
+
+def _obs_compiles(args) -> int:
+    """Render a run's compile ledger (compiles.jsonl): every jit build
+    with its wall time and HLO hash, recompiles with the argument that
+    changed. rc=1 when the ledger records any recompile."""
+    from novel_view_synthesis_3d_tpu import obs
+
+    entries = obs.load_ledger(args.run)
+    if not entries:
+        raise SystemExit(
+            f"no compile ledger under {args.run!r} — nothing jit-built "
+            "there, or a pre-ledger run")
+    recompiles = [e for e in entries if e.get("kind") == "recompile"]
+
+    if args.why is not None:
+        if not 1 <= args.why <= len(recompiles):
+            raise SystemExit(
+                f"--why {args.why}: run has {len(recompiles)} "
+                "recompile(s)")
+        e = recompiles[args.why - 1]
+        print(f"recompile {args.why}/{len(recompiles)}: {e['name']}")
+        for line in e.get("diff", []):
+            print(f"  {line}")
+        return 1
+
+    if args.json:
+        print(json.dumps({"run": args.run, "entries": entries,
+                          "recompiles": len(recompiles)}))
+        return 1 if recompiles else 0
+
+    print(f"{'#':>3s} {'kind':<10s} {'name':<18s} {'wall_s':>8s} "
+          f"{'hlo':<12s} changed")
+    for i, e in enumerate(entries):
+        wall = e.get("wall_s")
+        print(f"{i:>3d} {e.get('kind', '?'):<10s} "
+              f"{e.get('name', '?'):<18s} "
+              f"{wall if wall is not None else '':>8} "
+              f"{e.get('hlo_hash', ''):<12s} {e.get('changed', '')}")
+    print(f"{len(entries)} build(s), {len(recompiles)} recompile(s)"
+          + (" — `--why N` shows the Nth recompile's full diff"
+             if recompiles else ""))
+    return 1 if recompiles else 0
 
 
 # ---------------------------------------------------------------------------
@@ -1650,6 +1815,26 @@ def make_parser() -> argparse.ArgumentParser:
     q.add_argument("--targets", default=None,
                    help="step-class targets, e.g. '4:500,64:2000' "
                         "(default: serve.slo.targets from config)")
+
+    q = obs_sub.add_parser(
+        "numerics",
+        help="per-layer-group training numerics from numerics.jsonl: "
+             "latest stats, spike timeline, anomaly provenance; rc=1 "
+             "when a spike/anomaly is unresolved")
+    q.add_argument("run", help="run dir holding numerics.jsonl")
+    q.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+
+    q = obs_sub.add_parser(
+        "compiles",
+        help="compile ledger from compiles.jsonl: every jit build with "
+             "wall time + HLO hash, recompiles diffed to the argument "
+             "that changed; rc=1 when any recompile is recorded")
+    q.add_argument("run", help="run dir holding compiles.jsonl")
+    q.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    q.add_argument("--why", type=int, default=None, metavar="N",
+                   help="show the Nth recompile's full fingerprint diff")
 
     return parser
 

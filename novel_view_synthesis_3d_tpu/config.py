@@ -217,6 +217,36 @@ class WatchdogConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class NumericsConfig:
+    """In-jit per-layer-group numerics observatory (obs/numerics.py;
+    docs/DESIGN.md "Training numerics & compile observatory").
+
+    The train step ALWAYS emits per-group grad norm, param norm,
+    update/param RMS ratio, grad max-abs, and non-finite leaf counts as
+    READ-ONLY (G,)-shaped reductions grouped by the pipeline op list
+    (models/xunet.pipeline_op_specs); `enabled` gates only the HOST-side
+    consumer (numerics.jsonl rows, `nvs3d_grad_norm{group=...}` gauges,
+    the EWMA spike detector's `numerics_spike` events). That split is
+    the contract: flipping `enabled` is bitwise identical with zero
+    recompiles by construction — one step program either way, with
+    host-side decimation per `every`."""
+
+    # Host-side publication switch. The device-side reductions are a
+    # fixed part of the step program (see the module docstring).
+    enabled: bool = False
+    # Host-side decimation: device_get + publish the per-group stats every
+    # N steps. The device-side reductions run every step either way (same
+    # XLA program regardless); this only bounds host traffic.
+    every: int = 1
+    # EWMA spike detector: flag a group whose grad norm sits more than
+    # this many EWMA standard deviations above its running mean.
+    spike_z: float = 6.0
+    # Decay of the per-group EWMA mean/variance the z-score is computed
+    # against (0.9 ≈ a ~10-sample window).
+    ewma_decay: float = 0.9
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """Training loop options (reference: train.py:82-126)."""
 
@@ -365,6 +395,10 @@ class TrainConfig:
     # Heartbeat watchdog over the run's phases (utils/watchdog.py).
     watchdog: WatchdogConfig = dataclasses.field(
         default_factory=WatchdogConfig)
+    # Per-layer-group numerics observatory (obs/numerics.py): read-only,
+    # bitwise-neutral, zero-recompile stats over the train step.
+    numerics: NumericsConfig = dataclasses.field(
+        default_factory=NumericsConfig)
     # `nvs3d train --supervise` restart budget: the supervisor restarts a
     # crashed or watchdog-stalled child (resuming via the checkpoint
     # integrity walk-back) at most this many times, with exponential
@@ -929,6 +963,19 @@ class Config:
         if t.max_restarts < 0:
             errors.append(
                 f"train.max_restarts={t.max_restarts} must be >= 0")
+        nc = t.numerics
+        if nc.every < 1:
+            errors.append(
+                f"train.numerics.every={nc.every} must be >= 1 (host-side "
+                "decimation period for the per-group stats)")
+        if nc.spike_z <= 0:
+            errors.append(
+                f"train.numerics.spike_z={nc.spike_z} must be > 0 (EWMA "
+                "z-score threshold for numerics_spike events)")
+        if not 0.0 < nc.ewma_decay < 1.0:
+            errors.append(
+                f"train.numerics.ewma_decay={nc.ewma_decay} must be in "
+                "(0, 1)")
         wd = t.watchdog
         if wd.check_interval_s <= 0:
             errors.append(

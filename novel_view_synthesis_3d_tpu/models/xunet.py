@@ -269,6 +269,22 @@ def pipeline_op_specs(cfg: ModelConfig):
     return specs
 
 
+def op_groups(cfg: ModelConfig):
+    """Ordered (label, param_names) layer groups for the numerics
+    observatory (obs/numerics.py) — one group per pipeline op.
+
+    Labels are the op's explicit flax module name (stable across builds
+    by construction of pipeline_op_specs), except the multi-module
+    prelude/final ops which keep their kind as the label. Together the
+    groups partition the top-level param-tree keys exactly.
+    """
+    groups = []
+    for kind, info in pipeline_op_specs(cfg):
+        label = kind if kind in ("prelude", "final") else info["name"]
+        groups.append((label, tuple(info["param_names"])))
+    return groups
+
+
 class XUNet(nn.Module):
     """The X-UNet (reference model/xunet.py:205-280), config-driven.
 

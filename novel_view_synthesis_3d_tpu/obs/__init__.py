@@ -29,10 +29,32 @@ from novel_view_synthesis_3d_tpu.obs.bus import (  # noqa: F401
     EVENTS_HEADER,
     EventBus,
     append_event,
+    events_csv_path,
+    read_events,
+    numerics_path,
+)
+from novel_view_synthesis_3d_tpu.obs.compiles import (  # noqa: F401
+    CompileLedger,
+    compiles_path,
+    fingerprint_args,
+    fingerprint_diff,
+    hlo_hash,
+    last_recompile,
+    load_costmap,
+    load_ledger,
+    write_costmap,
+    xunet_costmap,
 )
 from novel_view_synthesis_3d_tpu.obs.flight import (  # noqa: F401
     FlightRecorder,
     NullFlightRecorder,
+)
+from novel_view_synthesis_3d_tpu.obs.numerics import (  # noqa: F401
+    NumericsMonitor,
+    first_bad_group,
+    group_assignment,
+    group_labels,
+    group_stats,
 )
 from novel_view_synthesis_3d_tpu.obs.registry import (  # noqa: F401
     MetricsRegistry,

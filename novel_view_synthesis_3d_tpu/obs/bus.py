@@ -49,6 +49,7 @@ EVENTS_HEADER = ("step", "event", "detail", "model_version")
 _METRICS_FILE = "metrics.csv"
 _EVENTS_FILE = "events.csv"
 _JSONL_FILE = "telemetry.jsonl"
+_NUMERICS_FILE = "numerics.jsonl"
 
 
 def metrics_csv_path(results_folder: str) -> str:
@@ -61,6 +62,10 @@ def events_csv_path(results_folder: str) -> str:
 
 def jsonl_path(results_folder: str) -> str:
     return os.path.join(results_folder, _JSONL_FILE)
+
+
+def numerics_path(results_folder: str) -> str:
+    return os.path.join(results_folder, _NUMERICS_FILE)
 
 
 class _CsvTable:
@@ -126,6 +131,18 @@ def append_event(results_folder: str, step: int, kind: str,
               + (f" ({detail})" if detail else ""), flush=True)
 
 
+def read_events(results_folder: str) -> list:
+    """events.csv rows as dicts keyed by column name (tolerates the
+    pre-model_version 3-column schema — missing columns read as "").
+    Readers live here with the writer so the schema has one home;
+    returns [] when the run never emitted an event."""
+    path = events_csv_path(results_folder)
+    if not os.path.exists(path):
+        return []
+    with open(path, newline="") as fh:
+        return [dict(row) for row in csv.DictReader(fh)]
+
+
 class EventBus:
     """Per-run telemetry fan-out over one results folder.
 
@@ -151,6 +168,7 @@ class EventBus:
         self._lock = threading.Lock()
         self._metrics: Optional[_CsvTable] = None
         self._jsonl_fh: Optional[IO] = None
+        self._numerics_fh: Optional[IO] = None
 
     # -- metrics.csv ---------------------------------------------------
     def metrics_row(self, header: Sequence[str], row: Sequence) -> None:
@@ -203,6 +221,31 @@ class EventBus:
                 self._jsonl_fh = None
                 os.replace(path, path + ".old")
 
+    # -- numerics.jsonl ------------------------------------------------
+    def numerics_row(self, obj: dict) -> None:
+        """One numerics.jsonl row (per-layer-group stats / spike records,
+        obs/numerics.py). Its own sink: the producer opted in via
+        train.numerics.enabled, so rows write even when the general JSONL
+        sink is off — but the flight-recorder tap still sees every row
+        first, same as jsonl_row."""
+        row = dict(obj, t=round(time.time(), 3))
+        if self.tap is not None:
+            try:
+                self.tap(row)
+            except Exception:
+                pass  # a forensics sink fault is never the run's fault
+        try:
+            line = json.dumps(row)
+        except (TypeError, ValueError):
+            return  # non-serializable telemetry is dropped, never fatal
+        with self._lock:
+            if self._numerics_fh is None:
+                os.makedirs(self.results_folder, exist_ok=True)
+                self._numerics_fh = open(
+                    numerics_path(self.results_folder), "a")
+            self._numerics_fh.write(line + "\n")
+            self._numerics_fh.flush()
+
     def span_record(self, rec: dict) -> None:
         """JSONL row for one tracer span record: {"kind":"span", name,
         dur_s, ...attrs} — what summarize_bench's percentile section
@@ -227,3 +270,6 @@ class EventBus:
             if self._jsonl_fh is not None:
                 self._jsonl_fh.close()
                 self._jsonl_fh = None
+            if self._numerics_fh is not None:
+                self._numerics_fh.close()
+                self._numerics_fh = None

@@ -1,0 +1,220 @@
+"""Per-layer-group training numerics (docs/DESIGN.md "Training numerics
+& compile observatory").
+
+Two halves, split exactly at the device/host boundary:
+
+  - ``group_stats`` runs INSIDE the jitted train step (train/step.py):
+    per-layer-group grad norm, param norm, update/param RMS ratio, grad
+    max-abs, and non-finite value counts, grouped by the pipeline op list
+    (models/xunet.op_groups — one group per op, so numerics attribution
+    and pipeline staging speak the same vocabulary). The reductions are
+    READ-ONLY and ALWAYS traced into the step program — the
+    ``train.numerics.enabled`` flag gates only the host-side consumer
+    below, so enabling stats is bitwise identical with zero recompiles
+    by construction (there is exactly one program either way;
+    decimation is host-side).
+  - ``NumericsMonitor`` runs on the HOST (trainer loop): decimates per
+    ``train.numerics.every``, publishes rows to the EventBus's
+    numerics.jsonl sink and ``nvs3d_grad_norm{group}`` gauges, and runs
+    per-group EWMA spike detection (``numerics_spike`` events with
+    z-score + group).
+
+Module-load constraint: no jax imports at the top level — the obs
+package must stay importable by the jax-free supervisor process. jax is
+imported lazily inside the traced helpers.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Stat names emitted per group, in row order. "nonfinite" is an int
+# count of non-finite gradient values in the group; the rest are f32.
+STAT_KEYS = ("grad_norm", "param_norm", "update_ratio", "grad_max",
+             "nonfinite")
+
+# EWMA warmup: a group needs this many accepted samples before the spike
+# detector may flag it (an unseeded variance would z-score everything).
+MIN_SPIKE_SAMPLES = 5
+
+
+def group_labels(groups: Sequence[Tuple[str, Sequence[str]]]) -> List[str]:
+    return [label for label, _ in groups]
+
+
+def group_assignment(groups: Sequence[Tuple[str, Sequence[str]]],
+                     param_keys: Sequence[str]) -> Dict[str, int]:
+    """Map each top-level param-tree key to its group index.
+
+    Raises loudly (at step-build/trace time, not mid-run) if the param
+    tree holds a key no group claims — a model change that outgrew the
+    op list must fail the build, not silently misattribute stats."""
+    assign: Dict[str, int] = {}
+    for gi, (label, names) in enumerate(groups):
+        for name in names:
+            assign[name] = gi
+    unknown = sorted(k for k in param_keys if k not in assign)
+    if unknown:
+        raise ValueError(
+            f"numerics: param keys {unknown} not claimed by any layer "
+            "group — models/xunet.op_groups is out of sync with the "
+            "param tree")
+    return assign
+
+
+def group_stats(assign: Dict[str, int], num_groups: int, *,
+                grads, params, new_params) -> dict:
+    """Traced per-group reductions; call inside the jitted train step.
+
+    Returns {stat: (G,) array}. `params` is the pre-update tree,
+    `new_params` the post-update tree (equal on guard-skipped steps, so
+    update_ratio reads 0 there — itself a diagnostic). All three trees
+    are replicated at the finish_step boundary in every update-sharding
+    mode, so the same reduction text serves replicated/zero/pipeline.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # One flat f32 vector per group (grads / params / new_params), then
+    # ONE reduction per stat per group. Per-leaf reductions compile an
+    # HLO instruction per (leaf, stat) pair — hundreds of tiny ops that
+    # measurably slow every step build; ravel+concat keeps the program
+    # text to ~2 cheap ops per leaf plus a handful per group.
+    g_parts: List[list] = [[] for _ in range(num_groups)]
+    p_parts: List[list] = [[] for _ in range(num_groups)]
+    n_parts: List[list] = [[] for _ in range(num_groups)]
+    for key in grads:
+        gi = assign[key]
+        for g in jax.tree.leaves(grads[key]):
+            g_parts[gi].append(g.ravel().astype(jnp.float32))
+        for p in jax.tree.leaves(params[key]):
+            p_parts[gi].append(p.ravel().astype(jnp.float32))
+        for n in jax.tree.leaves(new_params[key]):
+            n_parts[gi].append(n.ravel().astype(jnp.float32))
+
+    zf = jnp.zeros((), jnp.float32)
+    grad_ss, param_ss, update_ss, grad_max, nonfinite = [], [], [], [], []
+    for gi in range(num_groups):
+        if not g_parts[gi]:  # op with no live params (e.g. pure reshape)
+            grad_ss.append(zf)
+            param_ss.append(zf)
+            update_ss.append(zf)
+            grad_max.append(zf)
+            nonfinite.append(jnp.zeros((), jnp.int32))
+            continue
+        gcat = jnp.concatenate(g_parts[gi])
+        pcat = jnp.concatenate(p_parts[gi])
+        ncat = jnp.concatenate(n_parts[gi])
+        grad_ss.append(jnp.sum(jnp.square(gcat)))
+        param_ss.append(jnp.sum(jnp.square(pcat)))
+        update_ss.append(jnp.sum(jnp.square(ncat - pcat)))
+        grad_max.append(jnp.max(jnp.abs(gcat)))
+        # Count of non-finite VALUES (bf16→f32 casts preserve
+        # finiteness); >0 iff the group holds any bad gradient, which is
+        # all first_bad_group and the anomaly guard consume.
+        nonfinite.append(jnp.sum(~jnp.isfinite(gcat)).astype(jnp.int32))
+    grad_ss = jnp.stack(grad_ss)
+    param_ss = jnp.stack(param_ss)
+    update_ss = jnp.stack(update_ss)
+    # Same element count divides both RMS terms, so the ratio reduces to
+    # sqrt(update_ss)/sqrt(param_ss); epsilon guards empty/zero groups.
+    param_norm = jnp.sqrt(param_ss)
+    return {
+        "grad_norm": jnp.sqrt(grad_ss),
+        "param_norm": param_norm,
+        "update_ratio": jnp.sqrt(update_ss) / jnp.maximum(param_norm,
+                                                          1e-12),
+        "grad_max": jnp.stack(grad_max),
+        "nonfinite": jnp.stack(nonfinite),
+    }
+
+
+def first_bad_group(labels: Sequence[str], nonfinite_counts) -> str:
+    """Host-side: the first (lowest-op-index) group with a non-finite
+    gradient leaf — the NaN provenance attached to anomaly events and
+    flight dumps. "" when every group is clean."""
+    for label, count in zip(labels, nonfinite_counts):
+        if int(count) > 0:
+            return label
+    return ""
+
+
+class NumericsMonitor:
+    """Host-side consumer of the in-jit group stats.
+
+    One per Trainer. `observe(step, stats)` decimates per `every`,
+    pulls the tiny (G,)-shaped arrays off device, writes one
+    numerics.jsonl row, updates the grad-norm gauges, and runs the
+    per-group EWMA spike detector. Returns the decoded row (tests, NaN
+    provenance) or None on decimated steps."""
+
+    def __init__(self, labels: Sequence[str], bus, registry=None, *,
+                 every: int = 1, spike_z: float = 6.0,
+                 ewma_decay: float = 0.9):
+        self.labels = list(labels)
+        self._bus = bus
+        self._every = max(1, int(every))
+        self._spike_z = float(spike_z)
+        self._decay = float(ewma_decay)
+        n = len(self.labels)
+        self._mean = [0.0] * n
+        self._var = [0.0] * n
+        self._samples = [0] * n
+        self.rows = 0
+        self.spikes: List[dict] = []
+        self._gauge = (registry.gauge(
+            "nvs3d_grad_norm",
+            "Per-layer-group gradient norm (train.numerics)")
+            if registry is not None else None)
+
+    def observe(self, step: int, stats: dict) -> Optional[dict]:
+        if step % self._every != 0:
+            return None
+        import numpy as np
+
+        decoded = {}
+        for key in STAT_KEYS:
+            if key in stats:
+                decoded[key] = np.asarray(stats[key]).tolist()
+        per_group = {
+            label: {k: decoded[k][i] for k in decoded}
+            for i, label in enumerate(self.labels)}
+        row = {"kind": "numerics", "step": int(step), "groups": per_group}
+        self._bus.numerics_row(row)
+        self.rows += 1
+        for i, label in enumerate(self.labels):
+            gn = float(decoded.get("grad_norm", [0.0] * len(self.labels))[i])
+            if self._gauge is not None:
+                self._gauge.set(gn, group=label)
+            self._spike_check(step, i, label, gn)
+        return row
+
+    def _spike_check(self, step: int, i: int, label: str,
+                     grad_norm: float) -> None:
+        """EWMA z-score on the group's grad norm. Non-finite samples are
+        never folded into the baseline (they are the anomaly guard's
+        department); spiking samples are folded AFTER judging, so a
+        slow drift re-baselines while a step spike still flags."""
+        if not math.isfinite(grad_norm):
+            return
+        if self._samples[i] >= MIN_SPIKE_SAMPLES:
+            std = math.sqrt(max(self._var[i], 0.0))
+            if std > 0.0:
+                z = (grad_norm - self._mean[i]) / std
+                if z > self._spike_z:
+                    spike = {"kind": "numerics_spike", "step": int(step),
+                             "group": label, "z": round(z, 2),
+                             "grad_norm": grad_norm}
+                    self.spikes.append(spike)
+                    self._bus.numerics_row(spike)
+                    self._bus.event(
+                        step, "numerics_spike",
+                        f"group={label} z={z:.1f} "
+                        f"grad_norm={grad_norm:.3e}",
+                        echo="[numerics]")
+        d = self._decay
+        delta = grad_norm - self._mean[i]
+        self._mean[i] += (1.0 - d) * delta
+        self._var[i] = d * (self._var[i] + (1.0 - d) * delta * delta)
+        self._samples[i] += 1

@@ -9,15 +9,20 @@ reach it remotely over an SSH tunnel — docs/TPU_VM_SETUP.md).
 ``/metrics`` renders the shared registry in Prometheus format 0.0.4;
 ``/healthz`` answers ``ok`` (livenesss for the supervisor or an external
 prober: the HTTP thread answering proves the process is not wedged at
-the interpreter level, though a stuck device dispatch needs the run
-watchdog's deeper diagnosis).
+the interpreter level). A health PROVIDER (`set_health_provider`)
+upgrades the body to JSON progress facts — `last_step_age_s` from the
+trainer, `last_dispatch_age_s` + the live registry `model_version` from
+the serving plane — so a probe can tell wedged-but-listening (the HTTP
+thread answers while the ages grow without bound) from healthy, without
+the run watchdog's deeper diagnosis.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
 from novel_view_synthesis_3d_tpu.obs.registry import (
     MetricsRegistry,
@@ -36,6 +41,7 @@ class MetricsServer:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  port: int = 0, host: str = "127.0.0.1"):
         self.registry = registry if registry is not None else get_registry()
+        self._health_provider: Optional[Callable[[], dict]] = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -48,9 +54,18 @@ class MetricsServer:
                     self.end_headers()
                     self.wfile.write(body)
                 elif self.path.split("?")[0] == "/healthz":
-                    body = b"ok\n"
+                    body, ctype = b"ok\n", "text/plain"
+                    provider = outer._health_provider
+                    if provider is not None:
+                        try:
+                            body = (json.dumps(provider()) + "\n").encode()
+                            ctype = "application/json"
+                        except Exception:
+                            # A broken provider must not take liveness
+                            # down with it — fall back to the bare ok.
+                            body, ctype = b"ok\n", "text/plain"
                     self.send_response(200)
-                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -68,6 +83,13 @@ class MetricsServer:
             target=self._httpd.serve_forever, daemon=True,
             name="obs-metrics-http")
         self._thread.start()
+
+    def set_health_provider(
+            self, provider: Optional[Callable[[], dict]]) -> None:
+        """Install (or clear, with None) the /healthz JSON body source —
+        a zero-arg callable returning a JSON-serializable dict, called
+        per request on the HTTP thread so the ages it reports are live."""
+        self._health_provider = provider
 
     def url(self, path: str = "/metrics") -> str:
         return f"http://{self.host}:{self.port}{path}"

@@ -497,7 +497,8 @@ class SamplerProgramCache:
     zero-recompile-after-warmup assertion reads (tools/serve_bench.py,
     tests/test_serve.py)."""
 
-    def __init__(self, factory: Callable[..., Callable], capacity: int):
+    def __init__(self, factory: Callable[..., Callable], capacity: int,
+                 on_build: Optional[Callable[[tuple, float], None]] = None):
         self._factory = factory
         self._capacity = max(1, capacity)
         self._entries: "collections.OrderedDict[tuple, dict]" = \
@@ -505,6 +506,11 @@ class SamplerProgramCache:
         self._lock = threading.Lock()
         self.builds = 0
         self.hits = 0
+        # Build observer (the service's compile-ledger hook): called with
+        # (key, trace wall seconds) for each factory build this cache
+        # KEPT — raced duplicate builds are dropped unrecorded, matching
+        # the `builds` counter the zero-recompile asserts read.
+        self._on_build = on_build
 
     def get(self, key: tuple, *factory_args) -> dict:
         """Entry dict {fn, warm} for `key`, building (and evicting) as
@@ -516,7 +522,9 @@ class SamplerProgramCache:
                 self._entries.move_to_end(key)
                 self.hits += 1
                 return entry
+        t0 = time.perf_counter()
         fn = self._factory(*factory_args)
+        build_s = time.perf_counter() - t0
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:  # raced another builder
@@ -528,7 +536,12 @@ class SamplerProgramCache:
             self.builds += 1
             while len(self._entries) > self._capacity:
                 self._entries.popitem(last=False)
-            return entry
+        if self._on_build is not None:
+            try:
+                self._on_build(key, build_s)
+            except Exception:
+                pass  # ledger bookkeeping must never fail a dispatch
+        return entry
 
     def jit_entries(self) -> int:
         with self._lock:
@@ -638,6 +651,16 @@ class SamplingService:
         self.anomalies = 0
         self.worker_restarts = 0
         self.dispatches = 0
+        # Compile ledger (obs/compiles.py): every sampler-program build
+        # lands in compiles.jsonl with a field-named fingerprint, so a
+        # recompile names the knob that changed (bucket, steps, shape…) —
+        # what serve_bench's zero-recompile asserts print as the culprit.
+        self._compile_ledger = obs.CompileLedger(
+            self._results_folder, registry=obs.get_registry())
+        # /healthz progress heartbeat: stamped at every dispatch; a probe
+        # reads last_dispatch_age_s to tell wedged-but-listening from
+        # merely idle (pair it with queue depth).
+        self._last_dispatch_t = time.time()
         self._draining = False
         self._drained_ev = threading.Event()
         self._brownout_level = 0
@@ -694,7 +717,8 @@ class SamplingService:
             # guidance ride as device args); the host-side coefficient
             # bank supplies per-row schedule values per dispatch.
             self._programs = SamplerProgramCache(
-                self._build_step_program, self.serve.program_cache_entries)
+                self._build_step_program, self.serve.program_cache_entries,
+                on_build=self._record_build)
             self._banks = ScheduleBank(self.diffusion)
             # Per-bucket all-False `first` vectors, staged once: the
             # carry fast path reuses them instead of re-uploading.
@@ -707,7 +731,8 @@ class SamplingService:
             self._commit_fn = make_bank_commit_fn() if self._k_max else None
         else:
             self._programs = SamplerProgramCache(
-                self._build_program, self.serve.program_cache_entries)
+                self._build_program, self.serve.program_cache_entries,
+                on_build=self._record_build)
             self._banks = None
         self._lock = threading.Lock()
         self._queue_cv = threading.Condition(self._lock)
@@ -1694,6 +1719,7 @@ class SamplingService:
         carry (z, keys, cond, banks) stays on device — only an expiry or
         the orbit's LAST frame makes the slot exit the ring."""
         self.dispatches += 1
+        self._last_dispatch_t = time.time()
         faultinject.maybe_serve_dispatch_raise(self.dispatches)
         faultinject.maybe_serve_slow_step(self.dispatches)
         nan_at = faultinject.serve_nan_spec()
@@ -2157,6 +2183,49 @@ class SamplingService:
                 live.append(r)
         return live
 
+    # Field names matching the program-cache key tuples positionally —
+    # the ledger fingerprints each key field by name so a recompile diff
+    # reads "steps: 4 -> 256", not "position 3 changed".
+    _STEP_KEY_FIELDS = ("bucket", "H", "W", "sampler", "cfg_rescale",
+                        "ddim_eta", "objective", "clip_denoised",
+                        "schedule", "timesteps", "precision", "fused_step",
+                        "k_max", "stochastic_cond")
+    _BATCH_KEY_FIELDS = ("bucket", "H", "W", "steps", "guidance",
+                         "sampler", "cfg_rescale", "ddim_eta", "objective",
+                         "schedule", "precision", "fused_step")
+
+    def _record_build(self, key: tuple, build_s: float) -> None:
+        """Program-cache build observer → compile ledger entry. The
+        ledger keys every sampler build under ONE name so any second
+        build is classified (and diffed) as a recompile — exactly the
+        event the warm-sweep zero-recompile asserts police."""
+        fields = (self._STEP_KEY_FIELDS
+                  if self.serve.scheduler == "step"
+                  else self._BATCH_KEY_FIELDS)
+        args = {name: repr(v) for name, v in zip(fields, key)}
+        self._compile_ledger.record(
+            f"serve_{self.serve.scheduler}", {"args": args},
+            wall_s=build_s, backend=jax.default_backend())
+
+    def health_snapshot(self) -> dict:
+        """JSON progress facts for /healthz (obs/server.py's provider
+        contract): the dispatch heartbeat age, queue depth, and the live
+        model version — enough for a probe to tell wedged from idle
+        without scraping Prometheus."""
+        with self._lock:
+            depth = len(self._queue)
+        state = ("stopped" if self._worker is None
+                 else "draining" if self._draining else "ok")
+        return {
+            "status": state,
+            "role": "serve",
+            "dispatches": int(self.dispatches),
+            "queue_depth": depth,
+            "last_dispatch_age_s": round(
+                time.time() - self._last_dispatch_t, 3),
+            "model_version": self.model_version,
+        }
+
     def _cache_key(self, bucket: int, H: int, W: int, steps: int,
                    w: float) -> tuple:
         """Full program-cache key: the per-request shape/steps/guidance
@@ -2184,6 +2253,7 @@ class SamplingService:
 
     def _dispatch(self, group: List[_Request]) -> None:
         self.dispatches += 1
+        self._last_dispatch_t = time.time()
         faultinject.maybe_serve_dispatch_raise(self.dispatches)
         n = len(group)
         bucket = bucket_for(n, self.serve.max_batch)

@@ -141,6 +141,17 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
     # Fault injection (utils/faultinject.py): read at TRACE time — a clean
     # build compiles no injection ops at all.
     fi_nan_steps = faultinject.nan_loss_steps()
+    fi_nan_group = faultinject.nan_grad_group()
+    # Numerics observatory (obs/numerics.py): per-layer-group read-only
+    # reductions grouped by the pipeline op list, UNCONDITIONALLY traced
+    # into the step (see finish_step). train.numerics.enabled only gates
+    # the host-side consumer, which is what makes flipping it bitwise
+    # identical with zero recompiles: earlier Python-gated variants
+    # changed XLA's fusion around the optimizer update (~1-ulp param
+    # drift on CPU even behind an optimization_barrier).
+    from novel_view_synthesis_3d_tpu.models.xunet import op_groups
+    from novel_view_synthesis_3d_tpu.obs import numerics as numerics_lib
+    layer_groups = op_groups(config.model)
 
     def derive_fields(batch, k_t, k_noise, k_mask, B, rows):
         """Diffusion training fields for `rows` of a B-row batch.
@@ -279,12 +290,33 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         if fi_nan_steps:
             # Injected fault: poison loss AND gradients at the armed steps,
             # exactly what a numerically-blown forward/backward produces.
+            # NVS3D_FI_NAN_GRAD_GROUP narrows the grad poisoning to one
+            # layer group — the NaN-provenance drill.
             bad_step = jnp.isin(state.step,
                                 jnp.asarray(fi_nan_steps, jnp.int32))
             loss = jnp.where(bad_step, jnp.float32(jnp.nan), loss)
-            grads = jax.tree.map(
-                lambda g: jnp.where(bad_step, jnp.asarray(jnp.nan, g.dtype),
-                                    g), grads)
+            if fi_nan_group:
+                poison_keys = {name for label, names in layer_groups
+                               if label == fi_nan_group for name in names}
+                if not poison_keys:
+                    raise ValueError(
+                        f"NVS3D_FI_NAN_GRAD_GROUP={fi_nan_group!r} matches "
+                        "no layer group; labels: "
+                        f"{[label for label, _ in layer_groups]}")
+
+                def poison(path, g):
+                    top = getattr(path[0], "key", None)
+                    if top in poison_keys:
+                        return jnp.where(bad_step,
+                                         jnp.asarray(jnp.nan, g.dtype), g)
+                    return g
+
+                grads = jax.tree_util.tree_map_with_path(poison, grads)
+            else:
+                grads = jax.tree.map(
+                    lambda g: jnp.where(bad_step,
+                                        jnp.asarray(jnp.nan, g.dtype),
+                                        g), grads)
 
         grad_norm = optax.global_norm(grads)
 
@@ -344,6 +376,20 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         if new_guard is not None:
             metrics["anomalies"] = new_guard.anomalies.astype(jnp.float32)
             metrics["strikes"] = new_guard.strikes.astype(jnp.float32)
+        # Per-layer-group numerics (obs/numerics.py): read-only reductions
+        # over pre-update params, the gradient, and the post-update params
+        # (guard-skipped steps read update_ratio 0). ALWAYS part of the
+        # program — train.numerics.enabled gates only the host-side
+        # consumer (NumericsMonitor), so flipping it is bitwise identical
+        # and recompile-free by construction: there is exactly one step
+        # program either way. The (G,) outputs cost two elementwise passes
+        # over params+grads, noise next to the fwd/bwd and Adam's own
+        # tree passes.
+        metrics["numerics"] = numerics_lib.group_stats(
+            numerics_lib.group_assignment(
+                layer_groups, list(state.params.keys())),
+            len(layer_groups),
+            grads=grads, params=state.params, new_params=params)
         return new_state, metrics
 
     repl = mesh_lib.replicated(mesh)
@@ -377,6 +423,13 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         for k in ("anomalies", "strikes"):
             if k in ms:
                 out[k] = ms[k][-1]
+        # Numerics stats are positional like lr (last step's values),
+        # EXCEPT nonfinite which takes the window max — an anomaly inside
+        # a fused window must keep its provenance observable.
+        if "numerics" in ms:
+            out["numerics"] = {
+                k: (jnp.max(v, axis=0) if k == "nonfinite" else v[-1])
+                for k, v in ms["numerics"].items()}
         return state, out
 
     return jax.jit(

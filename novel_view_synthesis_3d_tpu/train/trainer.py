@@ -14,6 +14,7 @@ import os
 import queue
 import signal
 import threading
+import time
 from typing import Callable, Iterator, Optional
 
 import jax
@@ -410,6 +411,35 @@ class Trainer:
         # One-time FLOPs estimate for MFU (obs.cost_analysis): filled at
         # the first dispatch via train_step.lower(...).cost_analysis().
         self._flops_per_step: Optional[float] = None
+        # Compile ledger (obs/compiles.py): every jit build this process
+        # makes lands in compiles.jsonl with a fingerprint, so a recompile
+        # can name the argument that changed. The train step's entry is
+        # recorded at its first dispatch (where the wall time is known).
+        self._compile_ledger = obs.CompileLedger(tcfg.results_folder,
+                                                 registry=reg)
+        self._train_step_hlo = ""
+        # Numerics observatory (train.numerics): host half of the in-jit
+        # per-layer-group stats — numerics.jsonl rows, grad-norm gauges,
+        # EWMA spike detection. The labels are kept even with the monitor
+        # off: the step always emits the stats, so NaN provenance
+        # (first_bad_layer on anomaly events/flight dumps) works without
+        # opting into the full observatory.
+        from novel_view_synthesis_3d_tpu.models.xunet import op_groups
+
+        self._numerics_labels = obs.group_labels(op_groups(config.model))
+        self._numerics: Optional[obs.NumericsMonitor] = None
+        if tcfg.numerics.enabled:
+            self._numerics = obs.NumericsMonitor(
+                self._numerics_labels,
+                self.telemetry.bus, reg,
+                every=tcfg.numerics.every,
+                spike_z=tcfg.numerics.spike_z,
+                ewma_decay=tcfg.numerics.ewma_decay)
+        # /healthz progress facts: an external probe distinguishes
+        # wedged-but-listening from healthy by last_step_age_s.
+        self._last_step_t = time.time()
+        if self.telemetry.server is not None:
+            self.telemetry.server.set_health_provider(self._health_snapshot)
 
         # --- checkpointing / metrics ---
         self.ckpt = CheckpointManager(tcfg.checkpoint_dir)
@@ -703,10 +733,27 @@ class Trainer:
         strikes, anomalies = (int(v) for v in jax.device_get(
             [step_metrics["strikes"], step_metrics["anomalies"]]))
         if anomalies > self._anomalies_seen:
-            self.metrics.log_event(
-                step_now, "anomaly",
-                f"non-finite/spike step skipped (strikes={strikes}, "
-                f"total={anomalies})")
+            # NaN provenance (obs/numerics.py): the per-group non-finite
+            # counts name the first bad layer group, so the anomaly event
+            # (and the flight dump) carry their root cause.
+            first_bad = ""
+            if "numerics" in step_metrics:
+                first_bad = obs.first_bad_group(
+                    self._numerics_labels,
+                    jax.device_get(step_metrics["numerics"]["nonfinite"]))
+            detail = (f"non-finite/spike step skipped (strikes={strikes}, "
+                      f"total={anomalies})")
+            if first_bad:
+                detail += f" first_bad_layer={first_bad}"
+            self.metrics.log_event(step_now, "anomaly", detail)
+            if (self.telemetry.flight is not None
+                    and strikes <= tcfg.steps_per_dispatch):
+                # One forensics dump per strike streak (its first
+                # anomalous dispatch), not per anomaly — a poisoned-run
+                # drill must not carpet the results folder.
+                self.telemetry.flight.dump(
+                    "anomaly", step=step_now, strikes=strikes,
+                    anomalies=anomalies, first_bad_layer=first_bad)
             self._anomalies_seen = anomalies
         if strikes >= tcfg.max_anomaly_strikes:
             self._rollback(step_now)
@@ -868,7 +915,15 @@ class Trainer:
                 # donating dispatch deletes the state's buffers. lower()
                 # only traces — no XLA compile, no device time.
                 self._maybe_cost_analysis(self._device_batch)
+                # Ledger fingerprint is taken BEFORE the donating dispatch
+                # too — it reads the arg tree's shapes/dtypes.
+                compile_fp = obs.fingerprint_args(
+                    self.state, self._device_batch,
+                    static=(self.config.model, self.config.diffusion,
+                            self.config.train, self.config.mesh))
+                compile_t0 = time.perf_counter()
             phase = "compile" if first_dispatch else "train_step"
+            was_first = first_dispatch
             with self.timer.measure(), self.watchdog.phase(phase), \
                     self.tracer.span(phase) as sp:
                 first_dispatch = False
@@ -887,11 +942,30 @@ class Trainer:
                 # the armed train_step phase, exactly where a wedged
                 # dispatch would stall.
                 faultinject.maybe_stall("step", step_now)
+            if was_first:
+                # Compile-ledger entry for the train step: the first
+                # dispatch's wall time IS compile + first step (the same
+                # definition the compile span/watchdog budget uses).
+                self._compile_ledger.record(
+                    "train_step", compile_fp,
+                    wall_s=time.perf_counter() - compile_t0,
+                    hlo=self._train_step_hlo,
+                    backend=jax.default_backend())
+            # /healthz heartbeat: a dispatch completed; last_step_age_s
+            # restarts from zero.
+            self._last_step_t = time.time()
             # Counter semantics: steps EXECUTED — each dispatch runs
             # steps_per_dispatch optimizer steps; a rolled-back window
             # that re-runs counts again (a Prometheus counter is monotone,
             # the step column in metrics.csv carries the logical step).
             self._steps_total.inc(self.config.train.steps_per_dispatch)
+
+            # Numerics observatory: decimated host publish of the in-jit
+            # per-group stats. BEFORE the guard check so an anomalous
+            # window's stats (and its non-finite provenance) are on disk
+            # even when the guard rolls back and restarts the loop.
+            if self._numerics is not None and "numerics" in step_metrics:
+                self._numerics.observe(step_now, step_metrics["numerics"])
 
             if self._check_guard(step_now, step_metrics):
                 continue  # rolled back: restart the loop from the restore
@@ -997,6 +1071,17 @@ class Trainer:
             print(f"step timing: {timing}")
 
     # -- telemetry helpers (obs/) --------------------------------------
+    def _health_snapshot(self) -> dict:
+        """/healthz body (obs/server.py health provider): progress facts
+        an external probe can alarm on — a wedged trainer keeps /metrics
+        up while last_step_age_s grows without bound."""
+        return {
+            "status": "ok",
+            "role": "train",
+            "step": int(getattr(self, "_step_host", 0)),
+            "last_step_age_s": round(time.time() - self._last_step_t, 3),
+        }
+
     def _maybe_cost_analysis(self, device_batch) -> None:
         """One-time FLOPs estimate of the train step for the MFU gauge
         (obs.cost_analysis): jit(...).lower(...).cost_analysis() on the
@@ -1007,8 +1092,11 @@ class Trainer:
             return
         try:
             with self.tracer.span("cost_analysis"):
-                ca = self.train_step.lower(
-                    self.state, device_batch).cost_analysis()
+                lowered = self.train_step.lower(self.state, device_batch)
+                # Piggyback the compile ledger's HLO module hash on the
+                # lowering we already paid for.
+                self._train_step_hlo = obs.hlo_hash(lowered)
+                ca = lowered.cost_analysis()
             flops = (float(ca.get("flops", 0.0))
                      if isinstance(ca, dict) else 0.0)
         except Exception as e:  # bonus context, never fatal

@@ -15,6 +15,14 @@ Injection points:
                             step overwrites loss AND gradients with NaN at
                             those steps (read when make_train_step traces —
                             set it before the Trainer is built).
+  NVS3D_FI_NAN_GRAD_GROUP   layer-group label (models/xunet.op_groups,
+                            e.g. "XUNetBlock_1"); scopes the NaN-step
+                            gradient poisoning above to that group's
+                            params only (loss is still poisoned). The
+                            NaN-provenance drill: the numerics
+                            observatory must name exactly this group as
+                            first_bad_layer. Trace-time read; inert
+                            without NVS3D_FI_NAN_LOSS_AT.
   NVS3D_FI_RAISE_ON_RECORD  comma list of flat record indices;
                             SRNDataset.pair raises InjectedFault for them
                             (read per call).
@@ -102,6 +110,12 @@ def _int_list(env: str) -> Tuple[int, ...]:
 def nan_loss_steps() -> Tuple[int, ...]:
     """Steps whose loss/grads the train step poisons (trace-time read)."""
     return _int_list("NVS3D_FI_NAN_LOSS_AT")
+
+
+def nan_grad_group() -> str:
+    """Layer-group label scoping the NaN-step grad poisoning ("" = whole
+    tree, the default). Trace-time read, like nan_loss_steps."""
+    return os.environ.get("NVS3D_FI_NAN_GRAD_GROUP", "").strip()
 
 
 def record_fault_indices() -> Tuple[int, ...]:

@@ -1,0 +1,515 @@
+"""Training numerics observatory + compile/cost ledger tests
+(docs/DESIGN.md "Training numerics & compile observatory").
+
+The load-bearing contract first: the per-layer-group stats are ALWAYS
+traced into the train step and `train.numerics.enabled` gates only the
+host-side consumer, so flipping the flag is BITWISE identical (params
+and EMA, not almost-equal) with zero recompiles — one program either
+way. Then the observatory around it: NaN provenance naming the injected
+layer group on anomaly events and flight dumps, EWMA spike detection,
+the compile ledger's recompile diff, /healthz staleness ages for both
+roles, the per-op cost map, and the `nvs3d obs numerics|compiles` CLI.
+"""
+
+import json
+import os
+import time
+from urllib.request import urlopen
+
+import jax
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu import obs
+from novel_view_synthesis_3d_tpu.config import (
+    Config,
+    DataConfig,
+    DiffusionConfig,
+    MeshConfig,
+    ModelConfig,
+    NumericsConfig,
+    TrainConfig,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import (
+    make_example_batch,
+    write_synthetic_srn,
+)
+from novel_view_synthesis_3d_tpu.obs import numerics as numerics_lib
+
+pytestmark = pytest.mark.smoke
+
+TINY = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                   attn_resolutions=(8,), dropout=0.0)
+
+
+def _step_cfg(numerics: NumericsConfig = None) -> Config:
+    kw = {"numerics": numerics} if numerics is not None else {}
+    return Config(
+        model=TINY,
+        diffusion=DiffusionConfig(timesteps=50),
+        data=DataConfig(img_sidelength=16),
+        train=TrainConfig(batch_size=4, lr=1e-3, **kw),
+        mesh=MeshConfig(data=1, model=1, seq=1))
+
+
+def _build(cfg):
+    """One-device train-step harness (the test_fault_injection idiom)."""
+    from novel_view_synthesis_3d_tpu.diffusion import make_schedule
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.parallel import mesh as mesh_lib
+    from novel_view_synthesis_3d_tpu.train.state import create_train_state
+    from novel_view_synthesis_3d_tpu.train.step import make_train_step
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    mesh = mesh_lib.make_mesh(cfg.mesh, devices=jax.devices()[:1])
+    batch = make_example_batch(batch_size=4, sidelength=16, seed=0)
+    model = XUNet(cfg.model)
+    state = create_train_state(cfg.train, model, _sample_model_batch(batch))
+    state = mesh_lib.replicate(mesh, state)
+    step = make_train_step(cfg, model, make_schedule(cfg.diffusion), mesh)
+    db = mesh_lib.shard_batch(mesh, batch)
+    return state, step, db
+
+
+# ---------------------------------------------------------------------------
+# 1. The tentpole contract: enabling stats is bitwise-neutral, one program
+# ---------------------------------------------------------------------------
+def test_numerics_flag_is_bitwise_neutral_with_zero_recompiles():
+    from novel_view_synthesis_3d_tpu.models.xunet import op_groups
+
+    runs = {}
+    for key, cfg in (("off", _step_cfg()),
+                     ("on", _step_cfg(NumericsConfig(enabled=True)))):
+        state, step, db = _build(cfg)
+        metrics = None
+        for _ in range(3):
+            state, metrics = step(state, db)
+        runs[key] = (jax.device_get(state.params),
+                     jax.device_get(state.ema_params),
+                     step._cache_size(), jax.device_get(metrics))
+    p_off, e_off, n_off, _ = runs["off"]
+    p_on, e_on, n_on, m_on = runs["on"]
+    # BITWISE identical, not allclose: the flag must not perturb XLA's
+    # fusion around the optimizer update by even one ulp.
+    for a, b in zip(jax.tree.leaves(p_off), jax.tree.leaves(p_on)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(e_off), jax.tree.leaves(e_on)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Exactly one executable per mode — zero recompiles, by counter.
+    assert n_off == 1 and n_on == 1
+    # The stats ride the metrics either way (they're unconditional);
+    # well-formed: one value per layer group, finite clean-run numbers.
+    groups = op_groups(TINY)
+    num = m_on["numerics"]
+    for stat in numerics_lib.STAT_KEYS:
+        assert np.asarray(num[stat]).shape == (len(groups),)
+    assert int(np.asarray(num["nonfinite"]).sum()) == 0
+    assert float(np.asarray(num["grad_norm"]).sum()) > 0.0
+    assert float(np.asarray(num["update_ratio"]).max()) > 0.0
+
+
+def test_group_assignment_covers_params_and_rejects_strays():
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet, op_groups
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    model = XUNet(TINY)
+    mb = _sample_model_batch(make_example_batch(
+        batch_size=2, sidelength=16, seed=0))
+    batch_s = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                       np.asarray(a).dtype), mb)
+    mask_s = jax.ShapeDtypeStruct((2,), np.float32)
+    variables = jax.eval_shape(
+        lambda b, m: model.init(jax.random.PRNGKey(0), b, cond_mask=m,
+                                train=False), batch_s, mask_s)
+    keys = list(variables["params"].keys())
+    groups = op_groups(TINY)
+    assign = obs.group_assignment(groups, keys)
+    assert set(keys) <= set(assign)
+    assert set(assign.values()) <= set(range(len(groups)))
+    with pytest.raises(ValueError, match="not claimed"):
+        obs.group_assignment(groups, keys + ["stray_head"])
+
+
+def test_first_bad_group_picks_lowest_op_index():
+    assert obs.first_bad_group(["a", "b", "c"], [0, 2, 1]) == "b"
+    assert obs.first_bad_group(["a", "b"], np.asarray([0, 0])) == ""
+
+
+# ---------------------------------------------------------------------------
+# 2. Host half: decimation, jsonl rows, EWMA spike detection
+# ---------------------------------------------------------------------------
+class _StubBus:
+    def __init__(self):
+        self.rows = []
+        self.events = []
+
+    def numerics_row(self, row):
+        self.rows.append(dict(row))
+
+    def event(self, step, kind, detail, **kw):
+        self.events.append((step, kind, detail))
+
+
+def _stats(grad_norm):
+    return {"grad_norm": np.asarray([grad_norm], np.float32),
+            "param_norm": np.asarray([1.0], np.float32),
+            "update_ratio": np.asarray([1e-3], np.float32),
+            "grad_max": np.asarray([grad_norm], np.float32),
+            "nonfinite": np.asarray([0], np.int32)}
+
+
+def test_monitor_decimates_and_flags_step_spike():
+    bus = _StubBus()
+    mon = obs.NumericsMonitor(["g"], bus, every=2, spike_z=4.0,
+                              ewma_decay=0.9)
+    assert mon.observe(1, _stats(1.0)) is None  # decimated
+    # Warm the EWMA baseline with mildly jittered samples (constant
+    # values leave zero variance — nothing to z-score against).
+    step = 0
+    for v in (1.0, 1.1, 0.9, 1.05, 0.95, 1.0, 1.1):
+        row = mon.observe(step, _stats(v))
+        assert row is not None
+        assert row["groups"]["g"]["grad_norm"] == pytest.approx(v, rel=1e-6)
+        step += 2
+    assert not mon.spikes
+    mon.observe(step, _stats(100.0))
+    assert len(mon.spikes) == 1
+    spike = mon.spikes[0]
+    assert spike["group"] == "g" and spike["z"] > 4.0
+    # The spike reached both sinks: a numerics.jsonl row and an event.
+    assert any(r.get("kind") == "numerics_spike" for r in bus.rows)
+    assert any(kind == "numerics_spike" and "group=g" in detail
+               for _, kind, detail in bus.events)
+    # Non-finite samples never fold into the baseline (the anomaly
+    # guard's department) — and never crash the detector.
+    before = mon.rows
+    mon.observe(step + 2, _stats(float("nan")))
+    assert mon.rows == before + 1 and len(mon.spikes) == 1
+
+
+# ---------------------------------------------------------------------------
+# 3. NaN provenance: the injected layer group is named, end to end
+# ---------------------------------------------------------------------------
+def test_nan_grad_drill_names_injected_group(monkeypatch):
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet, op_groups
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    cfg = _step_cfg(NumericsConfig(enabled=True))
+    groups = op_groups(TINY)
+    labels = obs.group_labels(groups)
+    # Pick the highest-index group that owns live params (cheap abstract
+    # init), so the test also proves ordering isn't trivially group 0.
+    model = XUNet(TINY)
+    mb = _sample_model_batch(make_example_batch(
+        batch_size=2, sidelength=16, seed=0))
+    variables = jax.eval_shape(
+        lambda b, m: model.init(jax.random.PRNGKey(0), b, cond_mask=m,
+                                train=False),
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(
+            np.asarray(a).shape, np.asarray(a).dtype), mb),
+        jax.ShapeDtypeStruct((2,), np.float32))
+    assign = obs.group_assignment(groups, list(variables["params"].keys()))
+    target = labels[max(assign[k] for k in variables["params"])]
+
+    # Env is read at TRACE time: arm both knobs before the build.
+    monkeypatch.setenv("NVS3D_FI_NAN_LOSS_AT", "1")
+    monkeypatch.setenv("NVS3D_FI_NAN_GRAD_GROUP", target)
+    state, step, db = _build(cfg)
+
+    state, m0 = step(state, db)  # step 0: clean
+    nf0 = jax.device_get(m0["numerics"]["nonfinite"])
+    assert obs.first_bad_group(labels, nf0) == ""
+
+    state, m1 = step(state, db)  # step 1: poisoned
+    assert not np.isfinite(float(m1["loss"]))
+    nf1 = np.asarray(jax.device_get(m1["numerics"]["nonfinite"]))
+    bad = {labels[i] for i in np.nonzero(nf1)[0]}
+    assert bad == {target}
+    assert obs.first_bad_group(labels, nf1) == target
+
+
+@pytest.fixture(scope="module")
+def srn_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("srn_numerics")
+    write_synthetic_srn(str(root), num_instances=2, views_per_instance=4,
+                        image_size=16)
+    return str(root)
+
+
+def test_trainer_drill_provenance_sink_and_healthz(srn_root, tmp_path,
+                                                   monkeypatch):
+    from novel_view_synthesis_3d_tpu.models.xunet import op_groups
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+    cfg = Config(
+        model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
+                          attn_resolutions=(), dropout=0.0),
+        diffusion=DiffusionConfig(timesteps=8, sample_timesteps=4),
+        data=DataConfig(root_dir=srn_root, img_sidelength=16,
+                        num_workers=0),
+        train=TrainConfig(
+            batch_size=8, lr=1e-3, num_steps=4, save_every=2, log_every=1,
+            seed=0, resume=True,
+            checkpoint_dir=os.path.join(str(tmp_path), "ckpt"),
+            results_folder=os.path.join(str(tmp_path), "results"),
+            numerics=NumericsConfig(enabled=True, every=1)),
+        mesh=MeshConfig(data=-1),
+    ).validate()
+    # The injection env vars are read when Trainer.__init__ traces the
+    # step, so the target group must be picked BEFORE construction —
+    # abstract init (no device work) is enough to learn the param keys.
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    groups = op_groups(cfg.model)
+    mb = _sample_model_batch(make_example_batch(
+        batch_size=8, sidelength=16, seed=0))
+    shapes = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), dict(mb))
+    mask_s = jax.ShapeDtypeStruct((8,), mb["x"].dtype)
+    model_probe = XUNet(cfg.model)
+    variables = jax.eval_shape(
+        lambda b, m: model_probe.init(jax.random.PRNGKey(0), b,
+                                      cond_mask=m, train=False),
+        shapes, mask_s)
+    assign = obs.group_assignment(groups, list(variables["params"].keys()))
+    labels = obs.group_labels(groups)
+    target = labels[min(assign[k] for k in variables["params"])]
+    monkeypatch.setenv("NVS3D_FI_NAN_LOSS_AT", "1")
+    monkeypatch.setenv("NVS3D_FI_NAN_GRAD_GROUP", target)
+
+    tr = Trainer(config=cfg, use_grain=False)
+    assert list(tr._numerics_labels) == list(labels)
+    tr.train()
+    assert tr.step == 4
+
+    # The anomaly event names the poisoned layer group...
+    ev_path = obs.events_csv_path(cfg.train.results_folder)
+    with open(ev_path) as fh:
+        events = fh.read()
+    assert f"first_bad_layer={target}" in events
+    # ...the flight dump carries the same provenance...
+    dumps = list(tr.telemetry.flight.dumps)
+    assert dumps, "anomaly strike produced no flight dump"
+    with open(dumps[0]) as fh:
+        assert target in fh.read()
+    # ...and the numerics sink recorded per-group rows for the run.
+    rows = []
+    with open(obs.numerics_path(cfg.train.results_folder)) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") == "numerics":
+                rows.append(rec)
+    assert rows and set(rows[-1]["groups"]) == set(tr._numerics_labels)
+    poisoned = [r for r in rows
+                if (r["groups"].get(target, {}).get("nonfinite") or 0) > 0]
+    assert poisoned, "numerics rows never surfaced the poisoned group"
+
+    # /healthz progress facts: the snapshot reports the run's step and a
+    # fresh age; a stalled trainer only ever GROWS the age.
+    snap = tr._health_snapshot()
+    assert snap["role"] == "train" and snap["step"] == 4
+    assert snap["last_step_age_s"] >= 0.0
+    tr._last_step_t -= 100.0
+    assert tr._health_snapshot()["last_step_age_s"] >= 100.0
+    tr.ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. Compile ledger: recompiles name the changed argument
+# ---------------------------------------------------------------------------
+def test_compile_ledger_recompile_diff_names_changed_argument(tmp_path):
+    import jax.numpy as jnp
+
+    run = str(tmp_path)
+    led = obs.CompileLedger(run)
+    fp_a = obs.fingerprint_args({"w": jnp.zeros((2, 3))}, static=("cfg", 1))
+    assert fp_a["args"] == {"arg0['w']": "float32[2, 3]"}
+    first = led.record("train_step", fp_a, wall_s=1.234, hlo="deadbeef0123",
+                       backend="cpu")
+    assert first["kind"] == "compile" and first["wall_s"] == 1.234
+    # Same fingerprint again: a cache hit, not a recompile.
+    assert led.record("train_step", fp_a)["kind"] == "compile"
+    # Batch-size flip: recompile whose diff names the leaf that moved.
+    fp_b = obs.fingerprint_args({"w": jnp.zeros((4, 3))}, static=("cfg", 1))
+    entry = led.record("train_step", fp_b)
+    assert entry["kind"] == "recompile"
+    assert "arg0['w']" in entry["changed"]
+    assert "float32[2, 3] -> float32[4, 3]" in entry["changed"]
+    # Static-config drift is named too (digest line, no arg diff).
+    fp_c = obs.fingerprint_args({"w": jnp.zeros((4, 3))}, static=("cfg", 2))
+    assert "static digest" in led.record("train_step", fp_c)["changed"]
+    # Disk roundtrip feeds the CLI and the serve_bench assert printer.
+    entries = obs.load_ledger(run)
+    assert [e["kind"] for e in entries] == [
+        "compile", "compile", "recompile", "recompile"]
+    assert obs.last_recompile(run)["changed"] == "static digest: " \
+        f"{fp_b['static']} -> {fp_c['static']}"
+
+
+# ---------------------------------------------------------------------------
+# 5. /healthz provider contract + serving-plane snapshot
+# ---------------------------------------------------------------------------
+def test_healthz_provider_json_and_fallback():
+    reg = obs.MetricsRegistry()
+    server = obs.start_metrics_server(reg, port=0)
+    try:
+        t0 = time.time() - 42.5
+        server.set_health_provider(
+            lambda: {"status": "ok", "role": "train",
+                     "last_step_age_s": round(time.time() - t0, 3)})
+        body = json.loads(urlopen(server.url("/healthz"), timeout=5).read())
+        assert body["role"] == "train"
+        assert body["last_step_age_s"] >= 42.0  # stalled: age keeps growing
+        # ...while the metrics endpoint stays answering (the wedged-but-
+        # listening signature an external prober alarms on).
+        assert urlopen(server.url("/metrics"), timeout=5).status == 200
+
+        def broken():
+            raise RuntimeError("provider died")
+
+        server.set_health_provider(broken)
+        assert urlopen(server.url("/healthz"),
+                       timeout=5).read() == b"ok\n"
+    finally:
+        server.close()
+
+
+def test_serve_health_snapshot_and_build_ledger(tmp_path):
+    import jax.numpy as jnp
+
+    from novel_view_synthesis_3d_tpu.config import ServeConfig
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+    from novel_view_synthesis_3d_tpu.sample.service import (
+        SamplingService, request_cond_from_batch)
+
+    dcfg = DiffusionConfig(timesteps=3, sample_timesteps=3)
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=2, sidelength=16, seed=0)
+    mb = {"x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+          "logsnr": jnp.zeros((2,)), "R1": jnp.asarray(batch["R1"]),
+          "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+          "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"])}
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((2,)), train=False)["params"]
+    run = str(tmp_path)
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(max_batch=2, flush_timeout_ms=10.0, queue_depth=8),
+        results_folder=run, model_version="v7")
+    try:
+        snap = svc.health_snapshot()
+        assert snap["role"] == "serve" and snap["status"] == "ok"
+        assert snap["dispatches"] == 0 and snap["queue_depth"] == 0
+        assert snap["model_version"] == "v7"
+        svc._last_dispatch_t -= 50.0  # stalled dispatcher: age grows
+        assert svc.health_snapshot()["last_dispatch_age_s"] >= 50.0
+
+        cond = request_cond_from_batch(mb, 0)
+        svc.submit(cond, seed=7).result(timeout=300)
+        snap = svc.health_snapshot()
+        assert snap["dispatches"] >= 1
+        assert snap["last_dispatch_age_s"] < 50.0  # heartbeat reset
+        # The kept program build landed in the compile ledger with the
+        # cache key spelled out field by field.
+        entries = obs.load_ledger(run)
+        assert entries and all(
+            e["name"].startswith("serve_") for e in entries)
+        assert any("bucket" in e["fingerprint"]["args"] for e in entries)
+    finally:
+        svc.stop()
+    assert svc.health_snapshot()["status"] == "stopped"
+
+
+# ---------------------------------------------------------------------------
+# 6. Per-op cost map
+# ---------------------------------------------------------------------------
+def test_xunet_costmap_covers_every_op(tmp_path):
+    from novel_view_synthesis_3d_tpu.models.xunet import pipeline_op_specs
+    from novel_view_synthesis_3d_tpu.train.trainer import _sample_model_batch
+
+    cfg = _step_cfg()
+    rows = obs.xunet_costmap(
+        cfg, _sample_model_batch(make_example_batch(
+            batch_size=2, sidelength=16, seed=0)))
+    specs = pipeline_op_specs(cfg.model)
+    assert len(rows) == len(specs)
+    assert [r["op"] for r in rows] == list(range(len(specs)))
+    assert all(r["group"] for r in rows)
+    assert all(r["flops"] is None or r["flops"] > 0 for r in rows)
+    assert any(isinstance(r["flops"], float) for r in rows), \
+        "cost_analysis returned no per-op flops at all"
+    path = obs.write_costmap(str(tmp_path), rows)
+    assert os.path.basename(path) == "costmap.json"
+    assert obs.load_costmap(str(tmp_path)) == rows
+
+
+# ---------------------------------------------------------------------------
+# 7. CLI: nvs3d obs numerics / compiles
+# ---------------------------------------------------------------------------
+def _write_numerics_rows(run, rows):
+    bus = obs.EventBus(run, jsonl=False)
+    for row in rows:
+        bus.numerics_row(row)
+    bus.close()
+
+
+def _group(grad_norm, nonfinite=0):
+    return {"grad_norm": grad_norm, "param_norm": 2.0,
+            "update_ratio": 1e-3, "grad_max": grad_norm,
+            "nonfinite": nonfinite}
+
+
+def test_cli_obs_numerics_triage_rcs(tmp_path, capsys):
+    from novel_view_synthesis_3d_tpu import cli
+
+    run = str(tmp_path)
+    _write_numerics_rows(run, [
+        {"kind": "numerics", "step": 0, "groups": {"g0": _group(1.0)}},
+        {"kind": "numerics_spike", "step": 2, "group": "g0", "z": 8.0,
+         "grad_norm": 50.0},
+        {"kind": "numerics", "step": 2,
+         "groups": {"g0": _group(50.0, nonfinite=1)}},
+    ])
+    obs.append_event(run, 2, "anomaly",
+                     "non-finite step skipped first_bad_layer=g0")
+    rc = cli.main(["obs", "numerics", run, "--json"])
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1  # spike still burning, anomaly never cleared
+    assert doc["unresolved_spikes"] and doc["unresolved_anomalies"]
+    # A later clean row resolves both; rc drops to 0.
+    _write_numerics_rows(run, [
+        {"kind": "numerics", "step": 3, "groups": {"g0": _group(0.9)}}])
+    assert cli.main(["obs", "numerics", run, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert not doc["unresolved_spikes"] and not doc["unresolved_anomalies"]
+    # Text mode renders the table + resolved timeline.
+    assert cli.main(["obs", "numerics", run]) == 0
+    out = capsys.readouterr().out
+    assert "g0" in out and "[resolved]" in out
+    # An untraced run refuses loudly instead of printing empties.
+    with pytest.raises(SystemExit, match="numerics"):
+        cli.main(["obs", "numerics", str(tmp_path / "empty")])
+
+
+def test_cli_obs_compiles_why(tmp_path, capsys):
+    from novel_view_synthesis_3d_tpu import cli
+
+    run = str(tmp_path)
+    led = obs.CompileLedger(run)
+    led.record("train_step", {"args": {"arg0['z']": "float32[4, 16]"}},
+               wall_s=2.0, hlo="abc123")
+    led.record("train_step", {"args": {"arg0['z']": "float32[8, 16]"}})
+    rc = cli.main(["obs", "compiles", run, "--json"])
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and doc["recompiles"] == 1  # recompile present -> rc=1
+    assert cli.main(["obs", "compiles", run, "--why", "1"]) == 1
+    out = capsys.readouterr().out
+    assert "arg0['z']" in out and "float32[8, 16]" in out
+    with pytest.raises(SystemExit, match="recompile"):
+        cli.main(["obs", "compiles", run, "--why", "5"])
+    with pytest.raises(SystemExit, match="compile ledger"):
+        cli.main(["obs", "compiles", str(tmp_path / "empty")])

@@ -277,7 +277,9 @@ def test_no_direct_csv_writers_outside_obs():
                 if (isinstance(node, ast.Constant)
                         and isinstance(node.value, str)
                         and node.value in ("events.csv", "metrics.csv",
-                                           "telemetry.jsonl")):
+                                           "telemetry.jsonl",
+                                           "numerics.jsonl",
+                                           "compiles.jsonl")):
                     offenders.append(
                         f"{os.path.relpath(path, pkg_root)}:{node.lineno}"
                         f" -> {node.value!r}")

@@ -158,14 +158,73 @@ def multichip_verdicts(rounds: List[dict]) -> List[dict]:
     return points
 
 
+def attribute_regression(prior_docs: List[dict],
+                         newest_doc: Optional[dict]) -> Optional[str]:
+    """One-line WHERE for a sentry trip: the span whose p50 grew most
+    vs the prior rounds' median, plus the cost-map group whose FLOPs
+    moved most vs the last round that carried a cost map. Reads the
+    telemetry bench.py embeds in each judged record; returns None when
+    neither the newest nor the prior rounds carry any."""
+    if not newest_doc:
+        return None
+    parts = []
+    spans = ((newest_doc.get("telemetry") or {}).get("spans") or {})
+    worst = None
+    for name, s in spans.items():
+        p50 = s.get("p50_s")
+        if not isinstance(p50, (int, float)) or p50 <= 0:
+            continue
+        prior = [((d.get("telemetry") or {}).get("spans") or {})
+                 .get(name, {}).get("p50_s") for d in prior_docs]
+        prior = [p for p in prior if isinstance(p, (int, float)) and p > 0]
+        if not prior:
+            continue
+        base = statistics.median(prior)
+        drift = (p50 - base) / base * 100.0
+        if worst is None or drift > worst[1]:
+            worst = (name, drift, p50, base)
+    if worst is not None:
+        name, drift, p50, base = worst
+        parts.append(f"span '{name}' p50 {p50 * 1e3:.1f}ms vs prior "
+                     f"median {base * 1e3:.1f}ms ({drift:+.0f}%)")
+    new_cm = {r.get("group"): r.get("flops")
+              for r in (newest_doc.get("costmap") or [])
+              if isinstance(r.get("flops"), (int, float))}
+    old_cm = {}
+    for d in reversed(prior_docs):
+        old_cm = {r.get("group"): r.get("flops")
+                  for r in (d.get("costmap") or [])
+                  if isinstance(r.get("flops"), (int, float))}
+        if old_cm:
+            break
+    worst_cm = None
+    for group, flops in new_cm.items():
+        base = old_cm.get(group)
+        if not base:
+            continue
+        drift = (flops - base) / base * 100.0
+        if worst_cm is None or abs(drift) > abs(worst_cm[1]):
+            worst_cm = (group, drift)
+    if worst_cm is not None and abs(worst_cm[1]) >= 0.5:
+        parts.append(f"costmap: group '{worst_cm[0]}' flops "
+                     f"{worst_cm[1]:+.0f}% vs last mapped round")
+    if not parts:
+        return ("no span/costmap telemetry in the compared rounds — "
+                "re-run with telemetry-era bench.py for attribution")
+    return "; ".join(parts)
+
+
 def judge(dirpath: str,
           tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
-          fresh_vs: Optional[float] = None) -> dict:
+          fresh_vs: Optional[float] = None,
+          fresh_doc: Optional[dict] = None) -> dict:
     """Whole-archive verdict: ``regressed`` is True iff the NEWEST
     judgeable round of either trajectory regressed (older regressions
-    are history — they already had their round to page)."""
-    bench = bench_verdicts(load_rounds(dirpath, "BENCH"),
-                           tolerance_pct, fresh_vs=fresh_vs)
+    are history — they already had their round to page). ``fresh_doc``
+    (the judged record bench.py just built, when judging ``fresh_vs``)
+    feeds the trip attribution its span/costmap telemetry."""
+    rounds = load_rounds(dirpath, "BENCH")
+    bench = bench_verdicts(rounds, tolerance_pct, fresh_vs=fresh_vs)
     multichip = multichip_verdicts(load_rounds(dirpath, "MULTICHIP"))
 
     def newest(points):
@@ -173,6 +232,16 @@ def judge(dirpath: str,
         return judged[-1] if judged else None
 
     nb, nm = newest(bench), newest(multichip)
+    attribution = None
+    if nb and nb["regressed"]:
+        judged_docs = [(r["doc"] or {}).get("parsed") or {}
+                       for r in rounds
+                       if (r["doc"] or {}).get("rc") == 0]
+        if fresh_vs is not None:
+            attribution = attribute_regression(judged_docs, fresh_doc)
+        elif judged_docs:
+            attribution = attribute_regression(judged_docs[:-1],
+                                               judged_docs[-1])
     return {
         "bench": bench,
         "multichip": multichip,
@@ -180,6 +249,7 @@ def judge(dirpath: str,
         "newest_multichip": nm,
         "regressed": bool((nb and nb["regressed"])
                           or (nm and nm["regressed"])),
+        "attribution": attribution,
         "tolerance_pct": tolerance_pct,
     }
 
@@ -224,6 +294,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("verdict: "
               + ("REGRESSION (newest round below trajectory)"
                  if verdict["regressed"] else "healthy"))
+        if verdict["regressed"] and verdict.get("attribution"):
+            print(f"attribution: {verdict['attribution']}")
     return REGRESSION_RC if verdict["regressed"] else 0
 
 

@@ -75,6 +75,29 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 
+def print_recompile_culprit(
+        results_folder: str = "/tmp/nvs3d_serve_bench") -> None:
+    """Attribution line under a violated zero-recompile assert: the
+    service records every kept program build in the compile ledger
+    (obs/compiles.py), so the newest recompile entry names WHICH cache
+    -key field changed. Printed best-effort — the assert already set
+    rc=1; this only makes the page actionable."""
+    try:
+        from novel_view_synthesis_3d_tpu import obs
+        entry = obs.last_recompile(results_folder)
+    except Exception:
+        return
+    if entry is None:
+        print(f"  ledger: no recompile entry in "
+              f"{results_folder}/compiles.jsonl — the extra build landed "
+              "under a fresh ledger name (first build of a new program), "
+              "check `nvs3d obs compiles` for the full build list",
+              file=sys.stderr)
+        return
+    print(f"  ledger culprit [{entry.get('name')}]: "
+          f"{entry.get('changed')}", file=sys.stderr)
+
+
 def get_default_timesteps(preset: str) -> int:
     from novel_view_synthesis_3d_tpu.config import get_preset
 
@@ -770,6 +793,7 @@ def check_trajectory(traj: dict) -> int:
               f"{ring['commit_jit_entries_delta']}) — bank fill, pose, "
               "schedule and guidance are device arguments; warm mixed "
               "traffic must not recompile", file=sys.stderr)
+        print_recompile_culprit()
         rc = 1
     if traj["ring_vs_naive"] < 2.0:
         print(f"error: ring-native orbit generation is only "
@@ -973,6 +997,7 @@ def check_precision_sweep(sweep: dict) -> int:
                   f"{lane['programs_built_delta']} program(s) during the "
                   "warm trace — precision rides the cache key; warm "
                   "traffic must not recompile", file=sys.stderr)
+            print_recompile_culprit()
             rc = 1
     return rc
 
@@ -1359,6 +1384,7 @@ def check_chaos(chaos: dict) -> int:
               f"{chaos['jit_cache_entries_delta']}) — quarantine, "
               "restart and swap recovery are in-program / supervisor "
               "concerns, never a recompile", file=sys.stderr)
+        print_recompile_culprit("/tmp/nvs3d_serve_chaos")
         rc = 1
     return rc
 
@@ -1529,6 +1555,8 @@ def check_reqtrace(rt: dict) -> int:
                   f"{d['jit_cache_entries_delta']}) — request tracing "
                   "is host-side and must not perturb program identity",
                   file=sys.stderr)
+            if d.get("run_dir"):
+                print_recompile_culprit(d["run_dir"])
             rc = 1
     if rt["overhead_pct"] > rt["overhead_tolerance_pct"]:
         print(f"error: tracing overhead {rt['overhead_pct']}% exceeds "
@@ -1857,6 +1885,7 @@ def main() -> int:
                   f"{sweep_delta} new stepper program(s) — the stepper "
                   "program cache must be keyed on bucket/shape only "
                   "(steps/t/w are device arguments)", file=sys.stderr)
+            print_recompile_culprit()
             return 1
         return 0
 
@@ -1935,6 +1964,7 @@ def main() -> int:
             print("error: warm mixed-size sweep triggered new sampler "
                   f"compilations ({sweep}) — the program cache is not "
                   "holding its zero-recompile contract", file=sys.stderr)
+            print_recompile_culprit()
             return 1
         return 0
     finally:

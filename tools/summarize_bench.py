@@ -501,6 +501,134 @@ def chaos_lines(rows):
     return lines
 
 
+def numerics_lines(search_dirs):
+    """Numerics-observatory digest per numerics.jsonl (obs/numerics.py):
+    row/spike counts, the worst spike (group + z), and any anomaly
+    events whose detail names a first_bad_layer — the per-layer-group
+    NaN provenance next to the numbers it poisoned. Runs recorded
+    before the observatory (or with train.numerics.enabled=false) are
+    skipped LOUDLY, not silently."""
+    import csv
+    import glob
+
+    lines = ["", "## Numerics (grad/param norms per layer group, "
+                 "from numerics.jsonl)", ""]
+    found = []
+    for d in search_dirs:
+        for path in sorted(glob.glob(
+                os.path.join(d, "**", "numerics.jsonl"), recursive=True)):
+            rows = spikes = 0
+            worst = None  # (z, group, step)
+            groups = set()
+            try:
+                with open(path) as fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue  # torn tail line
+                        if rec.get("kind") == "numerics":
+                            rows += 1
+                            groups.update(rec.get("groups") or {})
+                        elif rec.get("kind") == "numerics_spike":
+                            spikes += 1
+                            z = float(rec.get("z", 0.0))
+                            if worst is None or z > worst[0]:
+                                worst = (z, rec.get("group", "?"),
+                                         rec.get("step"))
+            except OSError:
+                continue
+            found.append((path, rows, len(groups), spikes, worst))
+    if not found:
+        lines.append("- none recorded — SKIPPED: no numerics.jsonl under "
+                     "the scanned dirs (pre-observatory round, or the run "
+                     "trained with train.numerics.enabled=false)")
+        return lines
+    for path, rows, n_groups, spikes, worst in found:
+        spike_txt = f" spikes={spikes}"
+        if worst is not None:
+            spike_txt += (f" (worst z={worst[0]:.1f} group={worst[1]}"
+                          f" step={worst[2]})")
+        lines.append(f"- `{path}`: rows={rows} groups={n_groups}"
+                     + spike_txt)
+    # Anomaly provenance: the guard stamps first_bad_layer=<group> into
+    # the anomaly event detail; a NaN with a named layer group belongs
+    # in the same digest as the spike that preceded it.
+    for d in search_dirs:
+        for path in sorted(glob.glob(
+                os.path.join(d, "**", "events.csv"), recursive=True)):
+            try:
+                with open(path, newline="") as fh:
+                    for row in csv.DictReader(fh):
+                        if (row.get("event") == "anomaly"
+                                and "first_bad_layer="
+                                in (row.get("detail") or "")):
+                            lines.append(
+                                f"- anomaly `{path}` step="
+                                f"{row.get('step')}: {row.get('detail')}")
+            except (OSError, csv.Error):
+                continue
+    return lines
+
+
+def costmap_lines(search_dirs, rows):
+    """Per-op FLOPs attribution: the top ops from each costmap.json
+    (obs/compiles.xunet_costmap) plus any cost map embedded in a judged
+    bench record. Rounds banked before the cost map existed are named
+    as skipped so 'no table' never reads as 'no cost'."""
+    import glob
+
+    lines = ["", "## Cost map (per-op FLOPs/bytes, from costmap.json)", ""]
+    maps = []  # (origin, rows)
+    seen = set()
+    for d in search_dirs:
+        for path in sorted(glob.glob(
+                os.path.join(d, "**", "costmap.json"), recursive=True)):
+            if path in seen:
+                continue
+            seen.add(path)
+            try:
+                with open(path) as fh:
+                    doc = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                lines.append(f"- `{path}`: SKIPPED (malformed)")
+                continue
+            maps.append((path, doc.get("ops", [])))
+    for name, d in rows:
+        cm = d.get("costmap")
+        if isinstance(cm, list) and cm:
+            maps.append((f"{name} (embedded)", cm))
+    if not maps:
+        lines.append("- none recorded — SKIPPED: no costmap.json and no "
+                     "embedded costmap in any judged record (pre-cost-map "
+                     "round, or bench ran with NVS3D_BENCH_COST=0)")
+        return lines
+    for origin, ops in maps:
+        costed = [r for r in ops
+                  if isinstance(r.get("flops"), (int, float))]
+        total = sum(r["flops"] for r in costed)
+        lines.append(f"- `{origin}`: {len(ops)} ops, "
+                     f"total {total / 1e9:.2f} GFLOP")
+        if not costed:
+            lines.append("  - SKIPPED: no per-op flops (cost_analysis "
+                         "returned the legacy list form)")
+            continue
+        top = sorted(costed, key=lambda r: r["flops"], reverse=True)[:5]
+        lines += ["", "  | op | group | GFLOP | share | MB |",
+                  "  |---|---|---|---|---|"]
+        for r in top:
+            byts = r.get("bytes")
+            lines.append(
+                "  | {} {} | {} | {:.2f} | {:.1%} | {} |".format(
+                    r.get("op"), r.get("name", r.get("kind", "?")),
+                    r.get("group"), r["flops"] / 1e9,
+                    r["flops"] / total if total else 0.0,
+                    f"{byts / 1e6:.1f}"
+                    if isinstance(byts, (int, float)) else "-"))
+        lines.append("")
+    return lines
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     out_dir = args[0] if args else os.path.join("results", "tpu_r04")
@@ -593,6 +721,11 @@ def main() -> int:
     # Input-pipeline health: did the loader ever sit on the step loop's
     # critical path (data_fetch vs train_step, overlap ratio)?
     lines += input_pipeline_lines(telem)
+    # Numerics observatory + per-op cost attribution: spike/anomaly
+    # digest from numerics.jsonl and the top-FLOPs ops from each
+    # costmap.json (or the copy embedded in a judged bench record).
+    lines += numerics_lines([out_dir] + quality_dirs)
+    lines += costmap_lines([out_dir] + quality_dirs, rows)
     text = "\n".join(lines) + "\n"
     print(text)
     if "--write" in sys.argv:
