@@ -350,35 +350,12 @@ def cmd_sample(args, overrides: List[str]) -> int:
 # ---------------------------------------------------------------------------
 # serve
 # ---------------------------------------------------------------------------
-def submit_with_retry(submit, *, retries: int = 4, sleep=None, rng=None):
-    """Call `submit` (a zero-arg closure over service.submit/
-    submit_trajectory), honoring the service's structured rejections.
-
-    A rejection with `retryable=True` carries `retry_after_s` — the
-    server's own estimate of when capacity returns (brownout shed,
-    drain-for-restart, queue full). The client waits that long plus up
-    to 50% jitter (so a herd of rejected clients doesn't re-arrive in
-    lockstep) and retries, at most `retries` more times; a non-retryable
-    rejection or an exhausted budget re-raises the last error.
-
-    `sleep`/`rng` are injection points for tests (real time.sleep and a
-    fresh random.Random by default).
-    """
-    import random
-    import time
-
-    sleep = sleep if sleep is not None else time.sleep
-    rng = rng if rng is not None else random.Random()
-    for attempt in range(retries + 1):
-        try:
-            return submit()
-        except Exception as e:
-            if not getattr(e, "retryable", False) or attempt == retries:
-                raise
-            base = float(getattr(e, "retry_after_s", 0.0) or 0.0)
-            if base <= 0.0:
-                base = 0.05 * (2 ** attempt)
-            sleep(base * (1.0 + 0.5 * rng.random()))
+# Canonical implementation lives in sample/client.py so the CLI client
+# and the fleet router (serve/router.py) share one retry/backoff/jitter
+# loop; re-exported here because tests and external callers import it
+# from cli.
+from novel_view_synthesis_3d_tpu.sample.client import (  # noqa: F401
+    submit_with_retry)
 
 
 def cmd_serve(args, overrides: List[str]) -> int:
@@ -1239,6 +1216,33 @@ def cmd_obs(args, overrides: List[str]) -> int:
     sub = args.obs_command
 
     if sub == "trace":
+        # Fleet layout (<run>/router/ + <run>/replica_<name>/ — the
+        # `nvs3d route` / serve_bench --fleet convention): reconstruct
+        # cross-replica timelines keyed by the trace_id the router
+        # threaded through every hop, then verify the fleet invariants
+        # (hop/failover accounting, replica-side joins).
+        per_source = reqtrace.load_fleet_rows(args.run)
+        if per_source.get("router"):
+            fleet = reqtrace.reconstruct_fleet(per_source)
+            problems = reqtrace.verify_fleet(fleet, per_source)
+            if args.trace_id:
+                fleet = {t: tl for t, tl in fleet.items()
+                         if t == args.trace_id}
+                if not fleet:
+                    raise SystemExit(
+                        f"trace {args.trace_id!r} not found in fleet "
+                        f"dir {args.run!r}")
+            if args.json:
+                print(json.dumps({"fleet": True,
+                                  "timelines": list(fleet.values()),
+                                  "problems": problems}))
+            else:
+                for tid in sorted(fleet):
+                    print(reqtrace.format_fleet_timeline(fleet[tid]))
+                    print()
+                for p in problems:
+                    print(f"PROBLEM: {p}")
+            return 1 if problems else 0
         rows = reqtrace.load_rows(args.run)
         if not rows:
             raise SystemExit(
@@ -1483,6 +1487,66 @@ def _obs_compiles(args) -> int:
           + (" — `--why N` shows the Nth recompile's full diff"
              if recompiles else ""))
     return 1 if recompiles else 0
+
+
+# ---------------------------------------------------------------------------
+def cmd_route(args, overrides: List[str]) -> int:
+    """Fleet front-end operations against running replica processes
+    (serve/replica_main.py, or any ReplicaServer).
+
+    `status`: poll every replica's /healthz through a FleetRouter and
+    print the aggregated fleet snapshot (dispatch eligibility, step
+    debt, breaker states, live SLO burn); rc=1 unless every replica is
+    dispatchable. `deploy`: zero-downtime rolling deploy — move the
+    registry channel, then per replica quiesce → drain-to-idle → poke
+    the watcher → verify the swap → readmit → SLO-burn probation, with
+    fleet-wide auto-rollback on any gate failure (serve/deploy.py);
+    rc=0 only when the report says 'deployed'. Replicas are named
+    `--replica name=http://host:port` (bare URLs get r0, r1, ...).
+    """
+    from novel_view_synthesis_3d_tpu.serve import (
+        FleetRouter,
+        HttpReplica,
+        rolling_deploy,
+    )
+
+    cfg = build_config(args, overrides)
+    handles = []
+    for i, spec in enumerate(args.replica or []):
+        name, sep, url = spec.partition("=")
+        if not sep:
+            name, url = f"r{i}", spec
+        handles.append(HttpReplica(name, url))
+    if not handles:
+        raise SystemExit("no replicas: pass --replica name=URL "
+                         "(repeatable)")
+    router = FleetRouter(handles, rcfg=cfg.router)
+    sub = args.route_command
+
+    if sub == "status":
+        router.poll_health()
+        snap = router.fleet_snapshot()
+        snap["slo"] = router.fleet_slo()
+        print(json.dumps(snap, indent=None if args.json else 2,
+                         sort_keys=True))
+        return 0 if snap["healthy"] == snap["total"] else 1
+
+    if sub == "deploy":
+        from novel_view_synthesis_3d_tpu.registry import RegistryStore
+
+        store = RegistryStore(args.dir)
+        version = args.version or store.read_channel(args.from_channel)
+        if not version:
+            raise SystemExit(
+                f"no deploy target: --version not given and channel "
+                f"{args.from_channel!r} points at no version")
+        router.poll_health()
+        report = rolling_deploy(router, store, args.channel, version,
+                                rcfg=cfg.router)
+        print(json.dumps(report))
+        return 0 if report["status"] == "deployed" else 1
+
+    raise SystemExit(f"unknown route command {sub!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -1836,6 +1900,43 @@ def make_parser() -> argparse.ArgumentParser:
     q.add_argument("--why", type=int, default=None, metavar="N",
                    help="show the Nth recompile's full fingerprint diff")
 
+    p = sub.add_parser(
+        "route",
+        help="fleet front-end: aggregated replica health/SLO status "
+             "and zero-downtime registry-channel rolling deploys "
+             "with SLO-gated auto-rollback")
+    route_sub = p.add_subparsers(dest="route_command", required=True)
+    q = route_sub.add_parser(
+        "status",
+        help="poll every replica's /healthz and print the fleet "
+             "snapshot (eligibility, step debt, breaker, SLO burn); "
+             "rc=1 unless every replica is dispatchable")
+    _add_common(q)
+    q.add_argument("--replica", action="append", default=[],
+                   metavar="NAME=URL",
+                   help="replica endpoint (repeatable); bare URLs get "
+                        "names r0, r1, ...")
+    q.add_argument("--json", action="store_true",
+                   help="single-line JSON (default: indented)")
+    q = route_sub.add_parser(
+        "deploy",
+        help="rolling deploy: move the registry channel, then per "
+             "replica quiesce -> drain -> swap -> SLO-burn probation; "
+             "auto-rollback on any gate failure; rc=0 only on "
+             "'deployed'")
+    _add_common(q)
+    q.add_argument("--replica", action="append", default=[],
+                   metavar="NAME=URL")
+    q.add_argument("--dir", required=True, help="registry root directory")
+    q.add_argument("--channel", default="stable",
+                   help="channel the fleet subscribes to")
+    q.add_argument("--version", default=None,
+                   help="target version id (default: head of "
+                        "--from-channel)")
+    q.add_argument("--from-channel", default="latest",
+                   help="channel supplying the target when no "
+                        "--version is given")
+
     return parser
 
 
@@ -1851,6 +1952,7 @@ _COMMANDS = {
     "registry": cmd_registry,
     "distill": cmd_distill,
     "obs": cmd_obs,
+    "route": cmd_route,
 }
 
 

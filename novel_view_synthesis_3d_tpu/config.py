@@ -570,11 +570,72 @@ class ServeConfig:
     # stall-style all-thread-stacks diagnosis and raises instead of
     # silently leaking a wedged thread (PR 2 watchdog convention).
     stop_timeout_s: float = 10.0
+    # Minimum wall-clock per ring dispatch, milliseconds (0 = off). After
+    # the device work of a dispatch completes, the worker sleeps out the
+    # residual — a PACING floor, not a slowdown of the device program.
+    # Two uses: (a) rate-limiting a replica that shares a host with
+    # latency-sensitive neighbors; (b) fleet drills on few-core CI hosts,
+    # where N CPU replicas otherwise contend for the same core and a
+    # router scaling lane measures scheduler noise instead of dispatch
+    # overlap — the sleep releases the GIL/core, emulating N device-bound
+    # replicas honestly (tools/serve_bench.py --fleet records the floor
+    # it ran with in the artifact).
+    step_floor_ms: float = 0.0
     # Brownout degradation ladder (off by default).
     brownout: BrownoutConfig = dataclasses.field(
         default_factory=BrownoutConfig)
     # Per-step-class latency SLOs + burn-rate alerting (off by default).
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Fleet router (serve/router.py; `nvs3d route`; docs/DESIGN.md
+    "Fleet serving").
+
+    A thin front-end that spreads traffic over N SamplingService
+    replicas: least-step-debt dispatch fed by each replica's /healthz
+    gauges, session affinity for trajectory orbits, transparent failover
+    on death/drain/retryable rejection, and registry-channel rolling
+    deploys gated on SLO burn + swap-breaker state."""
+
+    # Health-poll period for the background poller (seconds). Between
+    # polls the router tracks its own outstanding-steps delta per
+    # replica, so dispatch pressure is poll-fresh + local-accurate.
+    health_poll_s: float = 0.5
+    # A polled snapshot older than this is STALE: the replica is treated
+    # as unknown-health (dispatchable only if nothing fresh is) rather
+    # than trusted at its last-known debt.
+    health_ttl_s: float = 5.0
+    # Failover budget PER REQUEST: how many times a request may be
+    # re-routed (replica died, drained, or shed retryably) before the
+    # router gives up and surfaces the structured error to the caller.
+    # Distinct from sample/client.submit_with_retry's retries: that loop
+    # re-asks the SAME endpoint later; this budget moves the request
+    # ACROSS replicas now.
+    retry_budget: int = 3
+    # When EVERY eligible replica sheds (fleet-wide brownout) the router
+    # does NOT burn the retry budget spinning across replicas — it
+    # raises FleetSaturated (retryable, carrying the fleet's max
+    # retry_after_s) after this many full-fleet sweeps.
+    saturation_sweeps: int = 1
+    # Session-affinity table capacity (orbit sessions pinned to the
+    # replica holding their frame bank); oldest entries evict first.
+    affinity_entries: int = 1024
+    # --- rolling deploy (serve/deploy.py; `nvs3d route deploy`) ---
+    # Per-replica router-level drain budget: out-of-rotation wait for
+    # step_debt+queue_depth to hit zero before the channel poke.
+    deploy_drain_timeout_s: float = 30.0
+    # Post-swap probation: the canary serves back in rotation this long
+    # while the gate watches its SLO fast-burn and swap breaker.
+    deploy_probation_s: float = 2.0
+    # Gate threshold: probation fails when the replica's fast-window SLO
+    # burn rate reaches this (default = the fast-window page threshold,
+    # SLOConfig.fast_burn).
+    deploy_burn_max: float = 14.0
+    # Budget for a poked replica to report the target model_version
+    # before the deploy declares the swap failed and rolls back.
+    deploy_swap_timeout_s: float = 30.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -739,6 +800,8 @@ class Config:
         default_factory=RegistryConfig)
     distill: DistillConfig = dataclasses.field(
         default_factory=DistillConfig)
+    router: RouterConfig = dataclasses.field(
+        default_factory=RouterConfig)
 
     # ------------------------------------------------------------------
     # Validation
@@ -1122,6 +1185,34 @@ class Config:
             errors.append(
                 f"serve.slo burn thresholds ({slo.fast_burn}, "
                 f"{slo.slow_burn}) must be > 0")
+        if sv.step_floor_ms < 0:
+            errors.append(
+                f"serve.step_floor_ms={sv.step_floor_ms} must be >= 0 "
+                "(0 disables dispatch pacing)")
+        rt = self.router
+        for fname in ("health_poll_s", "health_ttl_s",
+                      "deploy_drain_timeout_s", "deploy_probation_s",
+                      "deploy_burn_max", "deploy_swap_timeout_s"):
+            if getattr(rt, fname) <= 0:
+                errors.append(
+                    f"router.{fname}={getattr(rt, fname)} must be > 0")
+        if rt.health_ttl_s < rt.health_poll_s:
+            errors.append(
+                f"router.health_ttl_s={rt.health_ttl_s} must be >= "
+                f"router.health_poll_s={rt.health_poll_s} (a snapshot "
+                "must outlive at least one poll period)")
+        if rt.retry_budget < 0:
+            errors.append(
+                f"router.retry_budget={rt.retry_budget} must be >= 0 "
+                "(0 = no failover, surface the first error)")
+        if rt.saturation_sweeps < 1:
+            errors.append(
+                f"router.saturation_sweeps={rt.saturation_sweeps} must "
+                "be >= 1 (full-fleet shed sweeps before FleetSaturated)")
+        if rt.affinity_entries < 1:
+            errors.append(
+                f"router.affinity_entries={rt.affinity_entries} must be "
+                ">= 1 (orbit sessions need at least one pin slot)")
         if self.obs.telemetry_max_mb < 0:
             errors.append(
                 f"obs.telemetry_max_mb={self.obs.telemetry_max_mb} must "

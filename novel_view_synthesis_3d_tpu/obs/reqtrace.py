@@ -303,6 +303,188 @@ def export_perfetto(tl: dict, path: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Fleet reconstruction (router + N replica run dirs → cross-replica
+# timelines). Request ids are SERVICE-LOCAL (each replica numbers its
+# own), so the merge key is the trace_id the router threads through
+# every hop: reconstruct each replica's telemetry independently, then
+# join replica timelines onto the router's hop records by trace_id.
+# ---------------------------------------------------------------------------
+ROUTER_SPAN_NAMES = ("router_submit", "router_hop", "router_respond")
+
+
+def load_fleet_rows(fleet_dir: str) -> Dict[str, List[dict]]:
+    """Per-source telemetry rows for a fleet run dir laid out as
+    ``<fleet_dir>/router/`` + ``<fleet_dir>/replica_<name>/`` (the
+    serve_bench --fleet / `nvs3d route` convention). Sources with no
+    telemetry file are omitted; an empty result means `fleet_dir` is
+    not a fleet dir."""
+    out: Dict[str, List[dict]] = {}
+    try:
+        entries = sorted(os.listdir(fleet_dir))
+    except OSError:
+        return out
+    for entry in entries:
+        if entry != "router" and not entry.startswith("replica_"):
+            continue
+        sub = os.path.join(fleet_dir, entry)
+        if not os.path.isdir(sub):
+            continue
+        rows = load_rows(sub)
+        if rows:
+            out[entry] = rows
+    return out
+
+
+def reconstruct_fleet(per_source: Dict[str, List[dict]]
+                      ) -> Dict[str, dict]:
+    """{source: rows} → {trace_id: fleet timeline}. A fleet timeline is
+    the router's view (root + one record per hop + respond) with each
+    replica's OWN reconstructed timeline for that trace attached under
+    ``replica_timelines[replica]`` — the cross-replica story
+    `nvs3d obs trace` prints after a failover."""
+    fleet: Dict[str, dict] = {}
+    router_rows = per_source.get("router", [])
+    for row in router_rows:
+        if row.get("kind") != "span" \
+                or row.get("name") != "router_submit":
+            continue
+        tid = str(row.get("trace_id", ""))
+        if tid:
+            fleet[tid] = {
+                "trace_id": tid,
+                "req_kind": row.get("req_kind", "single"),
+                "steps": row.get("steps"),
+                "frames": row.get("frames"),
+                "session": row.get("session"),
+                "submit_t": row.get("t"),
+                "hops": [],
+                "respond": None,
+                "replica_timelines": {},
+            }
+    for row in router_rows:
+        if row.get("kind") != "span":
+            continue
+        tid = str(row.get("trace_id", ""))
+        if tid not in fleet:
+            continue
+        if row.get("name") == "router_hop":
+            fleet[tid]["hops"].append(row)
+        elif row.get("name") == "router_respond":
+            fleet[tid]["respond"] = row
+    for source, rows in per_source.items():
+        if not source.startswith("replica_"):
+            continue
+        replica = source[len("replica_"):]
+        for tid, tl in reconstruct(rows).items():
+            if tid in fleet:
+                fleet[tid]["replica_timelines"][replica] = tl
+    for tl in fleet.values():
+        tl["hops"].sort(key=lambda h: int(h.get("attempt") or 0))
+        tl["complete"] = tl["respond"] is not None
+        tl["outcome"] = (tl["respond"] or {}).get("outcome")
+        tl["failovers"] = (tl["respond"] or {}).get("failovers")
+    return fleet
+
+
+def verify_fleet(fleet: Dict[str, dict],
+                 per_source: Dict[str, List[dict]]) -> List[str]:
+    """Fleet-level invariants (the serve_bench --fleet chaos assertion
+    and the tier-1 fleet reconstruction test both run THIS):
+
+      - every routed request that responded ok ends on an ok hop, and
+        its hop count/failover count agree with the respond span;
+      - every ok hop lands on a replica whose own telemetry (when
+        present) holds a COMPLETE timeline for that trace — the
+        cross-replica join actually closes;
+      - each replica's own timelines are individually sound
+        (verify_timelines), problems prefixed with the source.
+    """
+    problems: List[str] = []
+    for tid, tl in sorted(fleet.items()):
+        resp = tl["respond"]
+        if resp is None:
+            problems.append(f"{tid}: no router_respond recorded")
+            continue
+        hops = tl["hops"]
+        claimed = resp.get("hops")
+        if claimed is not None and int(claimed) != len(hops):
+            problems.append(
+                f"{tid}: router counted {claimed} hops, "
+                f"reconstruction found {len(hops)}")
+        fo = resp.get("failovers")
+        observed_fo = sum(1 for h in hops
+                          if h.get("outcome") == "failover")
+        if fo is not None and int(fo) != observed_fo:
+            problems.append(
+                f"{tid}: respond says {fo} failovers, hops show "
+                f"{observed_fo}")
+        if resp.get("outcome") == "ok":
+            if not hops or hops[-1].get("outcome") != "ok":
+                problems.append(
+                    f"{tid}: responded ok but final hop outcome is "
+                    f"{hops[-1].get('outcome') if hops else 'missing'}")
+            for hop in hops:
+                if hop.get("outcome") != "ok":
+                    continue
+                replica = str(hop.get("replica", ""))
+                if f"replica_{replica}" not in per_source:
+                    continue  # replica telemetry not collected
+                rtl = tl["replica_timelines"].get(replica)
+                if rtl is None:
+                    problems.append(
+                        f"{tid}: ok hop on {replica} but no replica-"
+                        "side timeline joined for this trace")
+                elif not rtl.get("complete"):
+                    problems.append(
+                        f"{tid}: replica {replica} timeline for this "
+                        "trace is incomplete (no request_respond)")
+    for source, rows in sorted(per_source.items()):
+        if not source.startswith("replica_"):
+            continue
+        for problem in verify_timelines(reconstruct(rows), rows):
+            problems.append(f"[{source}] {problem}")
+    return problems
+
+
+def format_fleet_timeline(tl: dict) -> str:
+    """One routed request's cross-replica story as text."""
+    head = (f"trace {tl['trace_id']}  kind={tl['req_kind']}  "
+            f"steps={tl.get('steps')}")
+    if tl.get("frames"):
+        head += f"  frames={tl['frames']}"
+    if tl.get("session"):
+        head += f"  session={tl['session']}"
+    lines = [head]
+    t0 = tl.get("submit_t") or 0.0
+    for hop in tl["hops"]:
+        extra = ""
+        if hop.get("frames_done") is not None:
+            extra = f" frames_done={hop['frames_done']}"
+        if hop.get("error"):
+            extra += f"  [{hop['error']}]"
+        lines.append(
+            f"  +{(hop.get('t') or t0) - t0:8.3f}s  hop "
+            f"#{hop.get('attempt')} -> {hop.get('replica')}  "
+            f"outcome={hop.get('outcome')} "
+            f"dur={1e3 * (hop.get('dur_s') or 0.0):.1f}ms{extra}")
+    resp = tl.get("respond")
+    if resp is None:
+        lines.append("  [incomplete: no router_respond recorded]")
+    else:
+        lines.append(
+            f"  +{(resp.get('t') or t0) - t0:8.3f}s  respond "
+            f"outcome={resp.get('outcome')} "
+            f"latency={1e3 * (resp.get('latency_s') or 0.0):.1f}ms "
+            f"hops={resp.get('hops')} failovers={resp.get('failovers')}")
+    for replica, rtl in sorted(tl["replica_timelines"].items()):
+        lines.append(f"  --- replica {replica} "
+                     f"(local request_id={rtl['request_id']}) ---")
+        for sub in format_timeline(rtl).splitlines()[1:]:
+            lines.append("  " + sub)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # Cross-run span-percentile diff (``nvs3d obs diff``)
 # ---------------------------------------------------------------------------
 def span_percentiles(rows: List[dict]) -> Dict[str, dict]:

@@ -42,12 +42,20 @@ class MetricsServer:
                  port: int = 0, host: str = "127.0.0.1"):
         self.registry = registry if registry is not None else get_registry()
         self._health_provider: Optional[Callable[[], dict]] = None
+        self._metrics_extra: Optional[Callable[[], str]] = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 if self.path.split("?")[0] == "/metrics":
-                    body = outer.registry.render_prometheus().encode()
+                    text = outer.registry.render_prometheus()
+                    extra = outer._metrics_extra
+                    if extra is not None:
+                        try:
+                            text += extra()
+                        except Exception:
+                            pass  # aggregation failure ≠ scrape failure
+                    body = text.encode()
                     self.send_response(200)
                     self.send_header("Content-Type", CONTENT_TYPE)
                     self.send_header("Content-Length", str(len(body)))
@@ -90,6 +98,14 @@ class MetricsServer:
         a zero-arg callable returning a JSON-serializable dict, called
         per request on the HTTP thread so the ages it reports are live."""
         self._health_provider = provider
+
+    def set_metrics_extra(
+            self, extra: Optional[Callable[[], str]]) -> None:
+        """Install (or clear) extra Prometheus exposition text appended
+        after the local registry's render — the fleet router hangs its
+        replica-relabeled aggregation here, making the router's own
+        /metrics the single scrape surface for the whole fleet."""
+        self._metrics_extra = extra
 
     def url(self, path: str = "/metrics") -> str:
         return f"http://{self.host}:{self.port}{path}"

@@ -215,3 +215,22 @@ def attainment_from_rows(rows: List[dict],
         except (TypeError, ValueError):
             continue
     return eng.snapshot()
+
+
+def fleet_attainment(per_source: Dict[str, List[dict]],
+                     targets: Dict[int, float]) -> Dict[str, dict]:
+    """Offline SLO attainment across a fleet: `per_source` is the
+    {source: rows} map from ``reqtrace.load_fleet_rows``. Each
+    ``replica_<name>`` source is scored on its own request_respond
+    spans; the ``"fleet"`` rollup re-scores the union, so it is
+    traffic-weighted rather than a mean of per-replica attainments
+    (a near-idle replica cannot mask a busy one's misses)."""
+    out: Dict[str, dict] = {}
+    merged: List[dict] = []
+    for source, rows in sorted(per_source.items()):
+        if not source.startswith("replica_"):
+            continue
+        out[source] = attainment_from_rows(rows, targets)
+        merged.extend(rows)
+    out["fleet"] = attainment_from_rows(merged, targets)
+    return out

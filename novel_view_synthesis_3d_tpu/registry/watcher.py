@@ -39,6 +39,11 @@ from novel_view_synthesis_3d_tpu.registry.store import (
 from novel_view_synthesis_3d_tpu.utils import faultinject
 
 
+# Gauge encoding for nvs3d_swap_breaker_state (docs/DESIGN.md "Fleet
+# serving"): the deploy gate refuses a replica scraping as != 0.
+_BREAKER_STATES = {"closed": 0.0, "open": 1.0, "half-open": 2.0}
+
+
 class RegistryWatcher:
     def __init__(self, service, store: RegistryStore, channel: str, *,
                  poll_s: float = 2.0, event_cb: Optional[EventCb] = None,
@@ -64,6 +69,14 @@ class RegistryWatcher:
         self._swap_failures_total = obs.get_registry().counter(
             "nvs3d_swap_failures_total",
             "model swaps that failed verify/stage (breaker openings)")
+        # Breaker state as a gauge so the fleet deploy gate
+        # (serve/deploy.py) can refuse to proceed onto a replica whose
+        # last swap failed, without tailing events.csv.
+        self._breaker_gauge = obs.get_registry().gauge(
+            "nvs3d_swap_breaker_state",
+            "registry swap circuit breaker: 0 closed / 1 open / "
+            "2 half-open")
+        self._breaker_gauge.set(0.0)
         self._stop = threading.Event()
         self._poked = threading.Event()  # test hook: poll NOW
         self._thread = threading.Thread(
@@ -81,6 +94,22 @@ class RegistryWatcher:
         """Skip the remaining poll sleep (tests, admin endpoints)."""
         self._poked.set()
 
+    @property
+    def breaker_state(self) -> str:
+        """'closed' | 'open' | 'half-open', derived live (open→half-open
+        is a clock transition, not an event: the breaker goes half-open
+        the moment the backoff deadline passes, whether or not a poll
+        has probed yet). Reading refreshes the gauge so scrapes between
+        polls see the clock transition too."""
+        if self._failed_vid is None:
+            state = "closed"
+        elif time.monotonic() < self._retry_at:
+            state = "open"
+        else:
+            state = "half-open"
+        self._breaker_gauge.set(_BREAKER_STATES[state])
+        return state
+
     def poll_once(self) -> Optional[str]:
         """One poll: swap if the channel moved; returns the version
         swapped to, else None."""
@@ -88,6 +117,17 @@ class RegistryWatcher:
             vid = self.store.read_channel(self.channel)
         except OSError:
             return None
+        if self._failed_vid is not None and vid \
+                and vid != self._failed_vid:
+            # The channel moved OFF the artifact that tripped the
+            # breaker (a rollback, or a fresh publish superseding the
+            # bad one). The breaker guards that artifact, not the
+            # channel — reset so the new target gets a clean first
+            # attempt instead of inheriting a cooldown it never earned.
+            self._failed_vid = None
+            self.consecutive_failures = 0
+            self._retry_at = 0.0
+            self._breaker_gauge.set(_BREAKER_STATES["closed"])
         if not vid or vid == self.service.model_version:
             return None
         half_open = False
@@ -113,6 +153,7 @@ class RegistryWatcher:
                           self.breaker_base_s
                           * 2 ** (self.consecutive_failures - 1))
             self._retry_at = time.monotonic() + backoff
+            self._breaker_gauge.set(_BREAKER_STATES["open"])
             if self.event_cb is not None:
                 self.event_cb(0, "swap_fail",
                               f"channel {self.channel} -> {vid}: {exc!r}; "
@@ -134,6 +175,7 @@ class RegistryWatcher:
         self._failed_vid = None
         self.consecutive_failures = 0
         self._retry_at = 0.0
+        self._breaker_gauge.set(_BREAKER_STATES["closed"])
         return vid
 
     def stop(self) -> None:

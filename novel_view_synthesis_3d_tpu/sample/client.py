@@ -1,0 +1,53 @@
+"""Shared client-side retry/backoff for the serving surface.
+
+One implementation of the structured-rejection retry loop, used by the
+`nvs3d serve` CLI client AND the fleet router (serve/router.py): a
+`Rejected(retryable=True)` carries `retry_after_s` — the server's own
+estimate of when capacity returns — and the client honors it with
+jitter so a herd of rejected clients doesn't re-arrive in lockstep.
+Two drifting copies of this loop is exactly how a fleet ends up with
+one polite client and one retry-storming one.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+def submit_with_retry(submit, *, retries: int = 4, sleep=None, rng=None):
+    """Call `submit` (a zero-arg closure over service.submit/
+    submit_trajectory), honoring the service's structured rejections.
+
+    A rejection with `retryable=True` carries `retry_after_s` — the
+    server's own estimate of when capacity returns (brownout shed,
+    drain-for-restart, queue full). The client waits that long plus up
+    to 50% jitter (so a herd of rejected clients doesn't re-arrive in
+    lockstep) and retries, at most `retries` more times; a non-retryable
+    rejection or an exhausted budget re-raises the last error.
+
+    `sleep`/`rng` are injection points for tests (real time.sleep and a
+    fresh random.Random by default).
+    """
+    sleep = sleep if sleep is not None else time.sleep
+    rng = rng if rng is not None else random.Random()
+    for attempt in range(retries + 1):
+        try:
+            return submit()
+        except Exception as e:
+            if not getattr(e, "retryable", False) or attempt == retries:
+                raise
+            sleep(retry_delay_s(e, attempt, rng))
+
+
+def retry_delay_s(error, attempt: int, rng=None) -> float:
+    """Backoff for one retryable rejection: the server's retry_after_s
+    when it named one, else exponential from 50ms, plus up to 50%
+    jitter. Exposed separately so the router's failover loop (which
+    retries against a DIFFERENT replica, not the rejecting one) can
+    share the same backoff arithmetic."""
+    rng = rng if rng is not None else random.Random()
+    base = float(getattr(error, "retry_after_s", 0.0) or 0.0)
+    if base <= 0.0:
+        base = 0.05 * (2 ** attempt)
+    return base * (1.0 + 0.5 * rng.random())

@@ -1831,6 +1831,7 @@ class SamplingService:
                 params, z_dev, keys_dev, first_dev, cond_dev, coefs_dev,
                 w_dev)
         jax.block_until_ready(z_next)
+        self._pace_dispatch(t0)
         elapsed = time.perf_counter() - t0
         entry["warm"] = True
         # Rider attribution (obs/reqtrace.py contract): ONE row per
@@ -2207,24 +2208,59 @@ class SamplingService:
             f"serve_{self.serve.scheduler}", {"args": args},
             wall_s=build_s, backend=jax.default_backend())
 
+    def _pace_dispatch(self, t0: float) -> None:
+        """serve.step_floor_ms pacing: sleep out the residual so the
+        dispatch takes at least the floor. Runs AFTER block_until_ready
+        — the device program is untouched; the sleep releases the GIL
+        (and the core), which is the point: it rate-limits this replica
+        without burning CPU. No-op at the default 0."""
+        floor_s = self.serve.step_floor_ms / 1000.0
+        if floor_s <= 0.0:
+            return
+        residual = floor_s - (time.perf_counter() - t0)
+        if residual > 0.0:
+            time.sleep(residual)
+
     def health_snapshot(self) -> dict:
         """JSON progress facts for /healthz (obs/server.py's provider
-        contract): the dispatch heartbeat age, queue depth, and the live
-        model version — enough for a probe to tell wedged from idle
-        without scraping Prometheus."""
+        contract): the dispatch heartbeat age, queue depth, step debt,
+        brownout level, the drain state machine's state, and the live
+        model version — enough for a probe to tell wedged from idle, and
+        for the fleet router (serve/router.py) to run least-step-debt
+        dispatch and drain detection without scraping Prometheus.
+
+        `serve_state` ∈ ok|draining|stopped is the PR 11 state machine's
+        position (`status` keeps carrying the same value — it predates
+        the router and external probes key on it). `slo_fast_burn` rides
+        along when the service scores an SLO (serve.slo.targets): the
+        worst per-class fast-window burn rate, the number the rolling-
+        deploy gate (serve/deploy.py) watches during canary probation.
+        """
         with self._lock:
             depth = len(self._queue)
+            debt = self._step_debt_locked()
+            level = self._brownout_level
         state = ("stopped" if self._worker is None
                  else "draining" if self._draining else "ok")
-        return {
+        snap = {
             "status": state,
+            "serve_state": state,
             "role": "serve",
             "dispatches": int(self.dispatches),
             "queue_depth": depth,
+            "step_debt": int(debt),
+            "brownout_level": int(level),
             "last_dispatch_age_s": round(
                 time.time() - self._last_dispatch_t, 3),
             "model_version": self.model_version,
         }
+        if self.slo is not None:
+            slo_snap = self.slo.snapshot()
+            burns = [c.get("fast_burn", 0.0) for c in slo_snap.values()]
+            snap["slo_fast_burn"] = round(max(burns), 3) if burns else 0.0
+            snap["slo_breached"] = any(
+                c.get("breached") for c in slo_snap.values())
+        return snap
 
     def _cache_key(self, bucket: int, H: int, W: int, steps: int,
                    w: float) -> tuple:
@@ -2297,6 +2333,7 @@ class SamplingService:
         t0 = time.perf_counter()
         imgs = np.asarray(jax.device_get(
             entry["fn"](params, keys_dev, cond_dev)))
+        self._pace_dispatch(t0)
         elapsed = time.perf_counter() - t0
         entry["warm"] = True
         span = "compile" if cold else "device"

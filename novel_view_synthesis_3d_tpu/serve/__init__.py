@@ -1,0 +1,37 @@
+"""Fleet-scale serving (docs/DESIGN.md "Fleet serving").
+
+A thin routing layer over N independent `SamplingService` replicas —
+the Pathways/disaggregated-serving shape (PAPERS.md): each replica is
+one process with its own mesh, registry watcher, and telemetry dir;
+the router holds NO model state, only health snapshots, an
+outstanding-work ledger, and the orbit-session affinity table.
+
+  - `serve/replica.py`  — the replica boundary: LocalReplica (in-
+    process, tests), HttpReplica + ReplicaServer (subprocess fleet),
+    and the structured-error wire format that carries PR 11's
+    retryable-reject contract across the process boundary.
+  - `serve/router.py`   — FleetRouter: least-step-debt dispatch,
+    session affinity, transparent failover with per-request retry
+    budgets, fleet metrics/SLO aggregation.
+  - `serve/deploy.py`   — registry-channel rolling deploys with the
+    SLO-burn + swap-breaker gate and auto-rollback
+    (`nvs3d route deploy`).
+  - `serve/replica_main.py` — subprocess entrypoint
+    (`python -m novel_view_synthesis_3d_tpu.serve.replica_main`).
+"""
+
+from novel_view_synthesis_3d_tpu.serve.replica import (  # noqa: F401
+    LocalReplica,
+    HttpReplica,
+    ReplicaServer,
+    ReplicaUnreachable,
+    replica_health,
+)
+from novel_view_synthesis_3d_tpu.serve.router import (  # noqa: F401
+    FleetRouter,
+    FleetSaturated,
+    NoReplicaAvailable,
+)
+from novel_view_synthesis_3d_tpu.serve.deploy import (  # noqa: F401
+    rolling_deploy,
+)

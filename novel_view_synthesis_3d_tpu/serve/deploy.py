@@ -1,0 +1,210 @@
+"""Zero-downtime rolling deploys over the fleet (`nvs3d route deploy`).
+
+Composition, not new machinery: the deploy driver scripts four
+subsystems that already exist —
+
+  router.quiesce/await_idle/readmit   traffic control (PR 16)
+  registry channel + watcher.poke()   the swap itself (PR 5): the
+                                      channel pointer moves ONCE, then
+                                      each replica is poked one at a
+                                      time, so the watcher fleet rolls
+                                      instead of thundering
+  /healthz breaker field              swap health (PR 11 circuit
+                                      breaker, exported per satellite):
+                                      an open breaker means verify/
+                                      stage FAILED on that replica
+  /healthz slo_fast_burn              the promotion gate (PR 14 burn
+                                      rate): a canary serving garbage
+                                      burns error budget fast and is
+                                      caught during probation
+
+Per replica, in stable (sorted) order:
+
+  gate      breaker must be closed BEFORE we touch the replica — a
+            replica already failing swaps is not a deploy target
+  quiesce   out of rotation; router re-pins orbit sessions elsewhere
+  drain     await queue_depth==0 AND step_debt==0 (bounded by
+            router.deploy_drain_timeout_s) — the replica is idle, so
+            the swap cannot race in-flight work (the service would
+            tolerate it; the deploy is just stricter)
+  swap      poke the watcher, await healthz model_version == target
+            (deploy_swap_timeout_s); a breaker that opens here means
+            the artifact failed verify/stage on this replica
+  readmit   back into rotation
+  probation deploy_probation_s of live traffic: fail if the breaker
+            leaves closed, slo_breached flips true, or slo_fast_burn
+            crosses deploy_burn_max
+
+Any gate failure triggers AUTO-ROLLBACK: the channel pointer is rolled
+back (store.rollback), every replica that already swapped is quiesced,
+poked back to the prior version, and readmitted — the fleet converges
+on the pre-deploy version and the report says so. Throughout, N-1
+replicas keep serving: zero downtime is asserted (not assumed) by the
+serve_bench --fleet rolling-deploy lane, which keeps a closed-loop
+client running across the whole deploy and requires zero failures.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from novel_view_synthesis_3d_tpu.config import RouterConfig
+
+
+def _health(router, name: str) -> dict:
+    try:
+        return router._states[name].handle.healthz()
+    except Exception:
+        return {}
+
+
+def _await_version(router, name: str, version: str, timeout_s: float,
+                   sleep, clock, poll_s: float = 0.05) -> bool:
+    deadline = clock() + timeout_s
+    while clock() < deadline:
+        snap = _health(router, name)
+        if snap.get("model_version") == version:
+            return True
+        # A breaker that opens during the wait means the swap FAILED
+        # (verify/stage error) — waiting out the timeout is pointless.
+        if snap.get("breaker") == "open":
+            return False
+        sleep(poll_s)
+    return False
+
+
+def rolling_deploy(router, store, channel: str, target_version: str, *,
+                   rcfg: Optional[RouterConfig] = None, bus=None,
+                   clock=time.monotonic, sleep=time.sleep,
+                   replicas: Optional[List[str]] = None) -> dict:
+    """Roll `target_version` across the fleet one replica at a time.
+
+    Returns a report dict: {"status": "deployed" | "rolled_back" |
+    "refused", "target", "previous", "steps": [per-replica records],
+    "reason"}. Never raises for gate failures — the report is the
+    contract (`nvs3d route deploy` exits nonzero on != deployed)."""
+    rcfg = rcfg or getattr(router, "rcfg", None) or RouterConfig()
+
+    def event(kind: str, detail: str) -> None:
+        if bus is not None:
+            bus.event(0, kind, detail, model_version=target_version,
+                      echo="[deploy]")
+
+    names = sorted(replicas if replicas is not None
+                   else router._states.keys())
+    previous = store.read_channel(channel)
+    report = {"status": "deployed", "target": target_version,
+              "previous": previous, "channel": channel, "steps": [],
+              "reason": ""}
+
+    # Fleet pre-gate: refuse outright (no channel move, nothing to roll
+    # back) if any target replica is unreachable or breaker-open.
+    for name in names:
+        snap = _health(router, name)
+        if not snap:
+            report.update(status="refused",
+                          reason=f"replica {name} unreachable")
+            event("deploy_refused", report["reason"])
+            return report
+        if snap.get("breaker", "closed") != "closed":
+            report.update(
+                status="refused",
+                reason=f"replica {name} swap breaker is "
+                       f"{snap['breaker']} — heal or roll the channel "
+                       "before deploying")
+            event("deploy_refused", report["reason"])
+            return report
+
+    event("deploy_begin",
+          f"channel {channel}: {previous or '<unset>'} -> "
+          f"{target_version} across {len(names)} replica(s)")
+    store.set_channel(channel, target_version)
+    swapped: List[str] = []
+
+    def rollback(reason: str) -> dict:
+        event("deploy_rollback", f"rolling back: {reason}")
+        try:
+            restored = store.rollback(channel)
+        except Exception:
+            # History exhausted (fresh registry): restore directly.
+            restored = previous
+            if previous is not None:
+                store.set_channel(channel, previous)
+        for name in names:
+            try:
+                router.quiesce(name)
+                router._states[name].handle.poke()
+                if restored is not None:
+                    _await_version(router, name, restored,
+                                   rcfg.deploy_swap_timeout_s,
+                                   sleep, clock)
+            finally:
+                router.readmit(name)
+        report.update(status="rolled_back", reason=reason,
+                      restored=restored)
+        event("deploy_done",
+              f"rolled back to {restored or '<unset>'}: {reason}")
+        return report
+
+    for name in names:
+        step = {"replica": name, "outcome": "ok", "detail": ""}
+        report["steps"].append(step)
+        router.quiesce(name)
+        event("deploy_drain", f"replica {name}: quiesced, draining")
+        try:
+            if not router.await_idle(name, rcfg.deploy_drain_timeout_s):
+                step.update(outcome="drain_timeout",
+                            detail="never reached idle")
+                router.readmit(name)  # still on the old, good version
+                return rollback(f"replica {name} drain timed out")
+
+            router._states[name].handle.poke()
+            event("deploy_swap",
+                  f"replica {name}: poked watcher, awaiting "
+                  f"{target_version}")
+            if not _await_version(router, name, target_version,
+                                  rcfg.deploy_swap_timeout_s,
+                                  sleep, clock):
+                snap = _health(router, name)
+                step.update(
+                    outcome="swap_failed",
+                    detail=f"breaker={snap.get('breaker')} "
+                           f"version={snap.get('model_version')}")
+                router.readmit(name)
+                return rollback(
+                    f"replica {name} failed to swap to "
+                    f"{target_version} (breaker "
+                    f"{snap.get('breaker', '?')})")
+            swapped.append(name)
+        finally:
+            if step["outcome"] == "ok":
+                router.readmit(name)
+
+        # Probation: the canary takes live traffic; any SLO burn or
+        # breaker excursion aborts the roll and reverts the fleet.
+        event("deploy_gate",
+              f"replica {name}: probation {rcfg.deploy_probation_s}s "
+              f"(burn gate < {rcfg.deploy_burn_max})")
+        deadline = clock() + rcfg.deploy_probation_s
+        while clock() < deadline:
+            snap = _health(router, name)
+            burn = float(snap.get("slo_fast_burn") or 0.0)
+            breaker = snap.get("breaker", "closed")
+            if (not snap or breaker != "closed"
+                    or snap.get("slo_breached")
+                    or burn >= rcfg.deploy_burn_max):
+                step.update(
+                    outcome="gate_failed",
+                    detail=f"burn={burn} breaker={breaker} "
+                           f"breached={snap.get('slo_breached')}")
+                return rollback(
+                    f"replica {name} failed probation "
+                    f"(fast_burn={burn}, breaker={breaker})")
+            sleep(min(0.05, rcfg.deploy_probation_s / 4))
+        step["detail"] = f"serving {target_version}"
+
+    event("deploy_done",
+          f"channel {channel} now {target_version} on "
+          f"{len(swapped)}/{len(names)} replica(s)")
+    return report

@@ -1,0 +1,481 @@
+"""The replica boundary: one uniform handle protocol over a
+SamplingService, in-process or across HTTP.
+
+The router (serve/router.py) never touches a service directly — it
+talks to a *replica handle*:
+
+    name                        stable fleet identity
+    healthz() -> dict           service.health_snapshot() + watcher
+                                breaker state (may raise
+                                ReplicaUnreachable)
+    submit(cond, **kw)          -> ticket with .result(timeout)
+    submit_trajectory(cond, poses, **kw) -> ticket with .result(timeout)
+    begin_drain() / drain(t)    PR 11 drain state machine
+    poke()                      registry watcher: poll NOW
+    metrics_text() -> str       Prometheus exposition for aggregation
+    close()
+
+`LocalReplica` wraps an in-process service (tier-1 tests; no ports).
+`ReplicaServer` + `HttpReplica` carry the SAME protocol across a
+process boundary for the real fleet (`nvs3d route`, serve_bench
+--fleet): the structured error contract (Rejected/SampleAnomaly/
+TrajectoryExpired with retryable/retry_after_s/partial frames) is
+marshalled losslessly, so the router's failover logic is transport-
+blind. A transport-level failure (connection refused, socket timeout,
+torn response — the replica DIED, it didn't answer) surfaces as
+`ReplicaUnreachable`, which is retryable by construction: the request
+never entered a queue, so resubmitting elsewhere cannot double-serve.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from novel_view_synthesis_3d_tpu import obs
+from novel_view_synthesis_3d_tpu.sample.service import (
+    DeadlineExceeded,
+    Rejected,
+    SampleAnomaly,
+    ServeError,
+    TrajectoryExpired,
+)
+
+
+class ReplicaUnreachable(ServeError):
+    """Transport-level replica failure: died, unreachable, or answered
+    with a torn/non-protocol response. Retryable against a peer — the
+    request provably never committed to the dead replica's queue."""
+
+    retryable = True
+    retry_after_s = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Wire marshalling (arrays + the structured error contract)
+# ---------------------------------------------------------------------------
+def encode_array(arr: np.ndarray) -> str:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def decode_array(text: str) -> np.ndarray:
+    return np.load(io.BytesIO(base64.b64decode(text)),
+                   allow_pickle=False)
+
+
+def error_to_wire(exc: BaseException) -> dict:
+    """Structured serving error → JSON-able dict. Partial trajectory
+    frames (SampleAnomaly / TrajectoryExpired) ride along stacked, so
+    the router can stitch a failover continuation."""
+    wire = {
+        "type": type(exc).__name__,
+        "message": str(exc),
+        "retryable": bool(getattr(exc, "retryable", False)),
+        "retry_after_s": float(getattr(exc, "retry_after_s", 0.0) or 0.0),
+    }
+    frames = getattr(exc, "frames", None)
+    if frames:
+        wire["frames"] = encode_array(np.stack(frames))
+    if hasattr(exc, "frame_index"):
+        wire["frame_index"] = int(exc.frame_index)
+    return wire
+
+
+def wire_to_error(wire: dict) -> ServeError:
+    """Inverse of error_to_wire: re-raise the SAME exception class the
+    in-process service would have raised, so router failover logic and
+    sample/client.submit_with_retry see one contract either way."""
+    msg = str(wire.get("message", ""))
+    frames = wire.get("frames")
+    frame_list = [f for f in decode_array(frames)] if frames else []
+    etype = wire.get("type")
+    if etype == "SampleAnomaly":
+        return SampleAnomaly(
+            msg, frames=frame_list,
+            frame_index=int(wire.get("frame_index", 0)),
+            retry_after_s=float(wire.get("retry_after_s", 0.0)))
+    if etype == "TrajectoryExpired":
+        return TrajectoryExpired(
+            msg, frames=frame_list,
+            frame_index=int(wire.get("frame_index", 0)))
+    if etype == "DeadlineExceeded":
+        return DeadlineExceeded(msg)
+    if etype == "Rejected":
+        return Rejected(
+            msg, retryable=bool(wire.get("retryable", False)),
+            retry_after_s=float(wire.get("retry_after_s", 0.0)))
+    err = ServeError(msg or f"replica error ({etype})")
+    err.retryable = bool(wire.get("retryable", False))
+    err.retry_after_s = float(wire.get("retry_after_s", 0.0))
+    return err
+
+
+def replica_health(service, watcher=None) -> dict:
+    """The fleet /healthz body: the service's own snapshot (step_debt,
+    brownout_level, serve_state, ...) plus the registry watcher's
+    circuit-breaker state — the two inputs the router's dispatch policy
+    and the rolling-deploy gate read."""
+    snap = service.health_snapshot()
+    if watcher is not None:
+        snap["breaker"] = watcher.breaker_state
+        snap["swaps"] = int(watcher.swaps)
+        snap["swap_failures"] = int(watcher.failures)
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# In-process replica (tier-1 tests, single-host fleets)
+# ---------------------------------------------------------------------------
+class LocalReplica:
+    """Handle over an in-process SamplingService (+ optional watcher).
+
+    `run_dir` names the replica's telemetry folder so fleet trace
+    reconstruction (obs/reqtrace.load_fleet_rows) can find its rows."""
+
+    def __init__(self, name: str, service, *, watcher=None,
+                 run_dir: str = ""):
+        self.name = str(name)
+        self.service = service
+        self.watcher = watcher
+        self.run_dir = run_dir or service.serve.results_folder
+
+    def healthz(self) -> dict:
+        if self.service is None:
+            raise ReplicaUnreachable(f"replica {self.name} closed")
+        return replica_health(self.service, self.watcher)
+
+    def submit(self, cond, **kw):
+        if self.service is None:
+            raise ReplicaUnreachable(f"replica {self.name} closed")
+        return self.service.submit(cond, **kw)
+
+    def submit_trajectory(self, cond, poses, **kw):
+        if self.service is None:
+            raise ReplicaUnreachable(f"replica {self.name} closed")
+        return self.service.submit_trajectory(cond, poses=poses, **kw)
+
+    def begin_drain(self) -> None:
+        if self.service is not None:
+            self.service.begin_drain()
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        if self.service is not None:
+            self.service.drain(timeout_s)
+
+    def poke(self) -> None:
+        if self.watcher is not None:
+            self.watcher.poke()
+
+    def metrics_text(self) -> str:
+        return obs.get_registry().render_prometheus()
+
+    def close(self) -> None:
+        svc, self.service = self.service, None
+        if self.watcher is not None:
+            self.watcher.stop()
+        if svc is not None:
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport (subprocess fleets)
+# ---------------------------------------------------------------------------
+class _ReplicaHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "nvs3d-replica"
+
+    def log_message(self, fmt, *args):  # stdlib default logs to stderr
+        pass
+
+    # -- helpers -------------------------------------------------------
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, exc: BaseException) -> None:
+        wire = error_to_wire(exc)
+        code = 503 if wire["retryable"] else (
+            504 if isinstance(exc, DeadlineExceeded) else 400)
+        self._json(code, {"error": wire})
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        return json.loads(raw.decode()) if raw else {}
+
+    # -- routes --------------------------------------------------------
+    def do_GET(self):
+        core = self.server.core
+        if self.path.startswith("/healthz"):
+            try:
+                self._json(200, core.healthz())
+            except Exception as e:
+                self._json(500, {"error": {"type": "ServeError",
+                                           "message": repr(e)}})
+        elif self.path.startswith("/metrics"):
+            body = core.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json(404, {"error": {"type": "ServeError",
+                                       "message": "unknown path"}})
+
+    def do_POST(self):
+        core = self.server.core
+        try:
+            req = self._body()
+        except ValueError:
+            self._json(400, {"error": {"type": "Rejected",
+                                       "message": "bad request json",
+                                       "retryable": False}})
+            return
+        try:
+            if self.path.startswith("/submit_trajectory"):
+                self._handle_traj(core, req)
+            elif self.path.startswith("/submit"):
+                self._handle_submit(core, req)
+            elif self.path.startswith("/drain"):
+                core.begin_drain()
+                if req.get("full"):
+                    core.drain(req.get("timeout_s"))
+                self._json(200, core.healthz())
+            elif self.path.startswith("/poke"):
+                core.poke()
+                self._json(200, {"ok": True})
+            else:
+                self._json(404, {"error": {"type": "ServeError",
+                                           "message": "unknown path"}})
+        except ServeError as e:
+            self._error(e)
+        except Exception as e:  # pragma: no cover - defensive
+            self._json(500, {"error": {"type": "ServeError",
+                                       "message": repr(e)}})
+
+    def _kwargs(self, req: dict) -> dict:
+        kw = {}
+        for key in ("seed", "sample_steps", "guidance_weight",
+                    "deadline_ms", "k_max", "trace_id"):
+            if req.get(key) is not None:
+                kw[key] = req[key]
+        if "seed" in kw:
+            kw["seed"] = int(kw["seed"])
+        return kw
+
+    def _handle_submit(self, core, req: dict) -> None:
+        cond = {k: decode_array(v) for k, v in req["cond"].items()}
+        kw = self._kwargs(req)
+        kw.pop("k_max", None)
+        ticket = core.submit(cond, **kw)
+        img = ticket.result(timeout=float(req.get("timeout_s") or 600.0))
+        self._json(200, {
+            "image": encode_array(img),
+            "request_id": int(ticket.request_id),
+            "model_version": ticket.model_version,
+        })
+
+    def _handle_traj(self, core, req: dict) -> None:
+        cond = {k: decode_array(v) for k, v in req["cond"].items()}
+        poses = {"R2": decode_array(req["poses"]["R2"]),
+                 "t2": decode_array(req["poses"]["t2"])}
+        ticket = core.submit_trajectory(cond, poses, **self._kwargs(req))
+        frames = ticket.result(
+            timeout=float(req.get("timeout_s") or 600.0))
+        self._json(200, {
+            "frames": encode_array(frames),
+            "request_id": int(ticket.request_id),
+            "model_version": ticket.model_version,
+        })
+
+
+class ReplicaServer:
+    """HTTP face of one replica: /submit, /submit_trajectory, /drain,
+    /poke, /healthz, /metrics over a stdlib ThreadingHTTPServer bound
+    to loopback (same trust model as obs.MetricsServer — a fleet
+    fabric, not an internet-facing endpoint)."""
+
+    def __init__(self, core, *, host: str = "127.0.0.1", port: int = 0):
+        self.core = core  # a LocalReplica (or anything handle-shaped)
+        self._httpd = ThreadingHTTPServer((host, port), _ReplicaHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.core = core
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"replica-http-{core.name}")
+        self._thread.start()
+
+    def url(self, path: str = "") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10.0)
+
+
+class _HttpTicket:
+    """Client-side ticket over one in-flight HTTP request. The POST runs
+    on its own thread from construction (submission is not deferred to
+    result()), mirroring the in-process ticket's semantics."""
+
+    def __init__(self, call):
+        self.request_id = -1
+        self.model_version = ""
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+        def run():
+            try:
+                self._result = call(self)
+            except BaseException as e:
+                self._error = e
+            self._done.set()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("replica call still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class HttpReplica:
+    """Handle over a replica process at `base_url` (ReplicaServer /
+    serve/replica_main.py). `run_dir` (optional) names the replica's
+    telemetry folder on shared storage for fleet trace reconstruction.
+    """
+
+    def __init__(self, name: str, base_url: str, *, run_dir: str = "",
+                 health_timeout_s: float = 3.0,
+                 submit_timeout_s: float = 600.0):
+        self.name = str(name)
+        self.base_url = base_url.rstrip("/")
+        self.run_dir = run_dir
+        self.health_timeout_s = float(health_timeout_s)
+        self.submit_timeout_s = float(submit_timeout_s)
+
+    # -- plumbing ------------------------------------------------------
+    def _call(self, path: str, payload: Optional[dict],
+              timeout_s: float) -> dict:
+        url = self.base_url + path
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+            method="POST" if data is not None else "GET")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                wire = json.loads(e.read().decode()).get("error") or {}
+            except ValueError:
+                raise ReplicaUnreachable(
+                    f"replica {self.name}: torn response "
+                    f"(HTTP {e.code})") from e
+            raise wire_to_error(wire) from None
+        except (urllib.error.URLError, ConnectionError, socket.timeout,
+                TimeoutError, OSError) as e:
+            raise ReplicaUnreachable(
+                f"replica {self.name} unreachable at {url}: {e}") from e
+
+    # -- handle protocol ----------------------------------------------
+    def healthz(self) -> dict:
+        return self._call("/healthz", None, self.health_timeout_s)
+
+    def submit(self, cond, *, seed: int = 0, sample_steps=None,
+               guidance_weight=None, deadline_ms=None, trace_id=None,
+               timeout_s: Optional[float] = None):
+        payload = {
+            "cond": {k: encode_array(v) for k, v in cond.items()},
+            "seed": int(seed), "sample_steps": sample_steps,
+            "guidance_weight": guidance_weight,
+            "deadline_ms": deadline_ms, "trace_id": trace_id,
+            "timeout_s": timeout_s or self.submit_timeout_s,
+        }
+
+        def call(ticket):
+            resp = self._call("/submit", payload,
+                              (timeout_s or self.submit_timeout_s) + 30.0)
+            ticket.request_id = int(resp.get("request_id", -1))
+            ticket.model_version = resp.get("model_version", "")
+            return decode_array(resp["image"])
+
+        return _HttpTicket(call)
+
+    def submit_trajectory(self, cond, poses, *, seed: int = 0,
+                          sample_steps=None, guidance_weight=None,
+                          deadline_ms=None, k_max=None, trace_id=None,
+                          timeout_s: Optional[float] = None):
+        if not isinstance(poses, dict):
+            arr = np.asarray(poses, np.float32)
+            poses = {"R2": arr[:, :3, :3], "t2": arr[:, :3, 3]}
+        payload = {
+            "cond": {k: encode_array(v) for k, v in cond.items()},
+            "poses": {"R2": encode_array(poses["R2"]),
+                      "t2": encode_array(poses["t2"])},
+            "seed": int(seed), "sample_steps": sample_steps,
+            "guidance_weight": guidance_weight,
+            "deadline_ms": deadline_ms, "k_max": k_max,
+            "trace_id": trace_id,
+            "timeout_s": timeout_s or self.submit_timeout_s,
+        }
+
+        def call(ticket):
+            resp = self._call("/submit_trajectory", payload,
+                              (timeout_s or self.submit_timeout_s) + 30.0)
+            ticket.request_id = int(resp.get("request_id", -1))
+            ticket.model_version = resp.get("model_version", "")
+            return decode_array(resp["frames"])
+
+        return _HttpTicket(call)
+
+    def begin_drain(self) -> None:
+        self._call("/drain", {"full": False}, self.health_timeout_s)
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        self._call("/drain", {"full": True, "timeout_s": timeout_s},
+                   (timeout_s or 60.0) + 30.0)
+
+    def poke(self) -> None:
+        self._call("/poke", {}, self.health_timeout_s)
+
+    def metrics_text(self) -> str:
+        url = self.base_url + "/metrics"
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=self.health_timeout_s) as resp:
+                return resp.read().decode()
+        except (urllib.error.URLError, ConnectionError, socket.timeout,
+                TimeoutError, OSError) as e:
+            raise ReplicaUnreachable(
+                f"replica {self.name} unreachable at {url}: {e}") from e
+
+    def close(self) -> None:
+        pass  # the process has its own lifecycle (SIGTERM → drain)

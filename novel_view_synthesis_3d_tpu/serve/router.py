@@ -1,0 +1,624 @@
+"""FleetRouter: least-step-debt dispatch, session affinity, failover.
+
+The router is deliberately thin (the Pathways single-controller
+argument, PAPERS.md): replicas own all model state; the router owns
+three small tables —
+
+  - a health cache: each replica's /healthz snapshot (step_debt,
+    brownout_level, serve_state, breaker) polled every
+    router.health_poll_s and aged out after router.health_ttl_s;
+  - an outstanding-work ledger: denoise steps this router has in
+    flight per replica, so dispatch pressure between polls is
+    poll-fresh + local-accurate (two requests arriving between polls
+    don't both see the same stale debt);
+  - the affinity table: orbit session → replica. A trajectory's frame
+    bank is device-resident on ONE replica, so every segment of a
+    session must land there; the pin moves only when the pinned
+    replica leaves the eligible set (drain, death, deploy quiesce),
+    and the continuation is re-conditioned on the last delivered
+    frame so the orbit stays seamless.
+
+Failover is driven by PR 11's structured error contract: a replica
+that died (ReplicaUnreachable), drained, or shed retryably triggers a
+transparent re-route, bounded by router.retry_budget per request. When
+EVERY eligible replica sheds in a full sweep, the fleet is saturated —
+the router raises FleetSaturated (retryable, carrying the fleet's own
+max retry_after_s) instead of burning the budget retry-storming, so
+backpressure propagates to callers loudly and with server-paced
+backoff (sample/client.submit_with_retry honors it).
+
+Observability: the router threads one trace_id through every replica
+hop (the replica's request_submit/request_respond rows carry it), and
+writes its own rows through the obs bus/tracer — `router_submit` root,
+one `router_hop` span per attempt (replica, attempt ordinal, outcome),
+and a retrospective `router_respond` — so `nvs3d obs trace` can
+reconstruct a cross-replica timeline from the fleet's merged
+telemetry (obs/reqtrace.load_fleet_rows).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from novel_view_synthesis_3d_tpu import obs
+from novel_view_synthesis_3d_tpu.config import RouterConfig
+from novel_view_synthesis_3d_tpu.obs import reqtrace
+from novel_view_synthesis_3d_tpu.sample.client import retry_delay_s
+from novel_view_synthesis_3d_tpu.sample.service import (
+    Rejected,
+    ServeError,
+    _normalize_poses,
+)
+from novel_view_synthesis_3d_tpu.serve.replica import ReplicaUnreachable
+
+# Replica-side serve_state values the router will dispatch onto.
+_DISPATCHABLE = ("ok",)
+
+
+class NoReplicaAvailable(Rejected):
+    """Every replica is dead, draining, or out of rotation. Retryable:
+    a deploy readmits, a supervisor restarts — capacity returns."""
+
+    def __init__(self, message: str, *, retry_after_s: float = 1.0):
+        super().__init__(message, retryable=True,
+                         retry_after_s=retry_after_s)
+
+
+class FleetSaturated(Rejected):
+    """Fleet-wide brownout: every eligible replica shed retryably in a
+    full sweep. Carries the fleet's max retry_after_s so a herd of
+    callers backs off on the servers' own estimate instead of
+    retry-storming N replicas × retry_budget times each."""
+
+    def __init__(self, message: str, *, retry_after_s: float):
+        super().__init__(message, retryable=True,
+                         retry_after_s=retry_after_s)
+
+
+class _ReplicaState:
+    __slots__ = ("handle", "health", "health_t", "outstanding",
+                 "in_rotation", "reachable", "dispatches", "failures")
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.health: Optional[dict] = None
+        self.health_t = float("-inf")
+        self.outstanding = 0  # denoise steps in flight via THIS router
+        self.in_rotation = True
+        self.reachable = True
+        self.dispatches = 0
+        self.failures = 0
+
+
+class FleetRouter:
+    def __init__(self, replicas, *, rcfg: Optional[RouterConfig] = None,
+                 tracer=None, bus=None, clock=time.monotonic,
+                 sleep=time.sleep, start: bool = False,
+                 metrics_server=None):
+        """`replicas`: iterable of handles (serve/replica.py protocol).
+        `tracer`/`bus` come from the router's own obs.RunTelemetry (or
+        stay None for bare tests — every write is guarded). `start=True`
+        launches the background health poller; tests poll manually.
+        `metrics_server`: an obs.MetricsServer to hang the fleet
+        aggregation on — the router's own /metrics then re-serves every
+        replica's families relabeled with replica="<name>" (cleared on
+        close)."""
+        self.rcfg = rcfg or RouterConfig()
+        self._states: "OrderedDict[str, _ReplicaState]" = OrderedDict()
+        for h in replicas:
+            if h.name in self._states:
+                raise ValueError(f"duplicate replica name {h.name!r}")
+            self._states[h.name] = _ReplicaState(h)
+        self.tracer = tracer
+        self.bus = bus
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._affinity: "OrderedDict[str, str]" = OrderedDict()
+        self._next_rid = 0
+        self._rr = 0  # tie-break rotation for equal-debt picks
+        reg = obs.get_registry()
+        self._m_requests = reg.counter(
+            "nvs3d_router_requests_total",
+            "requests routed, by final outcome")
+        self._m_failovers = reg.counter(
+            "nvs3d_router_failovers_total",
+            "transparent re-routes, by reason")
+        self._m_dispatch = reg.counter(
+            "nvs3d_router_dispatch_total",
+            "hops dispatched, by replica")
+        self._m_healthy = reg.gauge(
+            "nvs3d_router_replicas_healthy",
+            "replicas reachable + dispatchable at last poll")
+        self._m_debt = reg.gauge(
+            "nvs3d_router_fleet_step_debt",
+            "fleet step debt: polled replica debt + router outstanding")
+        self._stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+        self._metrics_server = metrics_server
+        if metrics_server is not None:
+            metrics_server.set_metrics_extra(self.fleet_metrics_text)
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._poller is not None:
+            return
+        self._poller = threading.Thread(
+            target=self._poll_loop, daemon=True, name="router-health")
+        self._poller.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._metrics_server is not None:
+            self._metrics_server.set_metrics_extra(None)
+            self._metrics_server = None
+        if self._poller is not None:
+            self._poller.join(timeout=10.0)
+            self._poller = None
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            self.poll_health()
+            self._stop.wait(self.rcfg.health_poll_s)
+
+    # -- health --------------------------------------------------------
+    def poll_health(self) -> Dict[str, Optional[dict]]:
+        """Poll every replica's /healthz once; updates the cache, the
+        fleet gauges, and emits replica_down/replica_up transitions."""
+        now = self._clock()
+        healthy = 0
+        debt_total = 0
+        for name, st in self._states.items():
+            try:
+                snap = st.handle.healthz()
+                was_unreachable = not st.reachable
+                st.health, st.health_t, st.reachable = snap, now, True
+                if was_unreachable:
+                    self._event("replica_up",
+                                f"replica {name} reachable again")
+            except Exception as e:
+                if st.reachable:
+                    self._event("replica_down",
+                                f"replica {name} healthz failed: {e!r}")
+                st.reachable = False
+                st.health = None
+                continue
+            if self._dispatchable(st):
+                healthy += 1
+            debt_total += int(snap.get("step_debt", 0)) + st.outstanding
+        self._m_healthy.set(float(healthy))
+        self._m_debt.set(float(debt_total))
+        return {name: st.health for name, st in self._states.items()}
+
+    def _fresh(self, st: _ReplicaState) -> bool:
+        return (st.health is not None
+                and self._clock() - st.health_t <= self.rcfg.health_ttl_s)
+
+    def _dispatchable(self, st: _ReplicaState) -> bool:
+        if not (st.in_rotation and st.reachable):
+            return False
+        if not self._fresh(st):
+            # Unknown health: stale snapshot. Dispatchable (the poller
+            # may simply be off in a test), but _eligible ranks fresh
+            # replicas first.
+            return st.health is None or (
+                st.health.get("serve_state",
+                              st.health.get("status")) in _DISPATCHABLE)
+        state = st.health.get("serve_state", st.health.get("status"))
+        if state not in _DISPATCHABLE:
+            return False
+        return int(st.health.get("brownout_level", 0)) < 2
+
+    def _debt(self, st: _ReplicaState) -> int:
+        polled = int((st.health or {}).get("step_debt", 0))
+        return polled + st.outstanding
+
+    def _eligible(self, exclude=()) -> List[str]:
+        return [name for name, st in self._states.items()
+                if name not in exclude and self._dispatchable(st)]
+
+    # -- dispatch policy ----------------------------------------------
+    def pick(self, *, session: Optional[str] = None,
+             exclude=()) -> str:
+        """Least-step-debt replica; an orbit session's pin wins while
+        the pinned replica stays eligible (the frame bank lives there).
+        Raises NoReplicaAvailable when the eligible set is empty."""
+        with self._lock:
+            if session is not None:
+                pinned = self._affinity.get(session)
+                if pinned is not None and pinned not in exclude \
+                        and self._dispatchable(self._states[pinned]):
+                    self._affinity.move_to_end(session)
+                    return pinned
+            names = self._eligible(exclude)
+            if not names:
+                raise NoReplicaAvailable(
+                    "no dispatchable replica (all dead, draining, "
+                    "quiesced, or shedding)")
+            self._rr += 1
+            best = min(
+                names,
+                key=lambda n: (self._debt(self._states[n]),
+                               (self._rr + hash(n)) % len(names)))
+            if session is not None:
+                self._pin(session, best)
+            return best
+
+    def _pin(self, session: str, name: str) -> None:
+        # caller holds self._lock
+        moved = self._affinity.get(session)
+        self._affinity[session] = name
+        self._affinity.move_to_end(session)
+        while len(self._affinity) > self.rcfg.affinity_entries:
+            self._affinity.popitem(last=False)
+        if moved is not None and moved != name:
+            self._event("router_affinity_move",
+                        f"session {session}: {moved} -> {name}")
+
+    # -- rotation control (deploys) -----------------------------------
+    def quiesce(self, name: str) -> None:
+        """Take a replica out of rotation (router-level drain begin):
+        no new dispatches; orbit sessions re-pin on their next segment;
+        in-flight work finishes on the replica."""
+        self._states[name].in_rotation = False
+        self._event("router_quiesce", f"replica {name} out of rotation")
+
+    def readmit(self, name: str) -> None:
+        self._states[name].in_rotation = True
+        self._event("router_readmit", f"replica {name} back in rotation")
+
+    def await_idle(self, name: str, timeout_s: float,
+                   poll_s: float = 0.05) -> bool:
+        """Router-level drain wait: poll the replica's healthz until
+        queue_depth == 0 and step_debt == 0 (everything it owed is
+        served). True on idle, False on timeout/unreachable."""
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
+            try:
+                snap = self._states[name].handle.healthz()
+            except Exception:
+                return False
+            if (int(snap.get("queue_depth", 1)) == 0
+                    and int(snap.get("step_debt", 1)) == 0):
+                return True
+            self._sleep(poll_s)
+        return False
+
+    def retire(self, name: str, timeout_s: Optional[float] = None) -> None:
+        """Permanently remove a replica: quiesce, then run the PR 11
+        drain state machine to completion (admissions reject retryably,
+        queued + in-ring work finishes, worker exits)."""
+        self.quiesce(name)
+        st = self._states[name]
+        try:
+            st.handle.begin_drain()
+            st.handle.drain(timeout_s)
+        finally:
+            st.reachable = False
+
+    # -- request path --------------------------------------------------
+    def request(self, cond, *, seed: int = 0, sample_steps=None,
+                guidance_weight=None, deadline_ms=None,
+                trace_id: Optional[str] = None, timeout_s: float = 600.0
+                ) -> np.ndarray:
+        """Route one single-shot request; blocks for the image.
+        Transparent failover within router.retry_budget; fleet-wide
+        shed raises FleetSaturated."""
+        with self._lock:
+            self._next_rid += 1
+            rid = self._next_rid
+        tid = reqtrace.mint(rid, trace_id)
+        self._span("router_submit", 0.0, trace_id=tid,
+                   span_id=reqtrace.root_span_id(tid), req_kind="single",
+                   steps=int(sample_steps or 0))
+        t0 = time.monotonic()
+        steps_weight = int(sample_steps or 1)
+        attempt = 0
+        failovers = 0
+        shed: Dict[str, float] = {}
+        tried_dead: set = set()
+        while True:
+            try:
+                # A replica that shed THIS request is excluded from its
+                # retries: single-shots are stateless, so the budget is
+                # spent exploring remaining capacity instead of
+                # hammering the queue that just refused. (Trajectories
+                # retry in place — the frame bank is worth waiting for.)
+                name = self.pick(exclude=tried_dead | set(shed))
+            except NoReplicaAvailable:
+                if shed:
+                    self._finish(tid, t0, "saturated", attempt, failovers)
+                    raise FleetSaturated(
+                        "fleet saturated: every eligible replica shed "
+                        f"({sorted(shed)})",
+                        retry_after_s=max(shed.values()) or 0.25
+                    ) from None
+                self._finish(tid, t0, "no_replica", attempt, failovers)
+                raise
+            st = self._states[name]
+            attempt += 1
+            t_hop = time.monotonic()
+            st.outstanding += steps_weight
+            try:
+                ticket = st.handle.submit(
+                    cond, seed=seed, sample_steps=sample_steps,
+                    guidance_weight=guidance_weight,
+                    deadline_ms=deadline_ms, trace_id=tid)
+                img = ticket.result(timeout=timeout_s)
+            except Exception as e:
+                st.outstanding -= steps_weight
+                retryable = bool(getattr(e, "retryable", False))
+                self._hop(tid, name, attempt, t_hop,
+                          "failover" if retryable else "failed", e)
+                if isinstance(e, ReplicaUnreachable):
+                    st.reachable = False
+                    tried_dead.add(name)
+                    self._event("replica_down",
+                                f"replica {name} died mid-request: {e}")
+                elif retryable:
+                    shed[name] = max(
+                        shed.get(name, 0.0),
+                        float(getattr(e, "retry_after_s", 0.0) or 0.0))
+                    if set(self._eligible()) <= set(shed):
+                        # Full sweep shed: saturated, stop storming.
+                        self._m_requests.inc(outcome="saturated")
+                        self._finish(tid, t0, "saturated", attempt,
+                                     failovers)
+                        raise FleetSaturated(
+                            "fleet saturated: every eligible replica "
+                            f"shed ({sorted(shed)})",
+                            retry_after_s=max(shed.values()) or 0.25
+                        ) from e
+                if not retryable or failovers >= self.rcfg.retry_budget:
+                    self._m_requests.inc(outcome="failed")
+                    self._finish(tid, t0, "failed", attempt, failovers)
+                    raise
+                failovers += 1
+                self._m_failovers.inc(
+                    reason="dead" if isinstance(e, ReplicaUnreachable)
+                    else "shed")
+                self._sleep(min(0.25, retry_delay_s(e, failovers - 1)))
+                continue
+            st.outstanding -= steps_weight
+            st.dispatches += 1
+            self._m_dispatch.inc(replica=name)
+            self._hop(tid, name, attempt, t_hop, "ok", None)
+            self._m_requests.inc(outcome="ok")
+            self._finish(tid, t0, "ok", attempt, failovers)
+            return img
+
+    def request_trajectory(self, cond, poses, *, seed: int = 0,
+                           sample_steps=None, guidance_weight=None,
+                           deadline_ms=None, k_max=None,
+                           session: Optional[str] = None,
+                           trace_id: Optional[str] = None,
+                           timeout_s: float = 600.0) -> np.ndarray:
+        """Route one orbit; blocks for the stacked (N, H, W, 3) frames.
+
+        The session (default: the trace id) pins the orbit to one
+        replica — its frame bank lives there. A mid-orbit failure with
+        partial frames (SampleAnomaly, replica death after streaming)
+        fails over: the router re-pins, re-conditions on the LAST
+        DELIVERED frame + its pose, and submits only the remaining
+        poses, so the caller still receives a complete orbit."""
+        poses_R, poses_t = _normalize_poses(poses)
+        n_frames = int(poses_R.shape[0])
+        with self._lock:
+            self._next_rid += 1
+            rid = self._next_rid
+        tid = reqtrace.mint(rid, trace_id)
+        session = session or tid
+        self._span("router_submit", 0.0, trace_id=tid,
+                   span_id=reqtrace.root_span_id(tid),
+                   req_kind="trajectory", steps=int(sample_steps or 0),
+                   frames=n_frames, session=session)
+        t0 = time.monotonic()
+        done: List[np.ndarray] = []
+        attempt = 0
+        failovers = 0
+        shed: Dict[str, float] = {}
+        tried_dead: set = set()
+        base_cond = {k: np.asarray(v) for k, v in cond.items()}
+        while len(done) < n_frames:
+            try:
+                name = self.pick(session=session, exclude=tried_dead)
+            except NoReplicaAvailable:
+                self._finish(tid, t0, "no_replica", attempt, failovers,
+                             frames_done=len(done))
+                if shed:
+                    raise FleetSaturated(
+                        "fleet saturated mid-orbit "
+                        f"({len(done)}/{n_frames} frames)",
+                        retry_after_s=max(shed.values()) or 0.25
+                    ) from None
+                raise
+            st = self._states[name]
+            attempt += 1
+            start = len(done)
+            if start == 0:
+                hop_cond = base_cond
+            else:
+                # Continuation: condition on the last delivered frame
+                # at its own pose — the bank on the NEW replica is
+                # seeded exactly where the old one left off.
+                hop_cond = {
+                    "x": np.asarray(done[-1]),
+                    "R1": poses_R[start - 1],
+                    "t1": poses_t[start - 1],
+                    "K": base_cond["K"],
+                }
+            hop_poses = {"R2": poses_R[start:], "t2": poses_t[start:]}
+            weight = int(sample_steps or 1) * (n_frames - start)
+            t_hop = time.monotonic()
+            st.outstanding += weight
+            try:
+                ticket = st.handle.submit_trajectory(
+                    hop_cond, hop_poses, seed=seed + attempt,
+                    sample_steps=sample_steps,
+                    guidance_weight=guidance_weight,
+                    deadline_ms=deadline_ms, k_max=k_max, trace_id=tid)
+                frames = ticket.result(timeout=timeout_s)
+            except Exception as e:
+                st.outstanding -= weight
+                partial = getattr(e, "frames", None) or []
+                done.extend(np.asarray(f) for f in partial)
+                retryable = bool(getattr(e, "retryable", False))
+                self._hop(tid, name, attempt, t_hop,
+                          "failover" if retryable else "failed", e,
+                          frames_done=len(done))
+                if isinstance(e, ReplicaUnreachable):
+                    st.reachable = False
+                    tried_dead.add(name)
+                    self._event("replica_down",
+                                f"replica {name} died mid-orbit "
+                                f"(session {session}, "
+                                f"{len(done)}/{n_frames} frames): {e}")
+                elif retryable:
+                    shed[name] = max(
+                        shed.get(name, 0.0),
+                        float(getattr(e, "retry_after_s", 0.0) or 0.0))
+                if not retryable or failovers >= self.rcfg.retry_budget:
+                    self._m_requests.inc(outcome="failed")
+                    self._finish(tid, t0, "failed", attempt, failovers,
+                                 frames_done=len(done))
+                    raise
+                failovers += 1
+                self._m_failovers.inc(
+                    reason="dead" if isinstance(e, ReplicaUnreachable)
+                    else "shed")
+                with self._lock:
+                    if self._affinity.get(session) == name:
+                        del self._affinity[session]
+                self._sleep(min(0.25, retry_delay_s(e, failovers - 1)))
+                continue
+            st.outstanding -= weight
+            st.dispatches += 1
+            self._m_dispatch.inc(replica=name)
+            done.extend(np.asarray(f) for f in frames)
+            self._hop(tid, name, attempt, t_hop, "ok", None,
+                      frames_done=len(done))
+        self._m_requests.inc(outcome="ok")
+        self._finish(tid, t0, "ok", attempt, failovers,
+                     frames_done=len(done))
+        return np.stack(done)
+
+    # -- fleet views ---------------------------------------------------
+    def fleet_snapshot(self) -> dict:
+        """Aggregated health for `nvs3d route status` and the bench
+        artifacts: per-replica health + the fleet rollup."""
+        replicas = {}
+        healthy = 0
+        debt = 0
+        for name, st in self._states.items():
+            replicas[name] = {
+                "reachable": st.reachable,
+                "in_rotation": st.in_rotation,
+                "outstanding": st.outstanding,
+                "dispatches": st.dispatches,
+                "health": st.health,
+            }
+            if self._dispatchable(st):
+                healthy += 1
+            debt += self._debt(st)
+        return {
+            "replicas": replicas,
+            "healthy": healthy,
+            "total": len(self._states),
+            "fleet_step_debt": debt,
+        }
+
+    def fleet_metrics_text(self) -> str:
+        """Merged Prometheus exposition: every reachable replica's
+        /metrics with a replica="<name>" label stamped onto each
+        sample, HELP/TYPE headers deduped — one scrape surface for the
+        whole fleet (obs.MetricsServer extra-text hook serves it)."""
+        out: List[str] = []
+        seen_meta = set()
+        for name, st in self._states.items():
+            try:
+                text = st.handle.metrics_text()
+            except Exception:
+                continue
+            for line in text.splitlines():
+                if line.startswith("#"):
+                    if line not in seen_meta:
+                        seen_meta.add(line)
+                        out.append(line)
+                    continue
+                if not line.strip():
+                    continue
+                out.append(_relabel(line, name))
+        return "\n".join(out) + ("\n" if out else "")
+
+    def fleet_slo(self) -> dict:
+        """Fleet SLO rollup from the health cache: per-replica worst
+        fast-burn + breach flags (the live view; offline attainment
+        over merged telemetry is obs.slo.fleet_attainment)."""
+        per = {}
+        for name, st in self._states.items():
+            h = st.health or {}
+            per[name] = {
+                "slo_fast_burn": h.get("slo_fast_burn"),
+                "slo_breached": h.get("slo_breached"),
+            }
+        burns = [v["slo_fast_burn"] for v in per.values()
+                 if isinstance(v["slo_fast_burn"], (int, float))]
+        return {
+            "replicas": per,
+            "worst_fast_burn": max(burns) if burns else None,
+            "any_breached": any(v["slo_breached"] for v in per.values()),
+        }
+
+    # -- telemetry plumbing -------------------------------------------
+    def _span(self, name: str, dur_s: float, **attrs) -> None:
+        if self.tracer is not None:
+            self.tracer.add_span(name, dur_s, **attrs)
+
+    def _event(self, kind: str, detail: str) -> None:
+        if self.bus is not None:
+            self.bus.event(0, kind, detail, echo="[router]")
+
+    def _hop(self, tid: str, replica: str, attempt: int, t_hop: float,
+             outcome: str, error, **extra) -> None:
+        attrs = dict(trace_id=tid,
+                     span_id=f"{tid}/h{attempt}",
+                     parent_id=reqtrace.root_span_id(tid),
+                     replica=replica, attempt=attempt, outcome=outcome)
+        if error is not None:
+            attrs["error"] = f"{type(error).__name__}: {error}"[:200]
+        attrs.update(extra)
+        self._span("router_hop", time.monotonic() - t_hop, **attrs)
+        if outcome == "failover":
+            self._event(
+                "router_failover",
+                f"trace {tid} attempt {attempt} on {replica}: "
+                f"{type(error).__name__}: {error}")
+
+    def _finish(self, tid: str, t0: float, outcome: str, attempts: int,
+                failovers: int, **extra) -> None:
+        self._span("router_respond", 0.0, trace_id=tid,
+                   parent_id=reqtrace.root_span_id(tid),
+                   outcome=outcome,
+                   latency_s=round(time.monotonic() - t0, 6),
+                   hops=attempts, failovers=failovers, **extra)
+        if outcome == "saturated":
+            self._event("router_shed",
+                        f"trace {tid} shed after {attempts} attempt(s): "
+                        "fleet-wide brownout")
+
+
+def _relabel(sample_line: str, replica: str) -> str:
+    """Stamp replica="<name>" onto one Prometheus sample line."""
+    head, _, value = sample_line.rpartition(" ")
+    if not head:
+        return sample_line
+    if head.endswith("}"):
+        return f'{head[:-1]},replica="{replica}"}} {value}'
+    return f'{head}{{replica="{replica}"}} {value}'

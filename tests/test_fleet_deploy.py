@@ -1,0 +1,226 @@
+"""Zero-downtime rolling deploys (docs/DESIGN.md "Fleet serving",
+serve/deploy.py): quiesce -> drain -> poke-the-watcher -> SLO-gated
+probation, per replica, with auto-rollback on any gate failure.
+
+Real services behind LocalReplica handles, a real on-disk registry, and
+real RegistryWatchers (poll_s huge: swaps happen only when the deploy
+driver pokes) — the drills are the same three serve_bench --fleet runs
+judged, shrunk to tier-1 size:
+
+  - a good artifact rolls across the fleet, one replica at a time,
+    while the others keep serving;
+  - a corrupt artifact fails verify on the canary, opens the swap
+    breaker, and the deploy auto-rolls the channel + fleet back —
+    after which the breaker RESETS (the breaker guards the artifact,
+    not the channel), so the fleet is deployable again;
+  - a canary whose SLO fast-burn crosses deploy_burn_max during
+    probation is caught by the PR 14 gate and the deploy reverts.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import (
+    DiffusionConfig,
+    ModelConfig,
+    RouterConfig,
+    ServeConfig,
+    SLOConfig,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+from novel_view_synthesis_3d_tpu.registry import (
+    RegistryStore,
+    RegistryWatcher,
+)
+from novel_view_synthesis_3d_tpu.sample.service import (
+    DeadlineExceeded,
+    SamplingService,
+    request_cond_from_batch,
+)
+from novel_view_synthesis_3d_tpu.serve import FleetRouter, LocalReplica
+from novel_view_synthesis_3d_tpu.serve.deploy import rolling_deploy
+
+pytestmark = [pytest.mark.smoke]
+
+TINY = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                   attn_resolutions=(8,), dropout=0.0)
+T = 3
+S = 16
+
+RCFG = RouterConfig(retry_budget=2, deploy_drain_timeout_s=30.0,
+                    deploy_probation_s=0.3, deploy_swap_timeout_s=30.0)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T)
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=4, sidelength=S, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((4,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((4,)), train=False)["params"]
+    conds = [request_cond_from_batch(mb, i) for i in range(4)]
+    return model, params, dcfg, conds
+
+
+@pytest.fixture()
+def fleet(setup, tmp_path):
+    """Two watcher-wired replicas serving registry v1 off the 'stable'
+    channel, behind a router. Yields (router, store, versions, cores)
+    and tears the fleet down."""
+    model, params, dcfg, _ = setup
+    store = RegistryStore(os.path.join(str(tmp_path), "registry"))
+    # Idempotent publishes: same bytes need distinct steps for
+    # distinct version ids.
+    v1 = store.publish_params(params, step=1, ema=False,
+                              channel="stable").version
+    v2 = store.publish_params(params, step=2, ema=False,
+                              channel=None).version
+    cores = []
+    for name in ("a", "b"):
+        rdir = os.path.join(str(tmp_path), f"replica_{name}")
+        svc = SamplingService(
+            model, store.load_params(v1), dcfg,
+            ServeConfig(scheduler="step", max_batch=4,
+                        flush_timeout_ms=5.0, queue_depth=64, k_max=4,
+                        slo=SLOConfig(targets=f"{T}:60000")),
+            results_folder=rdir, model_version=v1)
+        watcher = RegistryWatcher(svc, store, "stable", poll_s=3600.0)
+        cores.append(LocalReplica(name, svc, watcher=watcher,
+                                  run_dir=rdir))
+    router = FleetRouter(cores, rcfg=RCFG)
+    router.poll_health()
+    yield router, store, {"v1": v1, "v2": v2}, cores
+    router.close()
+    for core in cores:
+        try:
+            core.close()
+        except Exception:
+            pass
+
+
+def versions_of(cores):
+    return {c.name: c.healthz().get("model_version") for c in cores}
+
+
+def warm(router, conds):
+    img = router.request(conds[0], seed=1, sample_steps=T)
+    assert np.isfinite(img).all()
+
+
+def test_good_deploy_rolls_whole_fleet(setup, fleet):
+    _, _, _, conds = setup
+    router, store, v, cores = fleet
+    warm(router, conds)
+    report = rolling_deploy(router, store, "stable", v["v2"], rcfg=RCFG)
+    assert report["status"] == "deployed", report
+    assert [s["outcome"] for s in report["steps"]] == ["ok", "ok"]
+    assert store.read_channel("stable") == v["v2"]
+    assert set(versions_of(cores).values()) == {v["v2"]}
+    # the fleet still serves after the roll
+    warm(router, conds)
+    # every replica stayed in rotation at the end
+    snap = router.fleet_snapshot()
+    assert all(r["in_rotation"] for r in snap["replicas"].values())
+
+
+def test_corrupt_artifact_opens_breaker_and_rolls_back(setup, fleet):
+    _, _, _, conds = setup
+    router, store, v, cores = fleet
+    warm(router, conds)
+    v3 = store.publish_params(setup[1], step=3, ema=False,
+                              channel=None).version
+    payload = os.path.join(store.versions_dir, v3, "params.msgpack")
+    with open(payload, "r+b") as fh:
+        fh.seek(64)
+        fh.write(b"\xde\xad\xbe\xef")
+
+    report = rolling_deploy(router, store, "stable", v3, rcfg=RCFG)
+    assert report["status"] == "rolled_back", report
+    assert "breaker" in report["reason"]
+    assert report["steps"][0]["outcome"] == "swap_failed"
+    # channel and fleet converged back on v1; nobody serves the
+    # corrupt artifact
+    assert store.read_channel("stable") == v["v1"]
+    assert set(versions_of(cores).values()) == {v["v1"]}
+    warm(router, conds)
+    # the rollback heals the breaker: the channel moved OFF the bad
+    # artifact, so the canary's breaker resets and the fleet is
+    # deployable again (to a GOOD artifact) without manual surgery
+    canary = cores[0]
+    deadline = time.time() + 10  # the rollback poke heals it async
+    while (time.time() < deadline
+           and canary.healthz()["breaker"] != "closed"):
+        time.sleep(0.02)
+    assert canary.healthz()["breaker"] == "closed"
+    report2 = rolling_deploy(router, store, "stable", v["v2"],
+                             rcfg=RCFG)
+    assert report2["status"] == "deployed", report2
+    assert set(versions_of(cores).values()) == {v["v2"]}
+
+
+def test_pre_gate_refuses_while_breaker_open(setup, fleet):
+    _, _, _, conds = setup
+    router, store, v, cores = fleet
+    v3 = store.publish_params(setup[1], step=3, ema=False,
+                              channel=None).version
+    payload = os.path.join(store.versions_dir, v3, "params.msgpack")
+    with open(payload, "r+b") as fh:
+        fh.seek(64)
+        fh.write(b"\xde\xad\xbe\xef")
+    # Trip the canary's breaker OUTSIDE a deploy: someone pointed the
+    # channel at the bad artifact by hand.
+    store.set_channel("stable", v3)
+    assert cores[0].watcher.poll_once() is None
+    assert cores[0].healthz()["breaker"] == "open"
+
+    report = rolling_deploy(router, store, "stable", v["v2"], rcfg=RCFG)
+    assert report["status"] == "refused", report
+    assert "breaker" in report["reason"]
+    # refusal is a no-op: the channel pointer did not move
+    assert store.read_channel("stable") == v3
+    assert set(versions_of(cores).values()) == {v["v1"]}
+
+
+def test_slo_burned_canary_fails_probation(setup, fleet):
+    _, _, _, conds = setup
+    router, store, v, cores = fleet
+    warm(router, conds)
+    # Burn the canary's fast window deterministically: deadline-doomed
+    # requests expire in-queue, each recording an SLO error
+    # (errors/total >> 1 - objective => fast_burn >> deploy_burn_max).
+    canary = cores[0]
+    for i in range(6):
+        try:
+            tk = canary.submit(conds[i % len(conds)], seed=100 + i,
+                               sample_steps=T, deadline_ms=1.0)
+        except DeadlineExceeded:
+            continue  # expired at admission: also recorded
+        with pytest.raises(DeadlineExceeded):
+            tk.result(timeout=60)
+    assert float(canary.healthz()["slo_fast_burn"]) >= \
+        RCFG.deploy_burn_max
+
+    report = rolling_deploy(router, store, "stable", v["v2"], rcfg=RCFG)
+    assert report["status"] == "rolled_back", report
+    assert "probation" in report["reason"]
+    assert report["steps"][0]["outcome"] == "gate_failed"
+    # the artifact was fine — but the gate cannot tell a bad canary
+    # from a bad artifact, so the fleet reverts to the known-good state
+    assert store.read_channel("stable") == v["v1"]
+    assert set(versions_of(cores).values()) == {v["v1"]}
+    warm(router, conds)

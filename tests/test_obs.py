@@ -540,6 +540,61 @@ def test_reqtrace_slo_writer_surfaces_route_through_bus():
                     "the csv sinks")
 
 
+def test_fleet_router_writer_surfaces_route_through_bus():
+    """The fleet-serving spans (router_submit/router_hop/
+    router_respond), the router/deploy event kinds, and the router +
+    swap-breaker gauges (PR 16) are NEW writer surfaces — every module
+    outside obs/ that names one must route through the tracer/bus (the
+    walk above already bans the telemetry-file literals), never a
+    private csv path; and the writers the DESIGN doc promises live in
+    the router, the deploy driver, and the registry watcher."""
+    import novel_view_synthesis_3d_tpu as pkg
+
+    pkg_root = os.path.dirname(os.path.abspath(pkg.__file__))
+    names = ("router_submit", "router_hop", "router_respond",
+             "router_failover", "router_shed", "deploy_begin",
+             "deploy_rollback", "deploy_done",
+             "nvs3d_router_failovers_total", "nvs3d_swap_breaker_state")
+    emitters = []
+    for root, _, files in os.walk(pkg_root):
+        if os.path.basename(root) == "obs":
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+            names_surface = imports_csv = False
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in names):
+                    names_surface = True
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    mod = getattr(node, "module", None) or ""
+                    if "csv" in [a.name for a in node.names] \
+                            or mod == "csv":
+                        imports_csv = True
+            if names_surface:
+                rel = os.path.relpath(path, pkg_root)
+                emitters.append(rel)
+                assert not imports_csv, (
+                    f"{rel} names fleet-router surfaces AND imports csv "
+                    "— telemetry writes belong to obs.bus only")
+                assert "tracer" in src or "obs." in src \
+                    or "bus." in src or "event_cb" in src, (
+                        f"{rel} names fleet-router surfaces but has no "
+                        "bus-routed path")
+    assert any(e.endswith(os.path.join("serve", "router.py"))
+               for e in emitters)
+    assert any(e.endswith(os.path.join("serve", "deploy.py"))
+               for e in emitters)
+    assert any(e.endswith(os.path.join("registry", "watcher.py"))
+               for e in emitters)
+
+
 # ---------------------------------------------------------------------------
 # Device monitor / MFU
 # ---------------------------------------------------------------------------

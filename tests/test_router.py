@@ -1,0 +1,615 @@
+"""Fleet router: least-step-debt dispatch, session affinity, failover,
+and cross-replica trace reconstruction (docs/DESIGN.md "Fleet
+serving").
+
+Two layers:
+
+  - policy units against FAKE replica handles (no model, no mesh):
+    dispatch ranking, the outstanding-work ledger, affinity pin/
+    migration/eviction, the failover loop's error taxonomy
+    (ReplicaUnreachable vs retryable shed vs fatal), retry budgets,
+    FleetSaturated semantics, and the /metrics relabeling merge;
+  - integration against REAL LocalReplica-wrapped services on the
+    8-virtual-CPU test mesh: a mid-orbit replica death must yield a
+    complete orbit (frame-bank continuation on the survivor), the HTTP
+    transport must marshal errors losslessly, and the merged fleet
+    telemetry must reconstruct every routed request
+    (obs/reqtrace.verify_fleet returns no problems).
+"""
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu import obs
+from novel_view_synthesis_3d_tpu.config import (
+    DiffusionConfig,
+    ModelConfig,
+    ObsConfig,
+    RouterConfig,
+    ServeConfig,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+from novel_view_synthesis_3d_tpu.obs import reqtrace
+from novel_view_synthesis_3d_tpu.sample.service import (
+    Rejected,
+    SampleAnomaly,
+    SamplingService,
+    ServeError,
+    request_cond_from_batch,
+)
+from novel_view_synthesis_3d_tpu.serve import (
+    FleetRouter,
+    FleetSaturated,
+    HttpReplica,
+    LocalReplica,
+    NoReplicaAvailable,
+    ReplicaServer,
+    ReplicaUnreachable,
+)
+from novel_view_synthesis_3d_tpu.serve.replica import (
+    error_to_wire,
+    wire_to_error,
+)
+from novel_view_synthesis_3d_tpu.serve.router import _relabel
+from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+
+pytestmark = [pytest.mark.smoke]
+
+TINY = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                   attn_resolutions=(8,), dropout=0.0)
+T = 3
+S = 16
+
+
+# ---------------------------------------------------------------------------
+# fakes: the replica handle protocol without a model
+# ---------------------------------------------------------------------------
+class FakeTicket:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def result(self, timeout=None):
+        return self._fn()
+
+
+class FakeReplica:
+    """Scriptable replica handle: `script` / `traj_script` hold one
+    entry per expected call — an Exception instance to raise from
+    result(), or None to succeed."""
+
+    def __init__(self, name, *, step_debt=0, frame=None):
+        self.name = name
+        self.health = {"status": "ok", "serve_state": "ok",
+                       "queue_depth": 0, "step_debt": step_debt,
+                       "brownout_level": 0, "breaker": "closed",
+                       "model_version": "v1"}
+        self.frame = (frame if frame is not None
+                      else np.zeros((S, S, 3), np.float32))
+        self.script = []
+        self.traj_script = []
+        self.submits = []
+        self.traj_submits = []
+
+    def healthz(self):
+        if isinstance(self.health, Exception):
+            raise self.health
+        return dict(self.health)
+
+    def _action(self, script):
+        return script.pop(0) if script else None
+
+    def submit(self, cond, *, seed=0, sample_steps=None,
+               guidance_weight=None, deadline_ms=None, trace_id=None):
+        self.submits.append({"cond": cond, "seed": seed,
+                             "trace_id": trace_id})
+        action = self._action(self.script)
+
+        def run():
+            if isinstance(action, Exception):
+                raise action
+            return self.frame
+
+        return FakeTicket(run)
+
+    def submit_trajectory(self, cond, poses, *, seed=0,
+                          sample_steps=None, guidance_weight=None,
+                          deadline_ms=None, k_max=None, trace_id=None):
+        n = int(np.asarray(poses["R2"]).shape[0])
+        self.traj_submits.append({"cond": cond, "poses": poses,
+                                  "seed": seed, "trace_id": trace_id})
+        action = self._action(self.traj_script)
+
+        def run():
+            if isinstance(action, Exception):
+                raise action
+            return np.stack([self.frame] * n)
+
+        return FakeTicket(run)
+
+    def metrics_text(self):
+        return ("# HELP nvs3d_fake_total fake\n"
+                "# TYPE nvs3d_fake_total counter\n"
+                'nvs3d_fake_total{kind="a"} 1\n'
+                "nvs3d_fake_bare 2\n")
+
+    def begin_drain(self):
+        self.health["serve_state"] = "draining"
+
+    def drain(self, timeout_s=None):
+        return True
+
+    def poke(self):
+        pass
+
+
+def make_router(replicas, **rkw):
+    rkw.setdefault("retry_budget", 2)
+    # sleep=no-op: failover backoff must not slow the suite down.
+    r = FleetRouter(replicas, rcfg=RouterConfig(**rkw),
+                    sleep=lambda s: None)
+    r.poll_health()
+    return r
+
+
+def orbit_for(n):
+    return orbit_poses(n, radius=1.0, elevation=0.3)
+
+
+# ---------------------------------------------------------------------------
+# dispatch policy
+# ---------------------------------------------------------------------------
+def test_pick_least_step_debt():
+    a, b = FakeReplica("a", step_debt=7), FakeReplica("b", step_debt=0)
+    router = make_router([a, b])
+    assert router.pick() == "b"
+
+
+def test_outstanding_ledger_counts_between_polls():
+    # Equal polled debt; the router's own in-flight ledger must break
+    # the tie toward the idle replica without waiting for a poll.
+    a, b = FakeReplica("a"), FakeReplica("b")
+    router = make_router([a, b])
+    router._states["a"].outstanding = 4
+    assert router.pick() == "b"
+
+
+def test_brownout_and_drain_leave_rotation():
+    a, b = FakeReplica("a"), FakeReplica("b", step_debt=99)
+    a.health["brownout_level"] = 2
+    router = make_router([a, b])
+    assert router.pick() == "b"  # despite b's huge debt
+    b.health["serve_state"] = "draining"
+    router.poll_health()
+    with pytest.raises(NoReplicaAvailable):
+        router.pick()
+
+
+def test_no_replica_when_all_quiesced():
+    router = make_router([FakeReplica("a"), FakeReplica("b")])
+    router.quiesce("a")
+    router.quiesce("b")
+    with pytest.raises(NoReplicaAvailable) as ei:
+        router.request(np.zeros(1))
+    assert ei.value.retryable
+
+
+def test_affinity_pins_and_survives_debt_shift():
+    a, b = FakeReplica("a", step_debt=5), FakeReplica("b")
+    router = make_router([a, b])
+    assert router.pick(session="orbit") == "b"
+    # b becomes the worse choice — the pin must still win (the frame
+    # bank lives there).
+    b.health["step_debt"] = 50
+    router.poll_health()
+    assert router.pick(session="orbit") == "b"
+    assert router.pick() == "a"  # unpinned traffic rebalances
+
+
+def test_affinity_migrates_off_quiesced_replica():
+    a, b = FakeReplica("a", step_debt=5), FakeReplica("b")
+    router = make_router([a, b])
+    assert router.pick(session="orbit") == "b"
+    router.quiesce("b")
+    assert router.pick(session="orbit") == "a"
+    assert router._affinity["orbit"] == "a"
+
+
+def test_affinity_table_is_bounded():
+    router = make_router([FakeReplica("a")], affinity_entries=2)
+    for i in range(5):
+        router.pick(session=f"s{i}")
+    assert len(router._affinity) == 2
+    assert "s4" in router._affinity and "s0" not in router._affinity
+
+
+# ---------------------------------------------------------------------------
+# failover
+# ---------------------------------------------------------------------------
+def test_failover_on_replica_death():
+    a, b = FakeReplica("a"), FakeReplica("b", step_debt=5)
+    a.script = [ReplicaUnreachable("a: connection refused")]
+    router = make_router([a, b])
+    img = router.request(np.zeros(1), sample_steps=T, trace_id="t1")
+    assert img.shape == (S, S, 3)
+    assert not router._states["a"].reachable
+    assert b.submits and b.submits[0]["trace_id"] == "t1"
+    snap = router.fleet_snapshot()
+    assert snap["healthy"] == 1 and snap["total"] == 2
+
+
+def test_fatal_error_does_not_fail_over():
+    a, b = FakeReplica("a"), FakeReplica("b", step_debt=5)
+    a.script = [ServeError("params are garbage")]
+    router = make_router([a, b])
+    with pytest.raises(ServeError):
+        router.request(np.zeros(1))
+    assert not b.submits  # a non-retryable error must not spread
+
+
+def test_single_shot_shed_explores_other_replicas():
+    # A shed replica is excluded from this request's retries: the
+    # budget explores capacity instead of hammering a full queue.
+    a, b = FakeReplica("a"), FakeReplica("b", step_debt=50)
+    a.script = [Rejected("full", retryable=True, retry_after_s=0.1)]
+    router = make_router([a, b], retry_budget=3)
+    img = router.request(np.zeros(1), sample_steps=T)
+    assert img.shape == (S, S, 3)
+    assert len(a.submits) == 1 and len(b.submits) == 1
+
+
+def test_trajectory_retry_budget_exhausted_reraises():
+    # Trajectories retry IN PLACE (the frame bank is worth waiting
+    # for) — a replica that keeps failing burns the budget, then the
+    # last error surfaces to the caller.
+    a, b = FakeReplica("a"), FakeReplica("b", step_debt=50)
+    a.traj_script = [SampleAnomaly("nan"), SampleAnomaly("nan"),
+                     SampleAnomaly("nan"), SampleAnomaly("nan")]
+    router = make_router([a, b], retry_budget=2)
+    cond = {"x": np.zeros((S, S, 3), np.float32),
+            "R1": np.eye(3, dtype=np.float32),
+            "t1": np.zeros(3, np.float32),
+            "K": np.eye(3, dtype=np.float32)}
+    with pytest.raises(SampleAnomaly):
+        router.request_trajectory(cond, orbit_for(3), sample_steps=T)
+    # budget=2 failovers -> 3 attempts total, all on the cheap replica
+    assert len(a.traj_submits) == 3 and not b.traj_submits
+
+
+def test_fleet_saturated_on_full_sweep_shed():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    a.script = [Rejected("full", retryable=True, retry_after_s=0.5)]
+    b.script = [Rejected("full", retryable=True, retry_after_s=2.0)]
+    router = make_router([a, b], retry_budget=5)
+    with pytest.raises(FleetSaturated) as ei:
+        router.request(np.zeros(1))
+    # carries the fleet's own worst backoff estimate
+    assert ei.value.retryable and ei.value.retry_after_s == 2.0
+    # one attempt per replica, NOT budget x replicas retry-storming
+    assert len(a.submits) + len(b.submits) == 2
+
+
+def test_trajectory_stitches_partial_frames_across_replica_death():
+    f_a = np.full((S, S, 3), 0.25, np.float32)
+    f_b = np.full((S, S, 3), 0.75, np.float32)
+    a = FakeReplica("a", frame=f_a)
+    b = FakeReplica("b", frame=f_b, step_debt=5)
+    partial = [f_a, f_a]
+    # The transport delivered 2 frames, then the replica died: the
+    # error is a death (excluded from retries) that still carries the
+    # streamed partials — the stitch must cross replicas.
+    death = ReplicaUnreachable("connection reset after 2 frames")
+    death.frames = partial
+    a.traj_script = [death]
+    router = make_router([a, b])
+    cond = {"x": np.zeros((S, S, 3), np.float32),
+            "R1": np.eye(3, dtype=np.float32),
+            "t1": np.zeros(3, np.float32),
+            "K": np.eye(3, dtype=np.float32)}
+    frames = router.request_trajectory(cond, orbit_for(5), seed=3,
+                                       sample_steps=T, session="orb")
+    # 2 partial frames from a + 3 continuation frames from b
+    assert frames.shape == (5, S, S, 3)
+    assert np.array_equal(frames[1], f_a)
+    assert np.array_equal(frames[2], f_b)
+    hop = b.traj_submits[0]
+    # continuation re-conditions on the LAST DELIVERED frame at its
+    # own pose, and only the remaining poses are submitted
+    assert np.array_equal(hop["cond"]["x"], f_a)
+    assert np.asarray(hop["poses"]["R2"]).shape[0] == 3
+    # the orbit's pin moved with the failover
+    assert router._affinity["orb"] == "b"
+
+
+def test_trajectory_anomaly_retries_in_place_with_stitch():
+    f_a = np.full((S, S, 3), 0.25, np.float32)
+    a = FakeReplica("a", frame=f_a)
+    b = FakeReplica("b", step_debt=5)
+    partial = [f_a, f_a]
+    a.traj_script = [SampleAnomaly("nan quarantined", frames=partial,
+                                   frame_index=2)]
+    router = make_router([a, b])
+    cond = {"x": np.zeros((S, S, 3), np.float32),
+            "R1": np.eye(3, dtype=np.float32),
+            "t1": np.zeros(3, np.float32),
+            "K": np.eye(3, dtype=np.float32)}
+    frames = router.request_trajectory(cond, orbit_for(5), seed=3,
+                                       sample_steps=T, session="orb")
+    assert frames.shape == (5, S, S, 3)
+    # transient anomaly: the retry lands back on the same (cheapest)
+    # replica, re-conditioned on the last delivered frame
+    assert len(a.traj_submits) == 2 and not b.traj_submits
+    hop = a.traj_submits[1]
+    assert np.array_equal(hop["cond"]["x"], f_a)
+    assert np.asarray(hop["poses"]["R2"]).shape[0] == 3
+    assert router._affinity["orb"] == "a"
+
+
+def test_trajectory_session_rejoins_pinned_replica():
+    a, b = FakeReplica("a"), FakeReplica("b", step_debt=5)
+    router = make_router([a, b])
+    cond = {"x": np.zeros((S, S, 3), np.float32),
+            "R1": np.eye(3, dtype=np.float32),
+            "t1": np.zeros(3, np.float32),
+            "K": np.eye(3, dtype=np.float32)}
+    router.request_trajectory(cond, orbit_for(2), session="s",
+                              sample_steps=T)
+    a.health["step_debt"] = 80  # pinned replica becomes "worse"
+    router.poll_health()
+    router.request_trajectory(cond, orbit_for(2), session="s",
+                              sample_steps=T)
+    assert len(a.traj_submits) == 2 and not b.traj_submits
+
+
+# ---------------------------------------------------------------------------
+# fleet views
+# ---------------------------------------------------------------------------
+def test_fleet_metrics_text_relabels_and_dedups():
+    router = make_router([FakeReplica("a"), FakeReplica("b")])
+    text = router.fleet_metrics_text()
+    assert text.count("# HELP nvs3d_fake_total fake") == 1
+    assert 'nvs3d_fake_total{kind="a",replica="a"} 1' in text
+    assert 'nvs3d_fake_bare{replica="b"} 2' in text
+
+
+def test_relabel_line_forms():
+    assert _relabel('m{k="v"} 3', "r0") == 'm{k="v",replica="r0"} 3'
+    assert _relabel("m 3", "r0") == 'm{replica="r0"} 3'
+
+
+def test_metrics_server_serves_fleet_aggregation():
+    """Wiring `metrics_server=` hangs fleet_metrics_text on the obs
+    endpoint: one scrape returns the router's own families PLUS every
+    replica's, relabeled — and close() unhooks it."""
+    import urllib.request
+
+    from novel_view_synthesis_3d_tpu.obs.server import (
+        start_metrics_server)
+
+    server = start_metrics_server(port=0)
+    try:
+        router = FleetRouter([FakeReplica("a"), FakeReplica("b")],
+                             sleep=lambda s: None,
+                             metrics_server=server)
+        router.poll_health()
+        body = urllib.request.urlopen(
+            server.url("/metrics"), timeout=10).read().decode()
+        assert "nvs3d_router_replicas_healthy" in body  # router's own
+        assert 'nvs3d_fake_total{kind="a",replica="a"} 1' in body
+        assert 'nvs3d_fake_bare{replica="b"} 2' in body
+        router.close()
+        body = urllib.request.urlopen(
+            server.url("/metrics"), timeout=10).read().decode()
+        assert "replica=" not in body  # unhooked on close
+    finally:
+        server.close()
+
+
+def test_healthz_failure_marks_unreachable_then_recovers():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    router = make_router([a, b])
+    good = dict(a.health)
+    a.health = ConnectionError("boom")
+    router.poll_health()
+    assert not router._states["a"].reachable
+    assert router.fleet_snapshot()["healthy"] == 1
+    a.health = good
+    router.poll_health()
+    assert router.fleet_snapshot()["healthy"] == 2
+
+
+# ---------------------------------------------------------------------------
+# error wire marshalling (the HTTP failover contract)
+# ---------------------------------------------------------------------------
+def test_error_wire_round_trip_preserves_taxonomy():
+    frames = [np.full((S, S, 3), 0.5, np.float32)]
+    for err in (
+            Rejected("queue full", retryable=True, retry_after_s=1.5),
+            SampleAnomaly("nan at step 2", frames=frames, frame_index=1,
+                          retry_after_s=0.25),
+            ServeError("fatal"),
+    ):
+        back = wire_to_error(error_to_wire(err))
+        assert type(back) is type(err)
+        assert getattr(back, "retryable", False) == getattr(
+            err, "retryable", False)
+        assert getattr(back, "retry_after_s", 0.0) == getattr(
+            err, "retry_after_s", 0.0)
+    anom = wire_to_error(error_to_wire(
+        SampleAnomaly("nan", frames=frames, frame_index=1)))
+    assert len(anom.frames) == 1
+    assert np.allclose(np.asarray(anom.frames[0]), frames[0])
+
+
+# ---------------------------------------------------------------------------
+# integration: real services behind the router
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def setup():
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T)
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=4, sidelength=S, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((4,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((4,)), train=False)["params"]
+    conds = [request_cond_from_batch(mb, i) for i in range(4)]
+    return model, params, dcfg, conds
+
+
+def make_replica(setup, fleet_dir, name):
+    """A LocalReplica wired the way replica_main wires it: its own
+    telemetry dir under <fleet>/replica_<name>/ feeding trace
+    reconstruction."""
+    model, params, dcfg, _ = setup
+    rdir = os.path.join(str(fleet_dir), f"replica_{name}")
+    telem = obs.RunTelemetry.create(
+        ObsConfig(device_poll_s=0.0, metrics_port=0), rdir,
+        start_server=False)
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=4, flush_timeout_ms=5.0,
+                    queue_depth=64, k_max=4),
+        results_folder=rdir, tracer=telem.tracer, flight=telem.flight,
+        model_version="v1")
+    return LocalReplica(name, svc, run_dir=rdir), telem
+
+
+def traj_cond(cond):
+    return {k: cond[k] for k in ("x", "R1", "t1", "K")}
+
+
+def test_router_end_to_end_with_fleet_trace(setup, tmp_path):
+    _, _, _, conds = setup
+    ra, telem_a = make_replica(setup, tmp_path, "a")
+    rb, telem_b = make_replica(setup, tmp_path, "b")
+    rtel = obs.RunTelemetry.create(
+        ObsConfig(device_poll_s=0.0, metrics_port=0),
+        os.path.join(str(tmp_path), "router"), start_server=False)
+    router = FleetRouter([ra, rb], rcfg=RouterConfig(retry_budget=2),
+                         tracer=rtel.tracer, bus=rtel.bus)
+    router.poll_health()
+    try:
+        img = router.request(conds[0], seed=1, sample_steps=T,
+                             trace_id="t-one")
+        assert img.shape == (S, S, 3) and np.isfinite(img).all()
+
+        poses = orbit_poses(
+            3, radius=float(np.linalg.norm(conds[0]["t1"])) or 1.0,
+            elevation=0.3)
+        frames = router.request_trajectory(
+            traj_cond(conds[0]), poses, seed=2, sample_steps=T,
+            session="orb", trace_id="t-orb")
+        assert frames.shape[0] == 3
+
+        # Kill the replica holding the orbit's frame bank; the pinned
+        # session MUST fail over and still deliver a complete orbit.
+        pinned = router._affinity["orb"]
+        victim, survivor = (ra, rb) if pinned == "a" else (rb, ra)
+        victim.close()
+        frames2 = router.request_trajectory(
+            traj_cond(conds[1]), poses, seed=3, sample_steps=T,
+            session="orb", trace_id="t-orb2")
+        assert frames2.shape[0] == 3
+        assert router._affinity["orb"] == survivor.name
+        assert not router._states[victim.name].reachable
+    finally:
+        router.close()
+        for core in (ra, rb):
+            try:
+                core.close()
+            except Exception:
+                pass
+        telem_a.finalize()
+        telem_b.finalize()
+        rtel.finalize()
+
+    per_source = reqtrace.load_fleet_rows(str(tmp_path))
+    assert "router" in per_source
+    assert {"replica_a", "replica_b"} <= set(per_source)
+    fleet = reqtrace.reconstruct_fleet(per_source)
+    assert {"t-one", "t-orb", "t-orb2"} <= set(fleet)
+    problems = reqtrace.verify_fleet(fleet, per_source)
+    assert problems == []
+    tl = fleet["t-orb2"]
+    assert tl["outcome"] == "ok" and tl["failovers"] >= 1
+    fo = [h for h in tl["hops"] if h["outcome"] == "failover"]
+    assert fo and all(h["replica"] == victim.name for h in fo)
+    # the cross-replica join: the ok hop's replica timeline is complete
+    ok_hop = tl["hops"][-1]
+    assert ok_hop["outcome"] == "ok"
+    assert tl["replica_timelines"][ok_hop["replica"]]["complete"]
+    # and the human-facing formatter renders it without raising
+    assert "failover" in reqtrace.format_fleet_timeline(tl)
+
+
+def test_http_transport_round_trip(setup, tmp_path):
+    _, _, _, conds = setup
+    core, telem = make_replica(setup, tmp_path, "h")
+    server = ReplicaServer(core)
+    h = HttpReplica("h", server.url(), run_dir=core.run_dir)
+    try:
+        snap = h.healthz()
+        assert snap["serve_state"] == "ok"
+        assert {"step_debt", "brownout_level", "queue_depth"} <= set(snap)
+        img = h.submit(conds[0], seed=9, sample_steps=T,
+                       trace_id="t-http").result(timeout=300)
+        assert img.shape == (S, S, 3) and np.isfinite(img).all()
+        assert "nvs3d_" in h.metrics_text()
+
+        # drain over HTTP: admissions must become STRUCTURED retryable
+        # rejects a router can fail over on
+        h.begin_drain()
+        with pytest.raises(Rejected) as ei:
+            h.submit(conds[0], seed=10, sample_steps=T).result(
+                timeout=30)
+        assert ei.value.retryable  # draining: the router can fail over
+        h.drain(30.0)
+    finally:
+        server.close()
+        try:
+            core.close()
+        except Exception:
+            pass
+        telem.finalize()
+    # a closed server is a DEAD replica, not an HTTP error
+    with pytest.raises(ReplicaUnreachable):
+        h.healthz()
+
+
+def test_router_against_dead_http_endpoint(setup, tmp_path):
+    """A router whose replica vanished entirely (connection refused)
+    marks it unreachable and serves from the survivor."""
+    _, _, _, conds = setup
+    core, telem = make_replica(setup, tmp_path, "live")
+    server = ReplicaServer(core)
+    live = HttpReplica("live", server.url(), run_dir=core.run_dir)
+    dead = HttpReplica("dead", "http://127.0.0.1:9")  # reserved port
+    router = FleetRouter([dead, live],
+                         rcfg=RouterConfig(retry_budget=2),
+                         sleep=lambda s: None)
+    try:
+        router.poll_health()
+        assert not router._states["dead"].reachable
+        img = router.request(conds[0], seed=11, sample_steps=T)
+        assert img.shape == (S, S, 3)
+    finally:
+        router.close()
+        server.close()
+        try:
+            core.close()
+        except Exception:
+            pass
+        telem.finalize()

@@ -11,6 +11,7 @@ recompiles on the anomaly path, and every rejection is STRUCTURED
 (retryable + retry_after_s) so clients can fail over."""
 
 import os
+import threading
 import time
 
 import jax
@@ -411,6 +412,92 @@ def test_drain_timeout_fails_leftovers_retryably(
             svc.stop()
 
 
+def test_drain_races_concurrent_admissions(setup, tmp_path):
+    """begin_drain() racing a herd of concurrent submit /
+    submit_trajectory callers: every admission that loses the race gets
+    a STRUCTURED retryable reject, every admission that won resolves to
+    real frames, and nothing hangs or is silently dropped — the fleet
+    router's failover path (PR 16) is built on exactly this contract."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, drain_timeout_s=60.0)
+    outcomes = []
+    errors = []
+    lock = threading.Lock()
+    halt = threading.Event()
+
+    def client(k):
+        for i in range(40):
+            if halt.is_set():
+                return
+            try:
+                if k % 2:
+                    tk = svc.submit_trajectory(
+                        traj_cond(conds[k % 4]),
+                        poses=orbit_for(conds[k % 4], 2),
+                        seed=1000 * k + i)
+                else:
+                    tk = svc.submit(conds[k % 4], seed=1000 * k + i)
+            except Rejected as e:
+                # lost the race to begin_drain: must be retryable with
+                # server-paced backoff, so a router can fail over
+                if not (e.retryable and e.retry_after_s > 0):
+                    with lock:
+                        errors.append(f"non-retryable admission "
+                                      f"reject: {e!r}")
+                    return
+                with lock:
+                    outcomes.append("rejected")
+                continue
+            except Exception as e:
+                with lock:
+                    errors.append(f"unstructured admission error: "
+                                  f"{e!r}")
+                return
+            try:
+                out = np.asarray(tk.result(timeout=120))
+                if not np.isfinite(out).all():
+                    with lock:
+                        errors.append("non-finite frames served")
+                    return
+                with lock:
+                    outcomes.append("served")
+            except Exception as e:
+                # a ticket admitted before the drain may NEVER vanish:
+                # the only legal failure is a structured retryable one
+                if not getattr(e, "retryable", False):
+                    with lock:
+                        errors.append(f"admitted ticket died "
+                                      f"non-retryably: {e!r}")
+                    return
+                with lock:
+                    outcomes.append("failed_retryable")
+
+    try:
+        warm(svc, conds[0])
+        threads = [threading.Thread(target=client, args=(k,),
+                                    daemon=True) for k in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.25)  # admissions mid-flight
+        svc.begin_drain(reason="race")
+        # wait until the herd actually hits the draining admission
+        # gate (each client first finishes the ticket it is blocked on)
+        deadline = time.time() + 60
+        while time.time() < deadline and "rejected" not in outcomes:
+            time.sleep(0.05)
+        halt.set()
+        for t in threads:
+            t.join(timeout=180)
+            assert not t.is_alive(), "client hung across the drain"
+        assert svc.drain() is True  # everything admitted completes
+    finally:
+        if svc._worker is not None:
+            svc.stop()
+    assert errors == []
+    assert outcomes.count("served") >= 1
+    assert outcomes.count("rejected") >= 1
+
+
 def test_stop_wedged_worker_writes_stall_diagnosis(
         setup, tmp_path, monkeypatch):
     """stop() on a wedged worker must not silently leak the thread: it
@@ -622,3 +709,53 @@ def test_swap_breaker_resets_on_new_version(monkeypatch):
     store.vid = "good"  # operator rolls the channel
     assert w.poll_once() == "good"
     assert svc.model_version == "good" and w.consecutive_failures == 0
+
+
+def test_breaker_state_property_and_gauge(monkeypatch):
+    """Satellite: the swap breaker is exported as the gauge
+    nvs3d_swap_breaker_state (closed 0 / open 1 / half-open 2) and as
+    the live breaker_state property — open -> half-open is a CLOCK
+    transition, visible to scrapes between polls."""
+    from novel_view_synthesis_3d_tpu import obs
+    from novel_view_synthesis_3d_tpu.registry.watcher import (
+        RegistryWatcher)
+
+    def gauge_value():
+        for line in obs.get_registry().render_prometheus().splitlines():
+            if line.startswith("nvs3d_swap_breaker_state "):
+                return float(line.rsplit(" ", 1)[1])
+        return None
+
+    svc, store = _StubService(), _StubStore()
+    w = RegistryWatcher(svc, store, "stable", poll_s=30.0, start=False,
+                        breaker_base_s=0.15)
+    assert w.breaker_state == "closed" and gauge_value() == 0.0
+    monkeypatch.setenv("NVS3D_FI_SERVE_SWAP_FAIL", "1")
+    assert w.poll_once() is None
+    assert w.breaker_state == "open" and gauge_value() == 1.0
+    time.sleep(0.2)
+    # backoff elapsed: reading the property refreshes the gauge too
+    assert w.breaker_state == "half-open" and gauge_value() == 2.0
+    assert w.poll_once() == "v1"  # half-open probe succeeds
+    assert w.breaker_state == "closed" and gauge_value() == 0.0
+
+
+def test_breaker_resets_when_channel_rolls_back_to_current(monkeypatch):
+    """Rollback heal: the channel returns to the version the replica
+    ALREADY serves, so no swap happens — but the breaker must reset
+    anyway (it guards the failed ARTIFACT, not the channel), or the
+    next rolling deploy's pre-gate (serve/deploy.py) would refuse a
+    perfectly healthy fleet forever."""
+    from novel_view_synthesis_3d_tpu.registry.watcher import (
+        RegistryWatcher)
+
+    svc, store = _StubService(), _StubStore("bad")
+    w = RegistryWatcher(svc, store, "stable", poll_s=30.0, start=False,
+                        breaker_base_s=600.0)
+    monkeypatch.setenv("NVS3D_FI_SERVE_SWAP_FAIL", "1")
+    assert w.poll_once() is None
+    assert w.breaker_state == "open"
+    store.vid = "v0"  # rolled back to what the service already serves
+    assert w.poll_once() is None  # nothing to swap...
+    assert w.breaker_state == "closed"  # ...but the breaker heals
+    assert svc.swapped == []  # and no spurious swap happened

@@ -323,7 +323,7 @@ def _unreachable_env(tmp_path):
 
 
 def test_cli_train_unreachable_backend_structured_exit(tmp_path):
-    # The acceptance drill: `nvsd train` against a wedged backend must be
+    # The acceptance drill: `nvs3d train` against a wedged backend must be
     # a structured sub-60s diagnosis, not a silent hang.
     t0 = time.monotonic()
     proc = subprocess.run(

@@ -1567,6 +1567,486 @@ def check_reqtrace(rt: dict) -> int:
     return rc
 
 
+# ---------------------------------------------------------------------------
+# --fleet: replica router + failover + rolling deploy (subprocess fleet)
+# ---------------------------------------------------------------------------
+def _await_ready(path: str, timeout_s: float) -> dict:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if os.path.exists(path):
+            with open(path) as fh:
+                return json.load(fh)
+        time.sleep(0.2)
+    raise RuntimeError(f"replica ready file {path} never appeared "
+                       f"within {timeout_s:.0f}s")
+
+
+def _spawn_replica(name: str, base: str, registry_dir: str, args,
+                   jax_cache: str):
+    """One fleet replica as a real OS process (serve/replica_main.py):
+    own JAX runtime, own telemetry dir (<base>/replica_<name>/), own
+    registry watcher on the 'stable' channel with poke-driven polling
+    (poll_s is huge on purpose — the deploy driver owns swap timing).
+
+    serve.step_floor_ms paces each denoise dispatch to a wall-clock
+    floor (the sleep releases the GIL/core), emulating the device-bound
+    replica a CPU CI host cannot provide — so the scaling lane measures
+    the ROUTER's ability to overlap N replicas, which is what fleet
+    serving adds, not the host's ability to run N models at once."""
+    import subprocess
+
+    rdir = os.path.join(base, f"replica_{name}")
+    os.makedirs(rdir, exist_ok=True)
+    spec = {
+        "name": name,
+        "results_folder": rdir,
+        "ready_file": os.path.join(base, f"{name}.ready"),
+        "preset": args.preset,
+        "sidelength": args.sidelength,
+        "steps": args.steps,
+        "port": 0,
+        "jax_cache_dir": jax_cache,
+        "registry": {"dir": registry_dir, "channel": "stable",
+                     "poll_s": 3600.0},
+        "overrides": {
+            "model.num_res_blocks": 1,
+            "model.attn_resolutions": [8],
+            "serve.scheduler": "step",
+            "serve.max_batch": 1,
+            "serve.k_max": max(4, args.fleet_frames),
+            "serve.flush_timeout_ms": 5.0,
+            "serve.queue_depth": 256,
+            "serve.step_floor_ms": args.fleet_floor_ms,
+            "serve.slo.targets": f"{args.steps}:60000",
+            "obs.device_poll_s": 0.0,
+        },
+    }
+    spec_path = os.path.join(base, f"{name}.spec.json")
+    with open(spec_path, "w") as fh:
+        json.dump(spec, fh)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    log = open(os.path.join(rdir, "replica.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "novel_view_synthesis_3d_tpu.serve.replica_main", spec_path],
+        stdout=log, stderr=subprocess.STDOUT, env=env, cwd=repo_root)
+    return proc
+
+
+def _fleet_closed_loop(router, conds, n: int, concurrency: int,
+                       steps: int, seed0: int, prefix: str) -> dict:
+    """Closed-loop load through the router: `concurrency` clients drain
+    a shared counter of `n` single-shot requests. Wall-clock RPS."""
+    lock = threading.Lock()
+    state = {"next": 0, "lat": [], "errors": []}
+
+    def client():
+        while True:
+            with lock:
+                i = state["next"]
+                if i >= n:
+                    return
+                state["next"] = i + 1
+            t0 = time.perf_counter()
+            try:
+                router.request(conds[i % len(conds)], seed=seed0 + i,
+                               sample_steps=steps,
+                               trace_id=f"{prefix}-{i}")
+            except Exception as e:
+                with lock:
+                    state["errors"].append(
+                        f"{prefix}-{i}: {type(e).__name__}: {e}")
+                continue
+            with lock:
+                state["lat"].append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return {"requests": n, "wall_s": round(wall, 3),
+            "rps": round(n / wall, 3), "p99_s": round(_p99(state["lat"]), 3),
+            "errors": state["errors"]}
+
+
+def fleet_bench(args) -> dict:
+    """Three judged drills over one real 4-process fleet:
+
+      scaling   closed-loop RPS with 1 replica in rotation vs all N —
+                the router must deliver near-linear fan-out (>= 3.2x at
+                N=4) over step-floor-paced replicas;
+      chaos     SIGKILL one replica while it owns a mid-flight orbit
+                and carries single-shot traffic — zero failed requests,
+                every failover hop names the victim (blast radius), and
+                the cross-replica trace reconstructs clean;
+      deploy    three scripted rolling deploys on the survivors: a good
+                version (zero-downtime, status 'deployed'), a corrupt
+                artifact (the swap breaker opens -> auto-rollback), and
+                a version whose canary gets an SLO-burn burst during
+                probation (the PR 14 gate -> auto-rollback) — with
+                closed-loop router traffic across all three asserting
+                zero failures.
+    """
+    from novel_view_synthesis_3d_tpu import obs
+    from novel_view_synthesis_3d_tpu.config import RouterConfig, get_preset
+    from novel_view_synthesis_3d_tpu.obs import reqtrace
+    from novel_view_synthesis_3d_tpu.registry import RegistryStore
+    from novel_view_synthesis_3d_tpu.serve import FleetRouter, HttpReplica
+    from novel_view_synthesis_3d_tpu.serve.deploy import rolling_deploy
+    from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+
+    base = args.fleet_dir or "/tmp/nvs3d_fleet_bench"
+    if os.path.isdir(base):
+        import shutil
+
+        shutil.rmtree(base)
+    os.makedirs(base, exist_ok=True)
+    jax_cache = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache")
+
+    # Parent-side build: conds for the load + the params the fleet
+    # serves (published as v1; every replica loads the channel head, so
+    # the whole fleet starts byte-identical).
+    cfg, model, params, conds = build(
+        args.preset, args.sidelength, args.steps,
+        extra_overrides=[("model.num_res_blocks", 1),
+                         ("model.attn_resolutions", [8])])
+    registry_dir = os.path.join(base, "registry")
+    store = RegistryStore(registry_dir)
+    v1 = store.publish_params(params, step=1, ema=False,
+                              channel="stable", notes="fleet v1").version
+
+    n = args.fleet_replicas
+    names = [f"r{i}" for i in range(n)]
+    procs = {}
+    handles = []
+    try:
+        # r0 first: its first request compiles the (bucket=1) program
+        # into the shared persistent cache; r1..rN then spawn into a
+        # warm cache instead of compiling 4x concurrently on one core.
+        procs[names[0]] = _spawn_replica(names[0], base, registry_dir,
+                                         args, jax_cache)
+        ready = _await_ready(os.path.join(base, f"{names[0]}.ready"),
+                             args.fleet_spawn_timeout_s)
+        handles.append(HttpReplica(
+            names[0], ready["url"],
+            run_dir=os.path.join(base, f"replica_{names[0]}")))
+        handles[0].submit(conds[0], seed=1, sample_steps=args.steps,
+                          trace_id="warm-r0").result(timeout=600)
+        for name in names[1:]:
+            procs[name] = _spawn_replica(name, base, registry_dir, args,
+                                         jax_cache)
+        for name in names[1:]:
+            ready = _await_ready(os.path.join(base, f"{name}.ready"),
+                                 args.fleet_spawn_timeout_s)
+            handles.append(HttpReplica(
+                name, ready["url"],
+                run_dir=os.path.join(base, f"replica_{name}")))
+        warm = [(h, h.submit(conds[0], seed=2, sample_steps=args.steps,
+                             trace_id=f"warm-{h.name}"))
+                for h in handles[1:]]
+        for _, t in warm:
+            t.result(timeout=600)
+
+        router_dir = os.path.join(base, "router")
+        telemetry = obs.RunTelemetry.create(
+            get_preset(args.preset).obs, router_dir, start_server=False)
+        rcfg = RouterConfig(health_poll_s=0.25, health_ttl_s=5.0,
+                            retry_budget=3,
+                            deploy_drain_timeout_s=60.0,
+                            deploy_probation_s=4.0,
+                            deploy_swap_timeout_s=60.0)
+        router = FleetRouter(handles, rcfg=rcfg,
+                             tracer=telemetry.tracer, bus=telemetry.bus,
+                             start=True)
+        router.poll_health()
+
+        # -- scaling lane -------------------------------------------
+        for name in names[1:]:
+            router.quiesce(name)
+        n1 = _fleet_closed_loop(router, conds, args.fleet_requests,
+                                args.fleet_concurrency, args.steps,
+                                1000, "scale1")
+        for name in names[1:]:
+            router.readmit(name)
+        router.poll_health()
+        nN = _fleet_closed_loop(router, conds, args.fleet_requests * n,
+                                args.fleet_concurrency, args.steps,
+                                2000, "scaleN")
+        scaling = {
+            "replicas": n,
+            "step_floor_ms": args.fleet_floor_ms,
+            "n1": n1, "nN": nN,
+            "scaling_x": round(nN["rps"] / max(n1["rps"], 1e-9), 3),
+        }
+
+        # -- chaos lane ---------------------------------------------
+        tcond = {k: conds[0][k] for k in ("x", "R1", "t1", "K")}
+        poses = orbit_poses(
+            args.fleet_frames,
+            radius=float(np.linalg.norm(conds[0]["t1"])) or 1.0,
+            elevation=0.3)
+        orbit_out = {}
+
+        def orbit_client():
+            try:
+                frames = router.request_trajectory(
+                    tcond, poses, seed=7, sample_steps=args.steps,
+                    session="chaos-orbit", trace_id="chaos-orbit",
+                    timeout_s=600.0)
+                orbit_out["frames"] = int(frames.shape[0])
+            except Exception as e:
+                orbit_out["error"] = f"{type(e).__name__}: {e}"
+
+        ot = threading.Thread(target=orbit_client, daemon=True)
+        ot.start()
+        deadline = time.time() + 15
+        while (time.time() < deadline
+               and "chaos-orbit" not in router._affinity):
+            time.sleep(0.02)
+        victim = router._affinity.get("chaos-orbit", names[-1])
+        # Let the orbit get properly mid-flight on the victim's ring,
+        # then kill -9: no drain, no goodbye — the transport must
+        # surface ReplicaUnreachable and the router must fail over.
+        time.sleep(3.0 * args.fleet_floor_ms / 1000.0)
+        procs[victim].kill()
+        single = _fleet_closed_loop(
+            router, conds, args.fleet_requests * 2,
+            args.fleet_concurrency, args.steps, 3000, "chaos")
+        ot.join(timeout=600)
+        procs[victim].wait(timeout=30)
+        survivors = [name for name in names if name != victim]
+        chaos = {
+            "victim": victim,
+            "orbit": orbit_out,
+            "single": single,
+            "failed": len(single["errors"])
+            + (0 if "frames" in orbit_out else 1),
+        }
+
+        # -- rolling-deploy lane ------------------------------------
+        canary = sorted(survivors)[0]
+        canary_h = next(h for h in handles if h.name == canary)
+        bg_stop = threading.Event()
+        bg = {"ok": 0, "errors": []}
+
+        def bg_load(lane: int):
+            i = 0
+            while not bg_stop.is_set():
+                tid = f"deploy-bg{lane}-{i}"  # unique per lane thread
+                try:
+                    router.request(conds[i % len(conds)],
+                                   seed=50_000 + 1000 * lane + i,
+                                   sample_steps=args.steps,
+                                   trace_id=tid)
+                    bg["ok"] += 1
+                except Exception as e:
+                    bg["errors"].append(
+                        f"{tid}: {type(e).__name__}: {e}")
+                i += 1
+
+        bg_threads = [threading.Thread(target=bg_load, args=(lane,),
+                                       daemon=True)
+                      for lane in range(2)]
+        for t in bg_threads:
+            t.start()
+
+        v2 = store.publish_params(params, step=2, ema=False,
+                                  channel=None, notes="fleet v2").version
+        good = rolling_deploy(router, store, "stable", v2, rcfg=rcfg,
+                              bus=telemetry.bus, replicas=survivors)
+
+        # Corrupt artifact: published clean, then its payload bytes are
+        # torn on disk — verify() fails on the canary, the swap breaker
+        # opens, and the deploy must roll the whole fleet back.
+        v3 = store.publish_params(params, step=3, ema=False,
+                                  channel=None, notes="fleet v3").version
+        payload = os.path.join(registry_dir, "versions", v3,
+                               "params.msgpack")
+        with open(payload, "r+b") as fh:
+            fh.seek(100)
+            fh.write(b"\xde\xad\xbe\xef")
+        breaker_roll = rolling_deploy(router, store, "stable", v3,
+                                      rcfg=rcfg, bus=telemetry.bus,
+                                      replicas=survivors)
+        # The rollback's poke clears the canary's breaker on the
+        # watcher THREAD; wait until the whole fleet reads closed so
+        # the next deploy's pre-gate doesn't race it.
+        settle = time.time() + 30
+        while time.time() < settle:
+            if all(h.healthz().get("breaker") == "closed"
+                   for h in handles if h.name in survivors):
+                break
+            time.sleep(0.1)
+
+        # SLO-gated rollback: v4 is GOOD bytes, but the canary takes a
+        # burst of deadline-doomed requests during probation (fired
+        # straight at the canary, bypassing the router — intentional
+        # chaos inputs, excluded from the zero-failure accounting);
+        # the DeadlineExceeded errors burn its fast window past
+        # deploy_burn_max and the gate must revert the fleet.
+        v4 = store.publish_params(params, step=4, ema=False,
+                                  channel=None, notes="fleet v4").version
+        burst_done = threading.Event()
+
+        def doomed_burst():
+            deadline = time.time() + 60
+            while time.time() < deadline and not burst_done.is_set():
+                try:
+                    if canary_h.healthz().get("model_version") == v4:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.05)
+            tickets = []
+            for i in range(12):
+                try:
+                    tickets.append(canary_h.submit(
+                        conds[i % len(conds)], seed=90_000 + i,
+                        sample_steps=args.steps, deadline_ms=1.0,
+                        trace_id=f"doomed-{i}"))
+                except Exception:
+                    pass
+            for t in tickets:
+                try:
+                    t.result(timeout=120)
+                except Exception:
+                    pass  # expected: DeadlineExceeded burns the canary
+
+        bt = threading.Thread(target=doomed_burst, daemon=True)
+        bt.start()
+        slo_roll = rolling_deploy(router, store, "stable", v4,
+                                  rcfg=rcfg, bus=telemetry.bus,
+                                  replicas=survivors)
+        burst_done.set()
+        bt.join(timeout=120)
+
+        bg_stop.set()
+        for t in bg_threads:
+            t.join(timeout=600)
+        final_versions = {}
+        for name in survivors:
+            try:
+                final_versions[name] = next(
+                    h for h in handles
+                    if h.name == name).healthz().get("model_version")
+            except Exception:
+                final_versions[name] = None
+        deploy = {
+            "v1": v1, "v2": v2, "v3_corrupt": v3, "v4_doomed": v4,
+            "good": good, "breaker_rollback": breaker_roll,
+            "slo_rollback": slo_roll,
+            "bg_ok": bg["ok"], "bg_errors": bg["errors"],
+            "final_versions": final_versions,
+        }
+
+        # -- fleet trace reconstruction -----------------------------
+        router.close()
+        telemetry.finalize()
+        per_source = reqtrace.load_fleet_rows(base)
+        fleet_tl = reqtrace.reconstruct_fleet(per_source)
+        problems = reqtrace.verify_fleet(fleet_tl, per_source)
+        chaos_hops = [
+            h for tid, tl in fleet_tl.items() if tid.startswith("chaos")
+            for h in tl["hops"] if h.get("outcome") == "failover"]
+        chaos["failovers"] = len(chaos_hops)
+        chaos["blast_ok"] = bool(chaos_hops) and all(
+            h.get("replica") == victim for h in chaos_hops)
+        trace = {
+            "sources": sorted(per_source),
+            "timelines": len(fleet_tl),
+            "problems": problems[:10],
+            "problem_count": len(problems),
+        }
+        return {"scaling": scaling, "chaos": chaos, "deploy": deploy,
+                "trace": trace, "fleet_dir": base}
+    finally:
+        import signal as _signal
+
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGTERM)
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=120)
+            except Exception:
+                proc.kill()
+
+
+def check_fleet(fleet: dict) -> int:
+    """rc=1 on any violated --fleet contract (stderr)."""
+    rc = 0
+    scaling = fleet["scaling"]
+    if scaling["scaling_x"] < 3.2:
+        print(f"error: fleet scaling {scaling['scaling_x']}x at "
+              f"N={scaling['replicas']} is below the 3.2x floor — the "
+              "router is serializing replicas it should overlap "
+              f"(N=1 {scaling['n1']['rps']} rps, "
+              f"N={scaling['replicas']} {scaling['nN']['rps']} rps)",
+              file=sys.stderr)
+        rc = 1
+    for lane in ("n1", "nN"):
+        if scaling[lane]["errors"]:
+            print(f"error: scaling lane {lane} failed requests: "
+                  f"{scaling[lane]['errors'][:3]}", file=sys.stderr)
+            rc = 1
+    chaos = fleet["chaos"]
+    if chaos["failed"]:
+        print(f"error: chaos lane lost {chaos['failed']} request(s) to "
+              f"a single replica kill (orbit={chaos['orbit']}, "
+              f"single errors={chaos['single']['errors'][:3]}) — "
+              "failover must be transparent", file=sys.stderr)
+        rc = 1
+    if chaos["failovers"] < 1:
+        print("error: chaos lane recorded no failover hops — the kill "
+              "landed after all traffic drained, the drill proved "
+              "nothing", file=sys.stderr)
+        rc = 1
+    if not chaos["blast_ok"]:
+        print(f"error: a failover hop names a replica other than the "
+              f"victim {chaos['victim']} — blast radius exceeded the "
+              "killed replica", file=sys.stderr)
+        rc = 1
+    deploy = fleet["deploy"]
+    if deploy["good"]["status"] != "deployed":
+        print(f"error: good rolling deploy did not complete: "
+              f"{deploy['good']}", file=sys.stderr)
+        rc = 1
+    if deploy["breaker_rollback"]["status"] != "rolled_back":
+        print(f"error: corrupt-artifact deploy was not rolled back: "
+              f"{deploy['breaker_rollback']}", file=sys.stderr)
+        rc = 1
+    if deploy["slo_rollback"]["status"] != "rolled_back":
+        print(f"error: SLO-burned canary deploy was not rolled back: "
+              f"{deploy['slo_rollback']}", file=sys.stderr)
+        rc = 1
+    if deploy["bg_errors"]:
+        print(f"error: {len(deploy['bg_errors'])} request(s) failed "
+              "during the rolling deploys — zero-downtime violated: "
+              f"{deploy['bg_errors'][:3]}", file=sys.stderr)
+        rc = 1
+    want = deploy["v2"]
+    wrong = {k: v for k, v in deploy["final_versions"].items()
+             if v != want}
+    if wrong:
+        print(f"error: fleet did not converge on {want} after the "
+              f"rollbacks: {wrong}", file=sys.stderr)
+        rc = 1
+    if fleet["trace"]["problem_count"]:
+        print(f"error: {fleet['trace']['problem_count']} fleet trace "
+              "reconstruction problem(s): "
+              f"{fleet['trace']['problems'][:5]}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="tiny64")
@@ -1693,6 +2173,39 @@ def main() -> int:
                     help="ring capacity for --chaos (also the worker-"
                          "death blast-radius bound the check asserts)")
     ap.add_argument("--chaos-seed", type=int, default=0)
+    ap.add_argument("--fleet", action="store_true",
+                    help="judged fleet-serving scenario: N replica "
+                         "PROCESSES behind the FleetRouter — scaling "
+                         "(>= 3.2x RPS at N=4 vs N=1 over step-floor-"
+                         "paced replicas), chaos (SIGKILL the replica "
+                         "holding a mid-flight orbit, zero failed "
+                         "requests, blast radius = the victim), and "
+                         "three scripted rolling deploys (good / "
+                         "corrupt-artifact breaker rollback / SLO-"
+                         "burned canary rollback) under live load, "
+                         "plus a cross-replica trace reconstruction "
+                         "audit (rc=1 on any violation)")
+    ap.add_argument("--fleet-replicas", type=int, default=4,
+                    help="replica process count for --fleet")
+    ap.add_argument("--fleet-requests", type=int, default=12,
+                    help="closed-loop requests PER REPLICA-EQUIVALENT "
+                         "in the scaling lane (N=1 runs this many, "
+                         "N=k runs k times as many)")
+    ap.add_argument("--fleet-concurrency", type=int, default=8,
+                    help="closed-loop client threads through the router")
+    ap.add_argument("--fleet-floor-ms", type=float, default=200.0,
+                    help="serve.step_floor_ms per replica: the paced "
+                         "device-time floor that makes 1-host fleet "
+                         "scaling honest (must exceed N x the tiny "
+                         "model's actual CPU step so replicas overlap "
+                         "in their sleep windows)")
+    ap.add_argument("--fleet-frames", type=int, default=6,
+                    help="orbit length for the chaos-lane trajectory")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="fleet scratch dir (default "
+                         "/tmp/nvs3d_fleet_bench; wiped on start)")
+    ap.add_argument("--fleet-spawn-timeout-s", type=float, default=300.0,
+                    help="per-replica ready-file timeout")
     ap.add_argument("--reqtrace", action="store_true",
                     help="judged request-tracing scenario: one mixed "
                          "single-shot + trajectory trace replayed with "
@@ -1727,6 +2240,26 @@ def main() -> int:
 
     from novel_view_synthesis_3d_tpu.config import ServeConfig
     from novel_view_synthesis_3d_tpu.sample.service import SamplingService
+
+    if args.fleet:
+        # Its own light-backbone build happens inside (the parent only
+        # supplies conds + the published v1 params; the replicas are
+        # separate processes with their own JAX runtimes).
+        fleet = fleet_bench(args)
+        result = {
+            "metric": f"serve_fleet_rps_{args.preset}",
+            "value": fleet["scaling"]["nN"]["rps"],
+            "unit": "req/s",
+            "vs_baseline": fleet["scaling"]["scaling_x"],
+            "baseline_value": fleet["scaling"]["n1"]["rps"],
+            "baseline": ("same router, same closed-loop clients, one "
+                         "replica in rotation (quiesced fleet)"),
+            "sidelength": args.sidelength,
+            "fleet": fleet,
+            "platform": jax.default_backend(),
+        }
+        print(json.dumps(result))
+        return check_fleet(fleet)
 
     cfg, model, params, conds = build(args.preset, args.sidelength,
                                       args.steps)
