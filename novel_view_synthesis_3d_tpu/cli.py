@@ -1520,7 +1520,8 @@ def cmd_route(args, overrides: List[str]) -> int:
     if not handles:
         raise SystemExit("no replicas: pass --replica name=URL "
                          "(repeatable)")
-    router = FleetRouter(handles, rcfg=cfg.router)
+    journal = getattr(args, "journal", None)
+    router = FleetRouter(handles, rcfg=cfg.router, journal=journal)
     sub = args.route_command
 
     if sub == "status":
@@ -1529,6 +1530,18 @@ def cmd_route(args, overrides: List[str]) -> int:
         snap["slo"] = router.fleet_slo()
         print(json.dumps(snap, indent=None if args.json else 2,
                          sort_keys=True))
+        rec = snap.get("recovery")
+        if rec:
+            rc = rec.get("recovered_steps") or {}
+            print(f"# journal {rec['journal']}: {rec['records']} "
+                  f"record(s), {rec['pins_restored']} override pin(s) "
+                  f"restored, {sum(rc.values())} pre-poll step(s) over "
+                  f"{len(rc)} replica(s), "
+                  f"{len(rec.get('reconciled') or {})} reconciled "
+                  f"against live /healthz"
+                  + (f", {rec['torn']} torn line(s)"
+                     if rec.get("torn") else ""),
+                  file=sys.stderr)
         return 0 if snap["healthy"] == snap["total"] else 1
 
     if sub == "deploy":
@@ -1918,6 +1931,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "names r0, r1, ...")
     q.add_argument("--json", action="store_true",
                    help="single-line JSON (default: indented)")
+    q.add_argument("--journal", default=None, metavar="PATH",
+                   help="router journal to replay first: the snapshot "
+                        "then carries the crash-restart reconstruction "
+                        "provenance (records replayed, pins restored, "
+                        "ledger steps reconciled against live /healthz)")
     q = route_sub.add_parser(
         "deploy",
         help="rolling deploy: move the registry channel, then per "

@@ -636,6 +636,53 @@ class RouterConfig:
     # Budget for a poked replica to report the target model_version
     # before the deploy declares the swap failed and rolls back.
     deploy_swap_timeout_s: float = 30.0
+    # --- self-healing fleet (docs/DESIGN.md "Fleet survivability") ---
+    # Virtual nodes per replica on the consistent-hash affinity ring.
+    # More vnodes = smoother key spread; the ring is rebuilt only when
+    # the replica SET changes, so this is a startup cost.
+    affinity_vnodes: int = 64
+    # Hedged dispatch for stateless singles: if the first replica has
+    # not answered after this long, a second copy goes to the next
+    # replica on the ring; first response wins, the loser is abandoned
+    # (recorded as a `router_hedge` span). 0 disables hedging.
+    # Trajectories never hedge — their frame bank is single-homed.
+    hedge_delay_s: float = 0.0
+    # Per-hop timeout budget (seconds): one replica attempt may consume
+    # at most this much of the request's total timeout before the
+    # router abandons the hop and fails over — a wedged replica can
+    # never eat the whole client deadline. 0 = no per-hop bound (the
+    # request timeout is the only clock).
+    hop_timeout_s: float = 0.0
+    # Gray-failure demotion: a replica whose polled latency_p99_s is
+    # >= this factor x the fleet's BEST fresh p99 is demoted — it only
+    # receives dispatches when no un-demoted replica is eligible.
+    # 0 disables (PR 16 behavior).
+    demote_p99_factor: float = 0.0
+    # Router journal (serve/journal.py): a full outstanding-steps
+    # snapshot row is appended every N hop records so replay cost stays
+    # bounded. The journal itself is enabled by passing journal= to
+    # FleetRouter (or `journal` in the router_main spec).
+    journal_snapshot_every: int = 32
+    # --- fleet supervisor (serve/fleet_supervisor.py) ---
+    # Restart budget PER SLOT; exhaustion marks the slot failed loudly
+    # (replica_giveup event) instead of flapping forever.
+    supervisor_max_restarts: int = 3
+    # Exponential restart backoff base / cap (PR 2 discipline:
+    # min(cap, backoff_s * 2**(restarts-1))).
+    supervisor_backoff_s: float = 1.0
+    supervisor_backoff_cap_s: float = 60.0
+    # A replica whose ready-file heartbeat is older than this is WEDGED
+    # (the process is alive but its event loop stopped beating).
+    supervisor_heartbeat_max_age_s: float = 15.0
+    # Consecutive /healthz failures before a live process is declared
+    # wedged (transient poll misses must not trigger a restart).
+    supervisor_health_fails: int = 3
+    # Supervisor monitor-loop period (seconds).
+    supervisor_poll_s: float = 1.0
+    # Budget for a restarted replica to write its ready file AND answer
+    # /healthz with the expected version before the resurrection is
+    # declared failed (burning one restart from the budget).
+    supervisor_ready_timeout_s: float = 300.0
 
 
 @dataclasses.dataclass(frozen=True)

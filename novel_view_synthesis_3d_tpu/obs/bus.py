@@ -195,7 +195,11 @@ class EventBus:
 
     # -- telemetry.jsonl -----------------------------------------------
     def jsonl_row(self, obj: dict) -> None:
-        row = dict(obj, t=round(time.time(), 3))
+        # pid scopes process-local ids (request_id, dispatch ordinals)
+        # when a supervised respawn APPENDS to its predecessor's file:
+        # reconstruction must never join incarnation A's dispatch rows
+        # into incarnation B's request of the same recycled id.
+        row = dict(obj, t=round(time.time(), 3), pid=os.getpid())
         if self.tap is not None:
             try:
                 self.tap(row)

@@ -111,13 +111,24 @@ def _riders_of(row: dict) -> List[int]:
     return out
 
 
+def _id_scope(row: dict):
+    """Request ids and dispatch ordinals are PROCESS-local counters. A
+    supervised respawn (serve/fleet_supervisor.py) appends to its dead
+    predecessor's telemetry.jsonl, so one file can hold two incarnations
+    both counting from zero — rider joins must stay within the writing
+    process. Rows predating the pid stamp share one scope (None), which
+    is exactly the old behavior."""
+    return row.get("pid")
+
+
 def reconstruct(rows: List[dict]) -> Dict[str, dict]:
     """telemetry rows → {trace_id: timeline}. A timeline is complete
     when both its root (``request_submit``) and its ``request_respond``
     landed; dispatch rows attach to every rider's timeline with the
-    co-rider count observed on that dispatch."""
+    co-rider count observed on that dispatch. Rider joins are scoped
+    per writing process (see ``_id_scope``)."""
     timelines: Dict[str, dict] = {}
-    by_request: Dict[int, str] = {}
+    by_request: Dict[tuple, str] = {}
     spans = [r for r in rows if r.get("kind") == "span"]
     for row in spans:
         if row.get("name") != "request_submit":
@@ -129,6 +140,7 @@ def reconstruct(rows: List[dict]) -> Dict[str, dict]:
         timelines[tid] = {
             "trace_id": tid,
             "request_id": rid,
+            "id_scope": _id_scope(row),
             "req_kind": row.get("req_kind", "single"),
             "steps": row.get("steps"),
             "frames": row.get("frames"),
@@ -138,7 +150,7 @@ def reconstruct(rows: List[dict]) -> Dict[str, dict]:
             "dispatches": [],
             "respond": None,
         }
-        by_request[rid] = tid
+        by_request[(_id_scope(row), rid)] = tid
     for row in spans:
         name = row.get("name")
         tid = str(row.get("trace_id", ""))
@@ -149,7 +161,7 @@ def reconstruct(rows: List[dict]) -> Dict[str, dict]:
         elif name in DISPATCH_SPAN_NAMES and "riders" in row:
             riders = _riders_of(row)
             for rid in riders:
-                tid = by_request.get(rid)
+                tid = by_request.get((_id_scope(row), rid))
                 if tid is None:
                     continue
                 timelines[tid]["dispatches"].append({
@@ -186,14 +198,15 @@ def verify_timelines(timelines: Dict[str, dict],
       - every rider named on a dispatch row maps to a known submit.
     """
     problems: List[str] = []
-    known = {tl["request_id"] for tl in timelines.values()}
+    known = {(tl.get("id_scope"), tl["request_id"])
+             for tl in timelines.values()}
     for row in rows:
         if row.get("kind") != "span" or "riders" not in row:
             continue
         if row.get("name") not in DISPATCH_SPAN_NAMES:
             continue
         for rid in _riders_of(row):
-            if rid not in known:
+            if (_id_scope(row), rid) not in known:
                 problems.append(
                     f"dispatch {row.get('dispatch')} names rider "
                     f"{rid} with no request_submit root")
@@ -309,7 +322,8 @@ def export_perfetto(tl: dict, path: str) -> str:
 # every hop: reconstruct each replica's telemetry independently, then
 # join replica timelines onto the router's hop records by trace_id.
 # ---------------------------------------------------------------------------
-ROUTER_SPAN_NAMES = ("router_submit", "router_hop", "router_respond")
+ROUTER_SPAN_NAMES = ("router_submit", "router_hop", "router_hedge",
+                     "router_respond")
 
 
 def load_fleet_rows(fleet_dir: str) -> Dict[str, List[dict]]:
@@ -358,6 +372,7 @@ def reconstruct_fleet(per_source: Dict[str, List[dict]]
                 "session": row.get("session"),
                 "submit_t": row.get("t"),
                 "hops": [],
+                "hedges": [],
                 "respond": None,
                 "replica_timelines": {},
             }
@@ -369,6 +384,8 @@ def reconstruct_fleet(per_source: Dict[str, List[dict]]
             continue
         if row.get("name") == "router_hop":
             fleet[tid]["hops"].append(row)
+        elif row.get("name") == "router_hedge":
+            fleet[tid]["hedges"].append(row)
         elif row.get("name") == "router_respond":
             fleet[tid]["respond"] = row
     for source, rows in per_source.items():
@@ -419,11 +436,20 @@ def verify_fleet(fleet: Dict[str, dict],
                 f"{tid}: respond says {fo} failovers, hops show "
                 f"{observed_fo}")
         if resp.get("outcome") == "ok":
-            if not hops or hops[-1].get("outcome") != "ok":
+            # Hedged dispatch means the winning hop need not be the
+            # LAST by attempt ordinal (an abandoned hedge loser's span
+            # lands after the winner's) — require one ok hop and only
+            # benign non-ok outcomes alongside it.
+            if not any(h.get("outcome") == "ok" for h in hops):
                 problems.append(
-                    f"{tid}: responded ok but final hop outcome is "
-                    f"{hops[-1].get('outcome') if hops else 'missing'}")
+                    f"{tid}: responded ok but no ok hop recorded")
+            benign = ("ok", "failover", "hop_timeout",
+                      "hedge_abandoned", "cancelled")
             for hop in hops:
+                if hop.get("outcome") not in benign:
+                    problems.append(
+                        f"{tid}: ok respond with stray hop outcome "
+                        f"{hop.get('outcome')}")
                 if hop.get("outcome") != "ok":
                     continue
                 replica = str(hop.get("replica", ""))

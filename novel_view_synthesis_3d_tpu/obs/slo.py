@@ -124,7 +124,7 @@ class SLOEngine:
         now = self._clock()
         with self._lock:
             dq = self._samples[cls]
-            dq.append((now, good))
+            dq.append((now, good, float(latency_s)))
             cutoff = now - self.slow_window_s
             while dq and dq[0][0] < cutoff:
                 dq.popleft()
@@ -137,7 +137,24 @@ class SLOEngine:
         cutoff = now - window_s
         with self._lock:
             samples = [s for s in self._samples[cls] if s[0] >= cutoff]
-        return len(samples), sum(1 for _, good in samples if not good)
+        return len(samples), sum(1 for s in samples if not s[1])
+
+    def latency_p99(self, window_s: Optional[float] = None,
+                    now: Optional[float] = None) -> float:
+        """p99 request latency (seconds) over the trailing window,
+        across ALL step classes — the polled gray-failure gauge
+        (/healthz ``latency_p99_s``) the fleet router's demotion policy
+        compares across replicas. 0.0 with no samples."""
+        now = self._clock() if now is None else now
+        window_s = self.slow_window_s if window_s is None else window_s
+        cutoff = now - window_s
+        with self._lock:
+            lats = sorted(s[2] for dq in self._samples.values()
+                          for s in dq if s[0] >= cutoff)
+        if not lats:
+            return 0.0
+        idx = max(0, -(-99 * len(lats) // 100) - 1)  # ceil(.99n) - 1
+        return float(lats[idx])
 
     def burn_rate(self, cls: int, window_s: float,
                   now: Optional[float] = None) -> float:

@@ -2253,6 +2253,10 @@ class SamplingService:
             "last_dispatch_age_s": round(
                 time.time() - self._last_dispatch_t, 3),
             "model_version": self.model_version,
+            # Program builds since boot: the fleet chaos drills assert
+            # this stays flat on SURVIVORS across kills/restarts (warm
+            # traffic never recompiles) without scraping Prometheus.
+            "programs_built": int(self._programs.builds),
         }
         if self.slo is not None:
             slo_snap = self.slo.snapshot()
@@ -2260,6 +2264,9 @@ class SamplingService:
             snap["slo_fast_burn"] = round(max(burns), 3) if burns else 0.0
             snap["slo_breached"] = any(
                 c.get("breached") for c in slo_snap.values())
+            # Gray-failure gauge: the fleet router demotes a replica
+            # whose p99 drifts far above its peers' (slow-but-alive).
+            snap["latency_p99_s"] = round(self.slo.latency_p99(), 6)
         return snap
 
     def _cache_key(self, bucket: int, H: int, W: int, steps: int,

@@ -1,23 +1,33 @@
-"""Fleet-scale serving (docs/DESIGN.md "Fleet serving").
+"""Fleet-scale serving (docs/DESIGN.md "Fleet serving" +
+"Fleet survivability").
 
 A thin routing layer over N independent `SamplingService` replicas —
 the Pathways/disaggregated-serving shape (PAPERS.md): each replica is
 one process with its own mesh, registry watcher, and telemetry dir;
 the router holds NO model state, only health snapshots, an
-outstanding-work ledger, and the orbit-session affinity table.
+outstanding-work ledger, and journaled affinity overrides (the pins
+themselves derive from a consistent-hash ring, so a restarted router
+reconstructs them from nothing).
 
   - `serve/replica.py`  — the replica boundary: LocalReplica (in-
     process, tests), HttpReplica + ReplicaServer (subprocess fleet),
     and the structured-error wire format that carries PR 11's
     retryable-reject contract across the process boundary.
   - `serve/router.py`   — FleetRouter: least-step-debt dispatch,
-    session affinity, transparent failover with per-request retry
-    budgets, fleet metrics/SLO aggregation.
+    consistent-hash session affinity, transparent failover with
+    per-request retry budgets + per-hop timeouts, hedged dispatch and
+    gray-failure demotion, fleet metrics/SLO aggregation.
+  - `serve/journal.py`  — append-only router journal: crash-safe
+    replay of the outstanding ledger + affinity overrides, reconciled
+    against live /healthz after a router restart.
+  - `serve/fleet_supervisor.py` — FleetSupervisor: replica process
+    resurrection with PR 2 backoff discipline (dead / stale-heartbeat
+    / probe-failure detectors, same-port respawn, loud giveup).
   - `serve/deploy.py`   — registry-channel rolling deploys with the
     SLO-burn + swap-breaker gate and auto-rollback
     (`nvs3d route deploy`).
-  - `serve/replica_main.py` — subprocess entrypoint
-    (`python -m novel_view_synthesis_3d_tpu.serve.replica_main`).
+  - `serve/replica_main.py` / `serve/router_main.py` — subprocess
+    entrypoints (`python -m novel_view_synthesis_3d_tpu.serve.…`).
 """
 
 from novel_view_synthesis_3d_tpu.serve.replica import (  # noqa: F401
@@ -30,7 +40,16 @@ from novel_view_synthesis_3d_tpu.serve.replica import (  # noqa: F401
 from novel_view_synthesis_3d_tpu.serve.router import (  # noqa: F401
     FleetRouter,
     FleetSaturated,
+    HashRing,
+    HopTimeout,
     NoReplicaAvailable,
+)
+from novel_view_synthesis_3d_tpu.serve.journal import (  # noqa: F401
+    RouterJournal,
+)
+from novel_view_synthesis_3d_tpu.serve.fleet_supervisor import (  # noqa: F401,E501
+    FleetSupervisor,
+    ReplicaSpec,
 )
 from novel_view_synthesis_3d_tpu.serve.deploy import (  # noqa: F401
     rolling_deploy,

@@ -131,18 +131,30 @@ def rolling_deploy(router, store, channel: str, target_version: str, *,
             restored = previous
             if previous is not None:
                 store.set_channel(channel, previous)
+        unrestored: List[str] = []
         for name in names:
+            # A replica may have DIED between its gate and this
+            # rollback (the crash-racing-deploy case): restoring the
+            # survivors must not be aborted by the corpse — the
+            # supervisor resurrects it onto the restored channel head,
+            # so skipping it here still converges the fleet.
             try:
                 router.quiesce(name)
-                router._states[name].handle.poke()
-                if restored is not None:
-                    _await_version(router, name, restored,
-                                   rcfg.deploy_swap_timeout_s,
-                                   sleep, clock)
-            finally:
-                router.readmit(name)
+                try:
+                    router._states[name].handle.poke()
+                    if restored is not None:
+                        _await_version(router, name, restored,
+                                       rcfg.deploy_swap_timeout_s,
+                                       sleep, clock)
+                finally:
+                    router.readmit(name)
+            except Exception as e:
+                unrestored.append(name)
+                event("deploy_rollback_skip",
+                      f"replica {name} unreachable during rollback "
+                      f"({e}) — supervisor/resurrection owns it")
         report.update(status="rolled_back", reason=reason,
-                      restored=restored)
+                      restored=restored, unrestored=unrestored)
         event("deploy_done",
               f"rolled back to {restored or '<unset>'}: {reason}")
         return report
@@ -177,6 +189,12 @@ def rolling_deploy(router, store, channel: str, target_version: str, *,
                     f"{target_version} (breaker "
                     f"{snap.get('breaker', '?')})")
             swapped.append(name)
+        except Exception as e:
+            # The replica DIED under us mid-step (poke/healthz raised):
+            # that is a per-replica gate failure, not a deploy crash —
+            # the whole-fleet rollback below is the contract.
+            step.update(outcome="died", detail=repr(e))
+            return rollback(f"replica {name} died mid-deploy: {e}")
         finally:
             if step["outcome"] == "ok":
                 router.readmit(name)

@@ -1,0 +1,166 @@
+"""Append-only router journal: crash-safe dispatch state.
+
+The consistent-hash ring makes affinity pins derivable with zero
+recovered state, but two pieces of router state are NOT derivable:
+
+  - the outstanding-steps ledger (dispatch pressure the router added
+    between health polls — a restarted router that forgets it starts
+    blind and double-loads the busiest replica until the first poll);
+  - affinity OVERRIDES (an orbit that migrated off its ring home on
+    failover now has its frame bank on the override replica — the ring
+    alone would send its next segment back to the resurrected home).
+
+Both are tiny and append-friendly, so the journal is a JSONL file:
+one object per line, flushed per record, torn tails tolerated on
+replay (a SIGKILL mid-write must not poison the restart). Record
+kinds:
+
+    hop       {t, tid, replica, w}          steps dispatched
+    hop_done  {t, tid, replica, w, outcome} steps resolved
+    orbit     {t, tid, session, frames, steps}  admitted orbit
+    pin       {t, session, replica, home}   affinity override created
+    unpin     {t, session}                  override dropped
+    snap      {t, outstanding: {replica: steps}}  ledger checkpoint
+
+Replay folds records newest-snapshot-forward into {outstanding, pins,
+orbits} plus provenance counters. The RESTARTED router treats replayed
+outstanding as a pre-poll prior only: the first successful /healthz
+poll of a replica supersedes it (the replica's own step_debt gauge is
+authoritative — work the dead router had in flight either finished or
+is counted there), which is the reconcile-against-live-healthz step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+
+class RouterJournal:
+    """Append-only JSONL writer + replayer for FleetRouter state.
+
+    Thread-safe; every append is flushed (the contract is crash-safe
+    REPLAY, not zero-loss — a torn final line loses one hop record,
+    which reconciliation against /healthz absorbs)."""
+
+    def __init__(self, path: str, *, snapshot_every: int = 32,
+                 clock=time.time):
+        self.path = str(path)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._since_snap = 0
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    # -- writer surface ------------------------------------------------
+    def _append(self, rec: dict) -> None:
+        rec["t"] = round(self._clock(), 3)
+        line = json.dumps(rec, sort_keys=True)
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def hop(self, tid: str, replica: str, weight: int) -> None:
+        self._append({"k": "hop", "tid": tid, "replica": replica,
+                      "w": int(weight)})
+        self._since_snap += 1
+
+    def hop_done(self, tid: str, replica: str, weight: int,
+                 outcome: str) -> None:
+        self._append({"k": "hop_done", "tid": tid, "replica": replica,
+                      "w": int(weight), "outcome": outcome})
+
+    def orbit(self, tid: str, session: str, frames: int,
+              steps: int) -> None:
+        self._append({"k": "orbit", "tid": tid, "session": session,
+                      "frames": int(frames), "steps": int(steps)})
+
+    def pin(self, session: str, replica: str, home: str) -> None:
+        self._append({"k": "pin", "session": session,
+                      "replica": replica, "home": home})
+
+    def unpin(self, session: str) -> None:
+        self._append({"k": "unpin", "session": session})
+
+    def maybe_snapshot(self, outstanding: Dict[str, int]) -> None:
+        """Checkpoint the ledger every `snapshot_every` hop records so
+        replay folds from the newest snapshot, not file start."""
+        if self._since_snap < self.snapshot_every:
+            return
+        self._since_snap = 0
+        self._append({"k": "snap",
+                      "outstanding": {k: int(v)
+                                      for k, v in outstanding.items()
+                                      if v}})
+
+    def close(self) -> None:
+        with self._lock:
+            fh, self._fh = self._fh, None
+        if fh is not None:
+            fh.close()
+
+
+def replay(path: str) -> Optional[dict]:
+    """Fold a journal back into router state. None when the file does
+    not exist (fresh start — no provenance to report).
+
+    Returns {"outstanding": {replica: steps}, "pins": {session:
+    replica}, "orbits": {session: record}, "records": n, "torn": n,
+    "path": path} — `outstanding` is the unresolved-hop ledger from the
+    newest snapshot forward; `pins` the surviving affinity overrides.
+    """
+    if not os.path.exists(path):
+        return None
+    records = []
+    torn = 0
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                torn += 1  # SIGKILL mid-write: skip, keep folding
+    # Fold from the newest ledger snapshot forward; pins/orbits fold
+    # over the WHOLE file (they are idempotent last-writer-wins).
+    last_snap = None
+    for i, rec in enumerate(records):
+        if rec.get("k") == "snap":
+            last_snap = i
+    outstanding: Dict[str, int] = {}
+    start = 0
+    if last_snap is not None:
+        outstanding.update({str(k): int(v) for k, v in
+                            (records[last_snap].get("outstanding")
+                             or {}).items()})
+        start = last_snap + 1
+    for rec in records[start:]:
+        kind = rec.get("k")
+        if kind == "hop":
+            outstanding[rec["replica"]] = (
+                outstanding.get(rec["replica"], 0) + int(rec["w"]))
+        elif kind == "hop_done":
+            outstanding[rec["replica"]] = (
+                outstanding.get(rec["replica"], 0) - int(rec["w"]))
+    outstanding = {k: v for k, v in outstanding.items() if v > 0}
+    pins: Dict[str, str] = {}
+    orbits: Dict[str, dict] = {}
+    for rec in records:
+        kind = rec.get("k")
+        if kind == "pin":
+            pins[rec["session"]] = rec["replica"]
+        elif kind == "unpin":
+            pins.pop(rec["session"], None)
+        elif kind == "orbit":
+            orbits[rec["session"]] = rec
+    return {"outstanding": outstanding, "pins": pins, "orbits": orbits,
+            "records": len(records), "torn": torn, "path": path}

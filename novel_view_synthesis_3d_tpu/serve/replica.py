@@ -30,12 +30,12 @@ never entered a queue, so resubmitting elsewhere cannot double-serve.
 from __future__ import annotations
 
 import base64
+import http.client
 import io
 import json
 import socket
 import threading
-import urllib.error
-import urllib.request
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -115,6 +115,11 @@ def wire_to_error(wire: dict) -> ServeError:
         return Rejected(
             msg, retryable=bool(wire.get("retryable", False)),
             retry_after_s=float(wire.get("retry_after_s", 0.0)))
+    if etype == "ReplicaUnreachable":
+        # A replica that answers "I am closed" over a still-warm
+        # keepalive socket is dead for routing purposes — same class
+        # as a connection that never opened.
+        return ReplicaUnreachable(msg)
     err = ServeError(msg or f"replica error ({etype})")
     err.retryable = bool(wire.get("retryable", False))
     err.retry_after_s = float(wire.get("retry_after_s", 0.0))
@@ -225,8 +230,7 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
             try:
                 self._json(200, core.healthz())
             except Exception as e:
-                self._json(500, {"error": {"type": "ServeError",
-                                           "message": repr(e)}})
+                self._json(500, {"error": error_to_wire(e)})
         elif self.path.startswith("/metrics"):
             body = core.metrics_text().encode()
             self.send_response(200)
@@ -272,8 +276,10 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
 
     def _kwargs(self, req: dict) -> dict:
         kw = {}
+        # "session" only matters when the core is a router ingress
+        # (serve/router_main.py) — a replica-bound call never sets it.
         for key in ("seed", "sample_steps", "guidance_weight",
-                    "deadline_ms", "k_max", "trace_id"):
+                    "deadline_ms", "k_max", "trace_id", "session"):
             if req.get(key) is not None:
                 kw[key] = req[key]
         if "seed" in kw:
@@ -373,37 +379,99 @@ class HttpReplica:
 
     def __init__(self, name: str, base_url: str, *, run_dir: str = "",
                  health_timeout_s: float = 3.0,
-                 submit_timeout_s: float = 600.0):
+                 submit_timeout_s: float = 600.0,
+                 connect_timeout_s: float = 3.0):
         self.name = str(name)
         self.base_url = base_url.rstrip("/")
         self.run_dir = run_dir
         self.health_timeout_s = float(health_timeout_s)
         self.submit_timeout_s = float(submit_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        parsed = urllib.parse.urlsplit(self.base_url)
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or 80
+        self._path_prefix = parsed.path.rstrip("/")
+        self._local = threading.local()  # per-thread keepalive conn
 
     # -- plumbing ------------------------------------------------------
+    def _connect(self) -> http.client.HTTPConnection:
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=self.connect_timeout_s)
+        try:
+            conn.connect()
+        except (ConnectionError, socket.timeout, TimeoutError,
+                OSError) as e:
+            conn.close()
+            raise ReplicaUnreachable(
+                f"replica {self.name} unreachable at "
+                f"{self.base_url}: {e}") from e
+        return conn
+
+    def _raw(self, method: str, path: str, body: Optional[bytes],
+             timeout_s: float):
+        """One HTTP exchange over a per-thread keepalive connection,
+        returning ``(status, body_bytes)``.
+
+        The connect and read phases run under SEPARATE timeouts: a dead
+        host must fail fast (``connect_timeout_s``, seconds) even when
+        the call is a long-poll submit whose read budget is minutes —
+        folding both into one timeout either hangs health probes on
+        SYN blackholes or truncates legitimate sampling waits.
+
+        A send/response failure on a REUSED connection is retried
+        exactly once on a fresh socket: the replica's HTTP server may
+        have closed the idle keepalive socket between calls, and that
+        reset says nothing about replica health. A FRESH connection
+        that fails is never retried here — that is real unreachability
+        and the router's failover owns it."""
+        headers = {"Content-Type": "application/json"} if body else {}
+        for fresh_retry in (False, True):
+            conn = getattr(self._local, "conn", None)
+            reused = conn is not None
+            if conn is None:
+                conn = self._connect()
+            self._local.conn = None  # never share a conn mid-flight
+            try:
+                conn.sock.settimeout(timeout_s)
+                conn.request(method, self._path_prefix + path,
+                             body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.RemoteDisconnected,
+                    ConnectionResetError, BrokenPipeError) as e:
+                conn.close()
+                if reused and not fresh_retry:
+                    continue  # stale keepalive socket: retry once fresh
+                raise ReplicaUnreachable(
+                    f"replica {self.name}: connection reset at "
+                    f"{path}: {e}") from e
+            except (http.client.HTTPException, ConnectionError,
+                    socket.timeout, TimeoutError, OSError) as e:
+                conn.close()
+                raise ReplicaUnreachable(
+                    f"replica {self.name} unreachable at "
+                    f"{self.base_url}{path}: {e}") from e
+            if resp.will_close:
+                conn.close()
+            else:
+                self._local.conn = conn
+            return resp.status, data
+        raise AssertionError("unreachable")  # pragma: no cover
+
     def _call(self, path: str, payload: Optional[dict],
               timeout_s: float) -> dict:
-        url = self.base_url + path
-        data = None if payload is None else json.dumps(payload).encode()
-        req = urllib.request.Request(
-            url, data=data,
-            headers={"Content-Type": "application/json"} if data else {},
-            method="POST" if data is not None else "GET")
+        body = None if payload is None else json.dumps(payload).encode()
+        status, data = self._raw(
+            "POST" if body is not None else "GET", path, body, timeout_s)
         try:
-            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-                return json.loads(resp.read().decode())
-        except urllib.error.HTTPError as e:
-            try:
-                wire = json.loads(e.read().decode()).get("error") or {}
-            except ValueError:
-                raise ReplicaUnreachable(
-                    f"replica {self.name}: torn response "
-                    f"(HTTP {e.code})") from e
-            raise wire_to_error(wire) from None
-        except (urllib.error.URLError, ConnectionError, socket.timeout,
-                TimeoutError, OSError) as e:
+            obj = json.loads(data.decode())
+        except ValueError:
             raise ReplicaUnreachable(
-                f"replica {self.name} unreachable at {url}: {e}") from e
+                f"replica {self.name}: torn response "
+                f"(HTTP {status})") from None
+        if status >= 400:
+            raise wire_to_error(obj.get("error") or {}) from None
+        return obj
 
     # -- handle protocol ----------------------------------------------
     def healthz(self) -> dict:
@@ -411,12 +479,13 @@ class HttpReplica:
 
     def submit(self, cond, *, seed: int = 0, sample_steps=None,
                guidance_weight=None, deadline_ms=None, trace_id=None,
-               timeout_s: Optional[float] = None):
+               session=None, timeout_s: Optional[float] = None):
         payload = {
             "cond": {k: encode_array(v) for k, v in cond.items()},
             "seed": int(seed), "sample_steps": sample_steps,
             "guidance_weight": guidance_weight,
             "deadline_ms": deadline_ms, "trace_id": trace_id,
+            "session": session,
             "timeout_s": timeout_s or self.submit_timeout_s,
         }
 
@@ -432,6 +501,7 @@ class HttpReplica:
     def submit_trajectory(self, cond, poses, *, seed: int = 0,
                           sample_steps=None, guidance_weight=None,
                           deadline_ms=None, k_max=None, trace_id=None,
+                          session=None,
                           timeout_s: Optional[float] = None):
         if not isinstance(poses, dict):
             arr = np.asarray(poses, np.float32)
@@ -443,7 +513,7 @@ class HttpReplica:
             "seed": int(seed), "sample_steps": sample_steps,
             "guidance_weight": guidance_weight,
             "deadline_ms": deadline_ms, "k_max": k_max,
-            "trace_id": trace_id,
+            "trace_id": trace_id, "session": session,
             "timeout_s": timeout_s or self.submit_timeout_s,
         }
 
@@ -467,15 +537,17 @@ class HttpReplica:
         self._call("/poke", {}, self.health_timeout_s)
 
     def metrics_text(self) -> str:
-        url = self.base_url + "/metrics"
-        try:
-            with urllib.request.urlopen(
-                    url, timeout=self.health_timeout_s) as resp:
-                return resp.read().decode()
-        except (urllib.error.URLError, ConnectionError, socket.timeout,
-                TimeoutError, OSError) as e:
+        status, data = self._raw("GET", "/metrics", None,
+                                 self.health_timeout_s)
+        if status != 200:
             raise ReplicaUnreachable(
-                f"replica {self.name} unreachable at {url}: {e}") from e
+                f"replica {self.name}: /metrics HTTP {status}")
+        return data.decode()
 
     def close(self) -> None:
-        pass  # the process has its own lifecycle (SIGTERM → drain)
+        # The replica PROCESS has its own lifecycle (SIGTERM → drain);
+        # only this thread's pooled keepalive socket is ours to drop.
+        conn = getattr(self._local, "conn", None)
+        self._local.conn = None
+        if conn is not None:
+            conn.close()

@@ -10,11 +10,18 @@ handle protocol over HTTP (serve/replica.py ReplicaServer) and writes
 the fleet launcher (serve_bench --fleet, `nvs3d route`) polls for it
 instead of racing the bind.
 
+Once serving, a daemon thread touches `ready_file`'s mtime every
+`heartbeat_s` (default 2.0) — the fleet supervisor's liveness signal: a
+process that is alive but wedged (event loop stuck, not just slow)
+stops heartbeating, and heartbeat age is checkable with a stat, no HTTP
+round-trip to a possibly-hung server.
+
 Spec keys:
     name            fleet identity (required)
     results_folder  this replica's telemetry dir (required; fleet trace
                     reconstruction reads <fleet_dir>/replica_<name>/)
     ready_file      path to write the readiness JSON (required)
+    heartbeat_s     ready-file mtime touch period (default 2.0)
     preset          config preset (default "tiny64")
     sidelength      image sidelength override (default 16)
     steps           diffusion.sample_timesteps (default 4)
@@ -71,6 +78,21 @@ def _build_synthetic(cfg):
         mb, cond_mask=jnp.ones((batch["x"].shape[0],)),
         train=False)["params"]
     return model, params
+
+
+def _heartbeat(ready_file: str, stop: "threading.Event",
+               period_s: float) -> None:
+    """Touch the ready file's mtime every `period_s` while serving. The
+    faultinject heartbeat-stop hook freezes it (wedged-process drill)."""
+    from novel_view_synthesis_3d_tpu.utils import faultinject
+
+    while not stop.wait(period_s):
+        if faultinject.serve_heartbeat_stopped():
+            continue
+        try:
+            os.utime(ready_file, None)
+        except OSError:
+            pass  # file mid-replace by a supervisor respawn: skip one
 
 
 def main(argv=None) -> int:
@@ -155,6 +177,11 @@ def main(argv=None) -> int:
     with open(tmp, "w") as fh:
         json.dump(ready, fh)
     os.replace(tmp, spec["ready_file"])
+    threading.Thread(
+        target=_heartbeat,
+        args=(spec["ready_file"], stop,
+              float(spec.get("heartbeat_s", 2.0))),
+        daemon=True, name="ready-heartbeat").start()
     print(f"replica {name} serving on {server.url()}", flush=True)
 
     stop.wait()

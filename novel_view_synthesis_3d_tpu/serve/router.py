@@ -1,22 +1,53 @@
-"""FleetRouter: least-step-debt dispatch, session affinity, failover.
+"""FleetRouter: least-step-debt dispatch, consistent-hash affinity,
+failover, and gray-failure defenses.
 
 The router is deliberately thin (the Pathways single-controller
 argument, PAPERS.md): replicas own all model state; the router owns
 three small tables —
 
   - a health cache: each replica's /healthz snapshot (step_debt,
-    brownout_level, serve_state, breaker) polled every
+    brownout_level, serve_state, breaker, latency_p99_s) polled every
     router.health_poll_s and aged out after router.health_ttl_s;
   - an outstanding-work ledger: denoise steps this router has in
     flight per replica, so dispatch pressure between polls is
     poll-fresh + local-accurate (two requests arriving between polls
     don't both see the same stale debt);
-  - the affinity table: orbit session → replica. A trajectory's frame
+  - the affinity layer: orbit session → replica. A trajectory's frame
     bank is device-resident on ONE replica, so every segment of a
-    session must land there; the pin moves only when the pinned
-    replica leaves the eligible set (drain, death, deploy quiesce),
-    and the continuation is re-conditioned on the last delivered
-    frame so the orbit stays seamless.
+    session must land there. The base mapping is a CONSISTENT-HASH
+    RING (replica names → vnode positions, session id → first vnode
+    clockwise): it is derived from nothing but the replica set, so a
+    freshly restarted router computes bit-identical pins with ZERO
+    recovered state. Only DEVIATIONS from the ring (a session that
+    migrated off its ring home on failover — its bank now lives
+    elsewhere) are stored, as bounded-LRU overrides, and journaled.
+
+Crash-safe restart: pass `journal=` (a path or serve/journal.py
+RouterJournal) and the router appends hop/orbit/pin records as it
+dispatches; a restarting router replays the journal — affinity
+overrides are restored, and the unresolved outstanding-steps ledger
+seeds dispatch pressure until the first /healthz poll of each replica
+supersedes it (the replica's own step_debt gauge is authoritative —
+that is the reconciliation). `fleet_snapshot()["recovery"]` reports
+the reconstruction provenance (`nvs3d route status` prints it).
+
+Gray-failure defenses (a replica that is slow is worse than one that
+is dead — the dead one fails fast):
+
+  - demotion: with router.demote_p99_factor set, a replica whose
+    polled latency_p99_s is >= factor × the fleet's best p99 is
+    demoted — dispatched to only when no un-demoted replica is
+    eligible (router_demote/router_promote events);
+  - hedged dispatch: with router.hedge_delay_s set, a stateless
+    single whose first replica has not answered after the delay is
+    sent again to the next ring replica; first response wins, the
+    loser is abandoned (`router_hedge` span, nvs3d_router_hedges_total
+    by winner). Trajectories never hedge — the frame bank is
+    single-homed;
+  - per-hop timeout: router.hop_timeout_s bounds what ONE replica
+    attempt may consume of the request's total timeout; a wedged
+    replica costs one hop budget, not the whole client deadline
+    (`router_hop_timeout` event, the hop fails over).
 
 Failover is driven by PR 11's structured error contract: a replica
 that died (ReplicaUnreachable), drained, or shed retryably triggers a
@@ -31,17 +62,21 @@ Observability: the router threads one trace_id through every replica
 hop (the replica's request_submit/request_respond rows carry it), and
 writes its own rows through the obs bus/tracer — `router_submit` root,
 one `router_hop` span per attempt (replica, attempt ordinal, outcome),
-and a retrospective `router_respond` — so `nvs3d obs trace` can
-reconstruct a cross-replica timeline from the fleet's merged
-telemetry (obs/reqtrace.load_fleet_rows).
+`router_hedge` for hedge races, and a retrospective `router_respond`
+— so `nvs3d obs trace` can reconstruct a cross-replica timeline from
+the fleet's merged telemetry (obs/reqtrace.load_fleet_rows).
 """
 
 from __future__ import annotations
 
+import bisect
+import hashlib
+import os
+import sys
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 import numpy as np
 
@@ -50,10 +85,13 @@ from novel_view_synthesis_3d_tpu.config import RouterConfig
 from novel_view_synthesis_3d_tpu.obs import reqtrace
 from novel_view_synthesis_3d_tpu.sample.client import retry_delay_s
 from novel_view_synthesis_3d_tpu.sample.service import (
+    DeadlineExceeded,
     Rejected,
     ServeError,
     _normalize_poses,
 )
+from novel_view_synthesis_3d_tpu.serve import journal as journal_mod
+from novel_view_synthesis_3d_tpu.serve.journal import RouterJournal
 from novel_view_synthesis_3d_tpu.serve.replica import ReplicaUnreachable
 
 # Replica-side serve_state values the router will dispatch onto.
@@ -80,9 +118,74 @@ class FleetSaturated(Rejected):
                          retry_after_s=retry_after_s)
 
 
+class HopTimeout(Rejected):
+    """One replica attempt exceeded the per-hop timeout budget
+    (router.hop_timeout_s): the replica is wedged-or-slow, not
+    provably dead — the hop is abandoned and the request fails over.
+    Retryable by construction (like ReplicaUnreachable, the router
+    stops waiting; a stateless resubmit elsewhere cannot double-count
+    a CLIENT-visible result)."""
+
+    def __init__(self, message: str):
+        super().__init__(message, retryable=True, retry_after_s=0.0)
+
+
+def _hash64(key: str) -> int:
+    """Stable 64-bit position — hashlib, NOT hash(): Python string
+    hashing is salted per process, and the whole point of the ring is
+    that two router incarnations derive identical pins."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(),
+        "big")
+
+
+class HashRing:
+    """Consistent-hash ring over replica names.
+
+    Each replica contributes `vnodes` points at blake2b("name#i");
+    `lookup(key)` walks clockwise from blake2b(key) to the first
+    point whose replica is not excluded. Deterministic in (replica
+    set, vnodes, key) and nothing else — the crash-safe affinity
+    contract. The exclude walk doubles as deterministic failover
+    order: the "next ring replica" for hedging and pin migration."""
+
+    def __init__(self, names, vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self._points = sorted(
+            (_hash64(f"{name}#{i}"), str(name))
+            for name in names for i in range(self.vnodes))
+        self._keys = [p[0] for p in self._points]
+
+    def lookup(self, key: str, exclude=frozenset()) -> Optional[str]:
+        if not self._points:
+            return None
+        i = bisect.bisect_right(self._keys, _hash64(str(key)))
+        seen: Set[str] = set()
+        for j in range(len(self._points)):
+            name = self._points[(i + j) % len(self._points)][1]
+            if name in seen:
+                continue
+            seen.add(name)
+            if name not in exclude:
+                return name
+        return None
+
+    def digest(self) -> str:
+        """Digest of the full vnode table: two routers derive identical
+        pins for EVERY key iff their digests match — the serve_bench
+        router-restart drill asserts this bit-reproduction across
+        incarnations instead of sampling keys."""
+        h = hashlib.blake2b(digest_size=8)
+        for pos, name in self._points:
+            h.update(pos.to_bytes(8, "big"))
+            h.update(name.encode("utf-8"))
+        return h.hexdigest()
+
+
 class _ReplicaState:
     __slots__ = ("handle", "health", "health_t", "outstanding",
-                 "in_rotation", "reachable", "dispatches", "failures")
+                 "in_rotation", "reachable", "dispatches", "failures",
+                 "demoted", "recovered")
 
     def __init__(self, handle):
         self.handle = handle
@@ -93,33 +196,45 @@ class _ReplicaState:
         self.reachable = True
         self.dispatches = 0
         self.failures = 0
+        self.demoted = False   # gray-failure: slow-but-alive
+        self.recovered = 0     # journal-replayed steps, pre-first-poll
 
 
 class FleetRouter:
     def __init__(self, replicas, *, rcfg: Optional[RouterConfig] = None,
                  tracer=None, bus=None, clock=time.monotonic,
                  sleep=time.sleep, start: bool = False,
-                 metrics_server=None):
+                 metrics_server=None, journal=None, run_dir: str = ""):
         """`replicas`: iterable of handles (serve/replica.py protocol).
         `tracer`/`bus` come from the router's own obs.RunTelemetry (or
         stay None for bare tests — every write is guarded). `start=True`
         launches the background health poller; tests poll manually.
         `metrics_server`: an obs.MetricsServer to hang the fleet
-        aggregation on — the router's own /metrics then re-serves every
-        replica's families relabeled with replica="<name>" (cleared on
-        close)."""
+        aggregation on. `journal`: a path or RouterJournal — enables
+        the crash-safe append-only journal; an existing file is
+        REPLAYED first (affinity overrides restored, unresolved ledger
+        seeded until reconciled against live /healthz). `run_dir`: the
+        router's own folder (stall diagnoses, default journal home)."""
         self.rcfg = rcfg or RouterConfig()
+        self.run_dir = str(run_dir or "")
         self._states: "OrderedDict[str, _ReplicaState]" = OrderedDict()
         for h in replicas:
             if h.name in self._states:
                 raise ValueError(f"duplicate replica name {h.name!r}")
             self._states[h.name] = _ReplicaState(h)
+        self._ring = HashRing(self._states.keys(),
+                              vnodes=self.rcfg.affinity_vnodes)
         self.tracer = tracer
         self.bus = bus
         self._clock = clock
         self._sleep = sleep
         self._lock = threading.Lock()
-        self._affinity: "OrderedDict[str, str]" = OrderedDict()
+        # Affinity OVERRIDES only (sessions living off their ring
+        # home); ring-derived pins need no state at all.
+        self._pins: "OrderedDict[str, str]" = OrderedDict()
+        # Last replica each live session dispatched to (status view +
+        # affinity-move detection); bounded like the override table.
+        self._sessions: "OrderedDict[str, str]" = OrderedDict()
         self._next_rid = 0
         self._rr = 0  # tie-break rotation for equal-debt picks
         reg = obs.get_registry()
@@ -132,19 +247,72 @@ class FleetRouter:
         self._m_dispatch = reg.counter(
             "nvs3d_router_dispatch_total",
             "hops dispatched, by replica")
+        self._m_hedges = reg.counter(
+            "nvs3d_router_hedges_total",
+            "hedged single dispatches, by winner (primary|hedge)")
         self._m_healthy = reg.gauge(
             "nvs3d_router_replicas_healthy",
             "replicas reachable + dispatchable at last poll")
+        self._m_demoted = reg.gauge(
+            "nvs3d_router_replicas_demoted",
+            "replicas demoted for gray failure (slow p99) at last poll")
         self._m_debt = reg.gauge(
             "nvs3d_router_fleet_step_debt",
             "fleet step debt: polled replica debt + router outstanding")
         self._stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
         self._metrics_server = metrics_server
+        self.journal: Optional[RouterJournal] = None
+        self.recovery: Optional[dict] = None
+        if journal is not None:
+            self._init_journal(journal)
         if metrics_server is not None:
             metrics_server.set_metrics_extra(self.fleet_metrics_text)
         if start:
             self.start()
+
+    # -- journal replay / recovery ------------------------------------
+    def _init_journal(self, journal) -> None:
+        if isinstance(journal, RouterJournal):
+            jr = journal
+        else:
+            path = str(journal)
+            if os.path.isdir(path) or path.endswith(os.sep):
+                path = os.path.join(path, "router_journal.jsonl")
+            jr = RouterJournal(
+                path, snapshot_every=self.rcfg.journal_snapshot_every)
+        replayed = journal_mod.replay(jr.path)
+        self.journal = jr
+        if not replayed or not replayed["records"]:
+            return
+        pins_restored = 0
+        for session, name in replayed["pins"].items():
+            if name in self._states:
+                self._pins[session] = name
+                self._sessions[session] = name
+                pins_restored += 1
+        recovered = {}
+        for name, steps in replayed["outstanding"].items():
+            if name in self._states and steps > 0:
+                self._states[name].recovered = int(steps)
+                recovered[name] = int(steps)
+        self.recovery = {
+            "journal": replayed["path"],
+            "records": replayed["records"],
+            "torn": replayed["torn"],
+            "pins_restored": pins_restored,
+            "orbits_seen": len(replayed["orbits"]),
+            "recovered_steps": recovered,
+            "reconciled": {},
+        }
+        self._event(
+            "router_journal_replay",
+            f"replayed {replayed['records']} record(s) from "
+            f"{jr.path}: {sum(recovered.values())} unresolved step(s) "
+            f"across {len(recovered)} replica(s), {pins_restored} "
+            f"affinity override(s) restored"
+            + (f", {replayed['torn']} torn line(s) skipped"
+               if replayed["torn"] else ""))
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -154,14 +322,61 @@ class FleetRouter:
             target=self._poll_loop, daemon=True, name="router-health")
         self._poller.start()
 
-    def close(self) -> None:
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the poller and release the metrics hook. A poller that
+        does not join within `timeout` is WEDGED (a healthz call stuck
+        past every socket timeout) — the router writes a PR 2-style
+        all-thread-stack diagnosis (stall_router_close_<n>.txt under
+        run_dir) and raises instead of silently leaking the thread."""
         self._stop.set()
         if self._metrics_server is not None:
             self._metrics_server.set_metrics_extra(None)
             self._metrics_server = None
-        if self._poller is not None:
-            self._poller.join(timeout=10.0)
+        poller = self._poller
+        if poller is not None:
+            poller.join(timeout=timeout)
+            if poller.is_alive():
+                self._dump_close_stall(poller, timeout)
+                raise RuntimeError(
+                    f"router health poller still alive after "
+                    f"{timeout:.1f}s join (close()): thread-stack "
+                    f"diagnosis written under {self.run_dir or '<unset>'!r} "
+                    "(stall_router_close_*.txt)")
             self._poller = None
+        if self.journal is not None:
+            self.journal.close()
+
+    def _dump_close_stall(self, thread: threading.Thread,
+                          timeout: float) -> None:
+        """Wedged-poller diagnosis: every thread's stack to a stall_*
+        file (stderr when even that fails — the diagnosis must never
+        be the second fault), plus a `stall` event row."""
+        from novel_view_synthesis_3d_tpu.utils import watchdog
+
+        self._event(
+            "stall",
+            f"close(): health poller {thread.name!r} wedged past the "
+            f"{timeout:.1f}s join; diagnosis stall_router_close_*.txt")
+        body = (f"fleet-router close(): poller {thread.name!r} still "
+                f"alive after join timeout {timeout:.1f}s\n"
+                f"time: {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}"
+                "\n\n" + watchdog.thread_stacks())
+        try:
+            if not self.run_dir:
+                raise OSError("router has no run_dir")
+            os.makedirs(self.run_dir, exist_ok=True)
+            n = 0
+            while os.path.exists(os.path.join(
+                    self.run_dir, f"stall_router_close_{n}.txt")):
+                n += 1
+            path = os.path.join(self.run_dir,
+                                f"stall_router_close_{n}.txt")
+            with open(path, "w") as fh:
+                fh.write(body)
+            print(f"[router] wedged-poller diagnosis: {path}",
+                  file=sys.stderr, flush=True)
+        except OSError:
+            print(body, file=sys.stderr, flush=True)
 
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
@@ -171,7 +386,11 @@ class FleetRouter:
     # -- health --------------------------------------------------------
     def poll_health(self) -> Dict[str, Optional[dict]]:
         """Poll every replica's /healthz once; updates the cache, the
-        fleet gauges, and emits replica_down/replica_up transitions."""
+        fleet gauges, gray-failure demotion, and emits
+        replica_down/replica_up transitions. A replica's first
+        successful poll supersedes (reconciles) any journal-recovered
+        outstanding steps — the replica's own step_debt gauge already
+        counts whatever survived the old router."""
         now = self._clock()
         healthy = 0
         debt_total = 0
@@ -180,6 +399,15 @@ class FleetRouter:
                 snap = st.handle.healthz()
                 was_unreachable = not st.reachable
                 st.health, st.health_t, st.reachable = snap, now, True
+                if st.recovered:
+                    if self.recovery is not None:
+                        self.recovery["reconciled"][name] = st.recovered
+                    self._event(
+                        "router_journal_reconcile",
+                        f"replica {name}: {st.recovered} journal-"
+                        f"recovered step(s) superseded by live "
+                        f"step_debt={int(snap.get('step_debt', 0))}")
+                    st.recovered = 0
                 if was_unreachable:
                     self._event("replica_up",
                                 f"replica {name} reachable again")
@@ -193,9 +421,45 @@ class FleetRouter:
             if self._dispatchable(st):
                 healthy += 1
             debt_total += int(snap.get("step_debt", 0)) + st.outstanding
+        self._update_demotion()
         self._m_healthy.set(float(healthy))
         self._m_debt.set(float(debt_total))
         return {name: st.health for name, st in self._states.items()}
+
+    def _update_demotion(self) -> None:
+        """Gray-failure scoring: a replica whose fresh latency_p99_s is
+        >= demote_p99_factor × the fleet's BEST fresh p99 is demoted.
+        Needs >= 2 reporting replicas — with one report there is no
+        peer to be slow relative to; when everyone slows together
+        (shared cause) nobody is demoted."""
+        factor = float(self.rcfg.demote_p99_factor or 0.0)
+        p99s: Dict[str, float] = {}
+        if factor > 0.0:
+            for name, st in self._states.items():
+                if st.reachable and self._fresh(st):
+                    p = float((st.health or {}).get("latency_p99_s")
+                              or 0.0)
+                    if p > 0.0:
+                        p99s[name] = p
+        best = min(p99s.values()) if len(p99s) >= 2 else 0.0
+        demoted = 0
+        for name, st in self._states.items():
+            was = st.demoted
+            st.demoted = bool(best > 0.0
+                              and p99s.get(name, 0.0) >= factor * best)
+            if st.demoted:
+                demoted += 1
+            if st.demoted and not was:
+                self._event(
+                    "router_demote",
+                    f"replica {name} demoted: p99 "
+                    f"{p99s.get(name, 0.0) * 1000:.0f}ms >= "
+                    f"{factor:g}x fleet best {best * 1000:.0f}ms")
+            elif was and not st.demoted:
+                self._event("router_promote",
+                            f"replica {name} promoted: p99 back within "
+                            f"{factor:g}x fleet best")
+        self._m_demoted.set(float(demoted))
 
     def _fresh(self, st: _ReplicaState) -> bool:
         return (st.health is not None
@@ -218,49 +482,132 @@ class FleetRouter:
 
     def _debt(self, st: _ReplicaState) -> int:
         polled = int((st.health or {}).get("step_debt", 0))
-        return polled + st.outstanding
+        return polled + st.outstanding + st.recovered
 
     def _eligible(self, exclude=()) -> List[str]:
         return [name for name, st in self._states.items()
                 if name not in exclude and self._dispatchable(st)]
 
+    def _outstanding_map(self) -> Dict[str, int]:
+        return {name: st.outstanding + st.recovered
+                for name, st in self._states.items()
+                if st.outstanding or st.recovered}
+
     # -- dispatch policy ----------------------------------------------
+    def ring_pin(self, session: str) -> Optional[str]:
+        """The session's zero-state ring home — what a freshly
+        restarted router with no journal would derive. Public so the
+        bench/tests can assert bit-reproduction."""
+        return self._ring.lookup(session)
+
     def pick(self, *, session: Optional[str] = None,
              exclude=()) -> str:
-        """Least-step-debt replica; an orbit session's pin wins while
-        the pinned replica stays eligible (the frame bank lives there).
-        Raises NoReplicaAvailable when the eligible set is empty."""
+        """Dispatch choice. Singles: least step debt among un-demoted
+        eligible replicas (demoted ones only when nothing better).
+        Sessions: the affinity override if one exists and is usable,
+        else the consistent-hash ring walk (home first, then ring
+        order) — deviations from the ring home are stored as overrides
+        so the orbit's frame bank stays single-homed. Raises
+        NoReplicaAvailable when the eligible set is empty."""
         with self._lock:
             if session is not None:
-                pinned = self._affinity.get(session)
-                if pinned is not None and pinned not in exclude \
-                        and self._dispatchable(self._states[pinned]):
-                    self._affinity.move_to_end(session)
-                    return pinned
+                name = self._pick_session_locked(session, set(exclude))
+                if name is None:
+                    raise NoReplicaAvailable(
+                        "no dispatchable replica (all dead, draining, "
+                        "quiesced, or shedding)")
+                return name
             names = self._eligible(exclude)
             if not names:
                 raise NoReplicaAvailable(
                     "no dispatchable replica (all dead, draining, "
                     "quiesced, or shedding)")
+            pref = [n for n in names if not self._states[n].demoted]
+            pool = pref or names
             self._rr += 1
-            best = min(
-                names,
+            return min(
+                pool,
                 key=lambda n: (self._debt(self._states[n]),
-                               (self._rr + hash(n)) % len(names)))
-            if session is not None:
-                self._pin(session, best)
-            return best
+                               (self._rr + hash(n)) % len(pool)))
 
-    def _pin(self, session: str, name: str) -> None:
+    def _pick_session_locked(self, session: str,
+                             exclude: Set[str]) -> Optional[str]:
         # caller holds self._lock
-        moved = self._affinity.get(session)
-        self._affinity[session] = name
-        self._affinity.move_to_end(session)
-        while len(self._affinity) > self.rcfg.affinity_entries:
-            self._affinity.popitem(last=False)
-        if moved is not None and moved != name:
+        cur = self._pins.get(session)
+        if cur is not None:
+            if (cur not in exclude and cur in self._states
+                    and self._dispatchable(self._states[cur])):
+                self._pins.move_to_end(session)
+                self._note_session(session, cur)
+                return cur
+            # The override's replica left the eligible set: drop the
+            # override and fall back to the ring walk.
+            del self._pins[session]
+            if self.journal is not None:
+                self.journal.unpin(session)
+        elig = set(self._eligible(exclude))
+        if not elig:
+            return None
+        pref = ({n for n in elig if not self._states[n].demoted}
+                or elig)
+        choice = self._ring.lookup(
+            session, exclude=set(self._states) - pref)
+        if choice is None:
+            choice = self._ring.lookup(
+                session, exclude=set(self._states) - elig)
+        if choice is None:
+            return None
+        home = self._ring.lookup(session)
+        if choice != home:
+            # Deviation from the ring: must be remembered (the bank
+            # lives on `choice` now; a restart must not send the next
+            # segment back to a resurrected home).
+            self._set_pin_locked(session, choice, home)
+        self._note_session(session, choice)
+        return choice
+
+    def _set_pin_locked(self, session: str, name: str,
+                        home: Optional[str]) -> None:
+        # caller holds self._lock
+        self._pins[session] = name
+        self._pins.move_to_end(session)
+        if self.journal is not None:
+            self.journal.pin(session, name, home or "")
+        while len(self._pins) > self.rcfg.affinity_entries:
+            old, _ = self._pins.popitem(last=False)
+            if self.journal is not None:
+                self.journal.unpin(old)
+
+    def _note_session(self, session: str, name: str) -> None:
+        # caller holds self._lock
+        prev = self._sessions.get(session)
+        self._sessions[session] = name
+        self._sessions.move_to_end(session)
+        while len(self._sessions) > self.rcfg.affinity_entries:
+            self._sessions.popitem(last=False)
+        if prev is not None and prev != name:
             self._event("router_affinity_move",
-                        f"session {session}: {moved} -> {name}")
+                        f"session {session}: {prev} -> {name}")
+
+    def _unpin_locked(self, session: str, name: str) -> None:
+        # caller holds self._lock; drop pin only if it still points at
+        # the failed replica (a concurrent segment may have re-pinned)
+        if self._pins.get(session) == name:
+            del self._pins[session]
+            if self.journal is not None:
+                self.journal.unpin(session)
+
+    def _hedge_peer(self, key: str, exclude: Set[str]) -> Optional[str]:
+        """The hedge target: next ring replica (deterministic) among
+        eligible, un-demoted (falling back to demoted) peers."""
+        with self._lock:
+            elig = set(self._eligible(exclude))
+            if not elig:
+                return None
+            pref = ({n for n in elig if not self._states[n].demoted}
+                    or elig)
+            return self._ring.lookup(
+                key, exclude=set(self._states) - pref)
 
     # -- rotation control (deploys) -----------------------------------
     def quiesce(self, name: str) -> None:
@@ -303,6 +650,182 @@ class FleetRouter:
         finally:
             st.reachable = False
 
+    # -- the hop engine -----------------------------------------------
+    def _run_hop(self, *, tid: str, name: str, att: dict, weight: int,
+                 deadline: float, submit, tried_dead: Set[str],
+                 shed: Dict[str, float], hedge: bool = False,
+                 err_extra=None, ok_extra=None):
+        """Dispatch one hop-group (primary + at most one hedge) and
+        wait for the first response, enforcing the per-hop timeout
+        budget. Owns ALL per-hop bookkeeping — ledger, journal, hop
+        spans, replica_down events, tried_dead/shed classification —
+        for primary and hedge alike. Returns (result, winner_name);
+        raises the terminal error for the outer failover loop to
+        budget (tried_dead/shed already updated)."""
+        rcfg = self.rcfg
+        hop_cap = rcfg.hop_timeout_s if rcfg.hop_timeout_s > 0 \
+            else float("inf")
+        hedge_at = (time.monotonic() + rcfg.hedge_delay_s
+                    if hedge and rcfg.hedge_delay_s > 0
+                    else float("inf"))
+        entries: List[dict] = []
+        last_err: Optional[BaseException] = None
+
+        def extras(e=None) -> dict:
+            if err_extra is None:
+                return {}
+            return err_extra(e) if callable(err_extra) else dict(err_extra)
+
+        def j_done(nm: str, outcome: str) -> None:
+            if self.journal is not None:
+                self.journal.hop_done(tid, nm, weight, outcome)
+
+        def launch(nm: str) -> Optional[dict]:
+            nonlocal last_err
+            st = self._states[nm]
+            st.outstanding += weight
+            if self.journal is not None:
+                self.journal.hop(tid, nm, weight)
+                self.journal.maybe_snapshot(self._outstanding_map())
+            att["n"] += 1
+            ent = {"name": nm, "st": st, "attempt": att["n"],
+                   "t0": time.monotonic()}
+            try:
+                ent["ticket"] = submit(nm)
+            except Exception as e:
+                settle_error(ent, e)
+                return None
+            return ent
+
+        def settle_error(ent: dict, e: BaseException) -> None:
+            nonlocal last_err
+            nm = ent["name"]
+            ent["st"].outstanding -= weight
+            retryable = bool(getattr(e, "retryable", False))
+            outcome = "failover" if retryable else "failed"
+            self._hop(tid, nm, ent["attempt"], ent["t0"], outcome, e,
+                      **extras(e))
+            j_done(nm, outcome)
+            if isinstance(e, ReplicaUnreachable):
+                ent["st"].reachable = False
+                tried_dead.add(nm)
+                self._event("replica_down",
+                            f"replica {nm} died mid-request: {e}")
+            elif retryable:
+                shed[nm] = max(
+                    shed.get(nm, 0.0),
+                    float(getattr(e, "retry_after_s", 0.0) or 0.0))
+            last_err = e
+
+        def settle_timeout(ent: dict, budget_s: float) -> None:
+            nonlocal last_err
+            nm = ent["name"]
+            ent["st"].outstanding -= weight
+            self._hop(tid, nm, ent["attempt"], ent["t0"], "hop_timeout",
+                      None, **extras(None))
+            j_done(nm, "hop_timeout")
+            tried_dead.add(nm)
+            self._event(
+                "router_hop_timeout",
+                f"trace {tid} attempt {ent['attempt']} on {nm}: no "
+                f"response within the {budget_s:.1f}s per-hop budget; "
+                "abandoning hop (replica keeps computing)")
+            last_err = HopTimeout(
+                f"replica {nm} exceeded the {budget_s:.1f}s per-hop "
+                "timeout budget")
+
+        def abandon(ent: dict, outcome: str) -> None:
+            ent["st"].outstanding -= weight
+            self._hop(tid, ent["name"], ent["attempt"], ent["t0"],
+                      outcome, None)
+            j_done(ent["name"], outcome)
+
+        primary = launch(name)
+        if primary is None:
+            raise last_err
+        entries.append(primary)
+        hedge_launched = False
+        poll = 0.02
+        while entries:
+            now = time.monotonic()
+            if now >= deadline:
+                for ent in list(entries):
+                    settle_timeout(ent, deadline - ent["t0"])
+                raise DeadlineExceeded(
+                    f"request {tid}: total router timeout exhausted "
+                    "waiting on the fleet")
+            if (not hedge_launched and now >= hedge_at
+                    and any(e is primary for e in entries)):
+                hedge_launched = True
+                peer = self._hedge_peer(
+                    tid, exclude=({e["name"] for e in entries}
+                                  | tried_dead | set(shed)))
+                if peer is not None:
+                    ent = launch(peer)
+                    if ent is not None:
+                        entries.append(ent)
+                        self._event(
+                            "router_hedge",
+                            f"trace {tid}: hedging {name} -> {peer} "
+                            f"after {rcfg.hedge_delay_s * 1000:.0f}ms "
+                            "without a response")
+            for ent in list(entries):
+                now = time.monotonic()
+                hop_deadline = min(ent["t0"] + hop_cap, deadline)
+                if now >= hop_deadline:
+                    entries.remove(ent)
+                    settle_timeout(ent, min(hop_cap,
+                                            deadline - ent["t0"]))
+                    continue
+                slice_t = min(poll, hop_deadline - now)
+                if not hedge_launched and hedge_at > now:
+                    slice_t = min(slice_t, hedge_at - now)
+                try:
+                    result = ent["ticket"].result(
+                        timeout=max(0.0, slice_t))
+                except TimeoutError:
+                    continue  # not done yet; budgets checked above
+                except Exception as e:
+                    entries.remove(ent)
+                    settle_error(ent, e)
+                    if not getattr(e, "retryable", False):
+                        # Deterministic failure — it would fail
+                        # identically on the hedge; stop the race.
+                        for other in list(entries):
+                            entries.remove(other)
+                            abandon(other, "cancelled")
+                        raise
+                    continue
+                # -- winner ------------------------------------------
+                entries.remove(ent)
+                for other in list(entries):
+                    entries.remove(other)
+                    abandon(other, "hedge_abandoned")
+                ent["st"].outstanding -= weight
+                ent["st"].dispatches += 1
+                self._m_dispatch.inc(replica=ent["name"])
+                ok_attrs = {}
+                if ok_extra is not None:
+                    ok_attrs = ok_extra(result)
+                if hedge_launched:
+                    ok_attrs["hedged"] = True
+                self._hop(tid, ent["name"], ent["attempt"], ent["t0"],
+                          "ok", None, **ok_attrs)
+                j_done(ent["name"], "ok")
+                if hedge_launched:
+                    winner = ("primary" if ent is primary else "hedge")
+                    self._m_hedges.inc(winner=winner)
+                    self._span(
+                        "router_hedge",
+                        time.monotonic() - primary["t0"],
+                        trace_id=tid,
+                        span_id=f"{tid}/g{primary['attempt']}",
+                        parent_id=reqtrace.root_span_id(tid),
+                        primary=name, winner=ent["name"],
+                        delay_s=rcfg.hedge_delay_s, outcome=winner)
+                return result, ent["name"]
+        raise last_err
+
     # -- request path --------------------------------------------------
     def request(self, cond, *, seed: int = 0, sample_steps=None,
                 guidance_weight=None, deadline_ms=None,
@@ -310,7 +833,9 @@ class FleetRouter:
                 ) -> np.ndarray:
         """Route one single-shot request; blocks for the image.
         Transparent failover within router.retry_budget; fleet-wide
-        shed raises FleetSaturated."""
+        shed raises FleetSaturated; per-hop timeouts and hedged
+        dispatch apply when configured (singles are stateless, so a
+        duplicate in flight is waste, never corruption)."""
         with self._lock:
             self._next_rid += 1
             rid = self._next_rid
@@ -319,11 +844,19 @@ class FleetRouter:
                    span_id=reqtrace.root_span_id(tid), req_kind="single",
                    steps=int(sample_steps or 0))
         t0 = time.monotonic()
+        deadline = t0 + float(timeout_s)
         steps_weight = int(sample_steps or 1)
-        attempt = 0
+        att = {"n": 0}
         failovers = 0
         shed: Dict[str, float] = {}
-        tried_dead: set = set()
+        tried_dead: Set[str] = set()
+
+        def submit(nm: str):
+            return self._states[nm].handle.submit(
+                cond, seed=seed, sample_steps=sample_steps,
+                guidance_weight=guidance_weight,
+                deadline_ms=deadline_ms, trace_id=tid)
+
         while True:
             try:
                 # A replica that shed THIS request is excluded from its
@@ -334,64 +867,48 @@ class FleetRouter:
                 name = self.pick(exclude=tried_dead | set(shed))
             except NoReplicaAvailable:
                 if shed:
-                    self._finish(tid, t0, "saturated", attempt, failovers)
+                    self._finish(tid, t0, "saturated", att["n"],
+                                 failovers)
                     raise FleetSaturated(
                         "fleet saturated: every eligible replica shed "
                         f"({sorted(shed)})",
                         retry_after_s=max(shed.values()) or 0.25
                     ) from None
-                self._finish(tid, t0, "no_replica", attempt, failovers)
+                self._finish(tid, t0, "no_replica", att["n"], failovers)
                 raise
-            st = self._states[name]
-            attempt += 1
-            t_hop = time.monotonic()
-            st.outstanding += steps_weight
             try:
-                ticket = st.handle.submit(
-                    cond, seed=seed, sample_steps=sample_steps,
-                    guidance_weight=guidance_weight,
-                    deadline_ms=deadline_ms, trace_id=tid)
-                img = ticket.result(timeout=timeout_s)
+                img, _winner = self._run_hop(
+                    tid=tid, name=name, att=att, weight=steps_weight,
+                    deadline=deadline, submit=submit,
+                    tried_dead=tried_dead, shed=shed, hedge=True)
             except Exception as e:
-                st.outstanding -= steps_weight
                 retryable = bool(getattr(e, "retryable", False))
-                self._hop(tid, name, attempt, t_hop,
-                          "failover" if retryable else "failed", e)
-                if isinstance(e, ReplicaUnreachable):
-                    st.reachable = False
-                    tried_dead.add(name)
-                    self._event("replica_down",
-                                f"replica {name} died mid-request: {e}")
-                elif retryable:
-                    shed[name] = max(
-                        shed.get(name, 0.0),
-                        float(getattr(e, "retry_after_s", 0.0) or 0.0))
-                    if set(self._eligible()) <= set(shed):
-                        # Full sweep shed: saturated, stop storming.
-                        self._m_requests.inc(outcome="saturated")
-                        self._finish(tid, t0, "saturated", attempt,
-                                     failovers)
-                        raise FleetSaturated(
-                            "fleet saturated: every eligible replica "
-                            f"shed ({sorted(shed)})",
-                            retry_after_s=max(shed.values()) or 0.25
-                        ) from e
+                if (retryable and shed
+                        and not isinstance(e, (ReplicaUnreachable,
+                                               HopTimeout))
+                        and set(self._eligible()) <= set(shed)):
+                    # Full sweep shed: saturated, stop storming.
+                    self._m_requests.inc(outcome="saturated")
+                    self._finish(tid, t0, "saturated", att["n"],
+                                 failovers)
+                    raise FleetSaturated(
+                        "fleet saturated: every eligible replica "
+                        f"shed ({sorted(shed)})",
+                        retry_after_s=max(shed.values()) or 0.25
+                    ) from e
                 if not retryable or failovers >= self.rcfg.retry_budget:
                     self._m_requests.inc(outcome="failed")
-                    self._finish(tid, t0, "failed", attempt, failovers)
+                    self._finish(tid, t0, "failed", att["n"], failovers)
                     raise
                 failovers += 1
                 self._m_failovers.inc(
                     reason="dead" if isinstance(e, ReplicaUnreachable)
+                    else "wedged" if isinstance(e, HopTimeout)
                     else "shed")
                 self._sleep(min(0.25, retry_delay_s(e, failovers - 1)))
                 continue
-            st.outstanding -= steps_weight
-            st.dispatches += 1
-            self._m_dispatch.inc(replica=name)
-            self._hop(tid, name, attempt, t_hop, "ok", None)
             self._m_requests.inc(outcome="ok")
-            self._finish(tid, t0, "ok", attempt, failovers)
+            self._finish(tid, t0, "ok", att["n"], failovers)
             return img
 
     def request_trajectory(self, cond, poses, *, seed: int = 0,
@@ -403,11 +920,16 @@ class FleetRouter:
         """Route one orbit; blocks for the stacked (N, H, W, 3) frames.
 
         The session (default: the trace id) pins the orbit to one
-        replica — its frame bank lives there. A mid-orbit failure with
+        replica — its frame bank lives there, at the session's
+        consistent-hash ring home unless a failover moved it (the
+        deviation is stored + journaled). A mid-orbit failure with
         partial frames (SampleAnomaly, replica death after streaming)
-        fails over: the router re-pins, re-conditions on the LAST
-        DELIVERED frame + its pose, and submits only the remaining
-        poses, so the caller still receives a complete orbit."""
+        fails over: the router re-pins along the ring, re-conditions
+        on the LAST DELIVERED frame + its pose, and submits only the
+        remaining poses, so the caller still receives a complete
+        orbit. Trajectories never hedge; the per-hop timeout budget
+        still applies (a wedged bank-holder is abandoned and the orbit
+        stitched onto a survivor)."""
         poses_R, poses_t = _normalize_poses(poses)
         n_frames = int(poses_R.shape[0])
         with self._lock:
@@ -419,18 +941,22 @@ class FleetRouter:
                    span_id=reqtrace.root_span_id(tid),
                    req_kind="trajectory", steps=int(sample_steps or 0),
                    frames=n_frames, session=session)
+        if self.journal is not None:
+            self.journal.orbit(tid, session, n_frames,
+                               int(sample_steps or 1))
         t0 = time.monotonic()
+        deadline = t0 + float(timeout_s)
         done: List[np.ndarray] = []
-        attempt = 0
+        att = {"n": 0}
         failovers = 0
         shed: Dict[str, float] = {}
-        tried_dead: set = set()
+        tried_dead: Set[str] = set()
         base_cond = {k: np.asarray(v) for k, v in cond.items()}
         while len(done) < n_frames:
             try:
                 name = self.pick(session=session, exclude=tried_dead)
             except NoReplicaAvailable:
-                self._finish(tid, t0, "no_replica", attempt, failovers,
+                self._finish(tid, t0, "no_replica", att["n"], failovers,
                              frames_done=len(done))
                 if shed:
                     raise FleetSaturated(
@@ -439,8 +965,6 @@ class FleetRouter:
                         retry_after_s=max(shed.values()) or 0.25
                     ) from None
                 raise
-            st = self._states[name]
-            attempt += 1
             start = len(done)
             if start == 0:
                 hop_cond = base_cond
@@ -456,65 +980,64 @@ class FleetRouter:
                 }
             hop_poses = {"R2": poses_R[start:], "t2": poses_t[start:]}
             weight = int(sample_steps or 1) * (n_frames - start)
-            t_hop = time.monotonic()
-            st.outstanding += weight
-            try:
-                ticket = st.handle.submit_trajectory(
-                    hop_cond, hop_poses, seed=seed + attempt,
-                    sample_steps=sample_steps,
+            attempt_seed = seed + att["n"] + 1
+
+            def submit(nm: str, _c=hop_cond, _p=hop_poses,
+                       _s=attempt_seed):
+                return self._states[nm].handle.submit_trajectory(
+                    _c, _p, seed=_s, sample_steps=sample_steps,
                     guidance_weight=guidance_weight,
                     deadline_ms=deadline_ms, k_max=k_max, trace_id=tid)
-                frames = ticket.result(timeout=timeout_s)
+
+            try:
+                frames, _winner = self._run_hop(
+                    tid=tid, name=name, att=att, weight=weight,
+                    deadline=deadline, submit=submit,
+                    tried_dead=tried_dead, shed=shed, hedge=False,
+                    err_extra=lambda e: {"frames_done": len(done) + len(
+                        getattr(e, "frames", None) or [])},
+                    ok_extra=lambda fr: {"frames_done":
+                                         len(done) + len(fr)})
             except Exception as e:
-                st.outstanding -= weight
                 partial = getattr(e, "frames", None) or []
                 done.extend(np.asarray(f) for f in partial)
                 retryable = bool(getattr(e, "retryable", False))
-                self._hop(tid, name, attempt, t_hop,
-                          "failover" if retryable else "failed", e,
-                          frames_done=len(done))
-                if isinstance(e, ReplicaUnreachable):
-                    st.reachable = False
-                    tried_dead.add(name)
-                    self._event("replica_down",
-                                f"replica {name} died mid-orbit "
-                                f"(session {session}, "
-                                f"{len(done)}/{n_frames} frames): {e}")
-                elif retryable:
-                    shed[name] = max(
-                        shed.get(name, 0.0),
-                        float(getattr(e, "retry_after_s", 0.0) or 0.0))
+                if isinstance(e, (ReplicaUnreachable, HopTimeout)):
+                    if isinstance(e, ReplicaUnreachable):
+                        self._event(
+                            "replica_down",
+                            f"replica {name} died mid-orbit "
+                            f"(session {session}, "
+                            f"{len(done)}/{n_frames} frames): {e}")
+                    with self._lock:
+                        self._unpin_locked(session, name)
                 if not retryable or failovers >= self.rcfg.retry_budget:
                     self._m_requests.inc(outcome="failed")
-                    self._finish(tid, t0, "failed", attempt, failovers,
+                    self._finish(tid, t0, "failed", att["n"], failovers,
                                  frames_done=len(done))
                     raise
                 failovers += 1
                 self._m_failovers.inc(
                     reason="dead" if isinstance(e, ReplicaUnreachable)
+                    else "wedged" if isinstance(e, HopTimeout)
                     else "shed")
-                with self._lock:
-                    if self._affinity.get(session) == name:
-                        del self._affinity[session]
                 self._sleep(min(0.25, retry_delay_s(e, failovers - 1)))
                 continue
-            st.outstanding -= weight
-            st.dispatches += 1
-            self._m_dispatch.inc(replica=name)
             done.extend(np.asarray(f) for f in frames)
-            self._hop(tid, name, attempt, t_hop, "ok", None,
-                      frames_done=len(done))
         self._m_requests.inc(outcome="ok")
-        self._finish(tid, t0, "ok", attempt, failovers,
+        self._finish(tid, t0, "ok", att["n"], failovers,
                      frames_done=len(done))
         return np.stack(done)
 
     # -- fleet views ---------------------------------------------------
     def fleet_snapshot(self) -> dict:
         """Aggregated health for `nvs3d route status` and the bench
-        artifacts: per-replica health + the fleet rollup."""
+        artifacts: per-replica health + the fleet rollup, affinity
+        provenance, and (after a journaled restart) the journal
+        reconstruction record."""
         replicas = {}
         healthy = 0
+        demoted = 0
         debt = 0
         for name, st in self._states.items():
             replicas[name] = {
@@ -522,16 +1045,30 @@ class FleetRouter:
                 "in_rotation": st.in_rotation,
                 "outstanding": st.outstanding,
                 "dispatches": st.dispatches,
+                "demoted": st.demoted,
+                "recovered": st.recovered,
                 "health": st.health,
             }
             if self._dispatchable(st):
                 healthy += 1
+            if st.demoted:
+                demoted += 1
             debt += self._debt(st)
+        with self._lock:
+            affinity = {
+                "vnodes": self._ring.vnodes,
+                "ring_digest": self._ring.digest(),
+                "overrides": dict(self._pins),
+                "sessions": dict(self._sessions),
+            }
         return {
             "replicas": replicas,
             "healthy": healthy,
+            "demoted": demoted,
             "total": len(self._states),
             "fleet_step_debt": debt,
+            "affinity": affinity,
+            "recovery": self.recovery,
         }
 
     def fleet_metrics_text(self) -> str:
@@ -567,6 +1104,7 @@ class FleetRouter:
             per[name] = {
                 "slo_fast_burn": h.get("slo_fast_burn"),
                 "slo_breached": h.get("slo_breached"),
+                "latency_p99_s": h.get("latency_p99_s"),
             }
         burns = [v["slo_fast_burn"] for v in per.values()
                  if isinstance(v["slo_fast_burn"], (int, float))]

@@ -1,0 +1,198 @@
+"""Router process entrypoint:
+
+    python -m novel_view_synthesis_3d_tpu.serve.router_main spec.json
+
+The fleet router as its OWN OS process — the piece the chaos lane
+SIGKILLs. The ingress reuses the replica wire protocol (serve/replica.py
+ReplicaServer over a RouterCore adapter), so clients talk to the router
+with the same `HttpReplica` handle + `submit_with_retry` they would use
+against a single replica: a router crash surfaces as ReplicaUnreachable
+(retryable by construction) and the client rides through the restart.
+
+Crash-safety comes from the router journal (serve/journal.py): affinity
+overrides and the outstanding-steps ledger are appended per dispatch, so
+a respawned router replays them, re-derives every ring-home pin from the
+consistent hash (zero recovered state), and reconciles the replayed
+ledger against live /healthz. `/healthz` on the router reports the full
+fleet snapshot INCLUDING the `recovery` provenance block — `nvs3d route
+status` against a restarted router shows exactly what was reconstructed
+from where.
+
+Spec keys:
+    name            router identity (default "router")
+    results_folder  router telemetry dir (required)
+    ready_file      readiness JSON path (required; heartbeat-touched)
+    port            bind port (default 0 = ephemeral)
+    replicas        [{"name", "url", "run_dir"}] fleet membership
+                    (required)
+    journal         journal path (default
+                    <results_folder>/router_journal.jsonl)
+    rcfg            {field: value} RouterConfig overrides
+    heartbeat_s     ready-file touch period (default 2.0)
+
+SIGTERM/SIGINT closes the router cleanly (poller joined, journal
+flushed+closed); SIGKILL is what the journal exists for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+
+class _CallTicket:
+    """Minimal ticket over a blocking router call, matching the handle
+    protocol ReplicaServer expects (the router's request() already
+    blocks internally; the thread keeps the HTTP handler's timeout
+    semantics identical to a replica's)."""
+
+    def __init__(self, fn):
+        self.request_id = -1
+        self.model_version = ""
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+        def run():
+            try:
+                self._result = fn()
+            except BaseException as e:
+                self._error = e
+            self._done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("router call still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class RouterCore:
+    """Adapter: FleetRouter behind the replica handle protocol, so
+    ReplicaServer can serve it and HttpReplica can speak to it."""
+
+    def __init__(self, name: str, router):
+        self.name = str(name)
+        self.router = router
+
+    def healthz(self) -> dict:
+        snap = self.router.fleet_snapshot()
+        snap["status"] = "ok" if snap.get("healthy") else "degraded"
+        snap["role"] = "router"
+        snap["model_version"] = ""
+        return snap
+
+    def submit(self, cond, *, session=None, timeout_s=None, **kw):
+        del session  # singles are stateless; affinity is orbits-only
+        return _CallTicket(lambda: self.router.request(cond, **kw))
+
+    def submit_trajectory(self, cond, poses, *, session=None,
+                          timeout_s=None, **kw):
+        return _CallTicket(lambda: self.router.request_trajectory(
+            cond, poses, session=session, **kw))
+
+    def begin_drain(self) -> None:
+        pass  # retirement is the launcher's SIGTERM → close()
+
+    def drain(self, timeout_s=None) -> None:
+        pass
+
+    def poke(self) -> None:
+        self.router.poll_health()
+
+    def metrics_text(self) -> str:
+        from novel_view_synthesis_3d_tpu import obs
+
+        return (obs.get_registry().render_prometheus()
+                + self.router.fleet_metrics_text())
+
+    def close(self) -> None:
+        self.router.close()
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m novel_view_synthesis_3d_tpu.serve."
+              "router_main <spec.json>", file=sys.stderr)
+        return 2
+    with open(argv[0]) as fh:
+        spec = json.load(fh)
+
+    from novel_view_synthesis_3d_tpu import obs
+    from novel_view_synthesis_3d_tpu.config import RouterConfig, get_preset
+    from novel_view_synthesis_3d_tpu.serve.replica import (
+        HttpReplica,
+        ReplicaServer,
+    )
+    from novel_view_synthesis_3d_tpu.serve.replica_main import _heartbeat
+    from novel_view_synthesis_3d_tpu.serve.router import FleetRouter
+
+    name = spec.get("name", "router")
+    results_folder = spec["results_folder"]
+    os.makedirs(results_folder, exist_ok=True)
+    rcfg = dataclasses.replace(RouterConfig(),
+                               **dict(spec.get("rcfg") or {}))
+    replicas = [
+        HttpReplica(r["name"], r["url"], run_dir=r.get("run_dir", ""))
+        for r in spec["replicas"]]
+
+    telemetry = obs.RunTelemetry.create(
+        get_preset("tiny64").obs, results_folder, start_server=False)
+    journal = spec.get("journal") or os.path.join(
+        results_folder, "router_journal.jsonl")
+    router = FleetRouter(
+        replicas, rcfg=rcfg, tracer=telemetry.tracer,
+        bus=telemetry.bus, start=True, journal=journal,
+        run_dir=results_folder)
+    core = RouterCore(name, router)
+    server = ReplicaServer(core, port=int(spec.get("port", 0)))
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    ready = {"port": server.port, "pid": os.getpid(),
+             "url": server.url(), "name": name,
+             "recovery": router.recovery}
+    tmp = spec["ready_file"] + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(ready, fh)
+    os.replace(tmp, spec["ready_file"])
+    threading.Thread(
+        target=_heartbeat,
+        args=(spec["ready_file"], stop,
+              float(spec.get("heartbeat_s", 2.0))),
+        daemon=True, name="ready-heartbeat").start()
+    print(f"router {name} serving {len(replicas)} replica(s) on "
+          f"{server.url()}"
+          + (" (journal replayed)" if router.recovery else ""),
+          flush=True)
+
+    stop.wait()
+    print(f"router {name}: closing", flush=True)
+    # Give in-flight ingress threads a beat to settle before the poller
+    # join — SIGTERM is the graceful path; abrupt death is the drill.
+    time.sleep(0.1)
+    try:
+        router.close()
+    finally:
+        server.close()
+        telemetry.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

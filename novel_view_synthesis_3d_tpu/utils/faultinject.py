@@ -81,6 +81,17 @@ the chaos drills in tests/test_serve_chaos.py and `serve_bench --chaos`):
                             ring dispatch — the wedged-worker drill for
                             SamplingService.stop()'s join-timeout
                             diagnosis and the brownout step-debt drill.
+                            "*[:<seconds>]" slows EVERY dispatch — the
+                            gray-failure drill: the replica stays alive
+                            and healthy-looking but its p99 inflates,
+                            which the fleet router's demotion + hedging
+                            defenses must absorb.
+  NVS3D_FI_SERVE_HEARTBEAT_STOP
+                            "1": the replica process's ready-file
+                            heartbeat thread stops touching the file —
+                            the wedged-process drill for the fleet
+                            supervisor's heartbeat-age detector (the
+                            process is alive, its event loop is not).
 
 plus `truncate_checkpoint`, a direct helper that corrupts an on-disk Orbax
 step the way a mid-write preemption does (the checkpoint-fallback drill).
@@ -273,34 +284,55 @@ def maybe_serve_swap_fail() -> None:
         f"{n - 1} left)")
 
 
-def serve_slow_step_spec() -> Optional[Tuple[int, float]]:
-    """(dispatch, seconds) armed for the slow-ring-step drill.
+def serve_slow_step_spec() -> Optional[Tuple[Optional[int], float]]:
+    """(dispatch, seconds) armed for the slow-ring-step drill; dispatch
+    is None for the every-dispatch ("*") gray-failure form.
 
-    Env format "<dispatch>" (default 30 s) or "<dispatch>:<seconds>"."""
+    Env format "<dispatch>" (default 30 s), "<dispatch>:<seconds>", or
+    "*[:<seconds>]"."""
     raw = os.environ.get("NVS3D_FI_SERVE_SLOW_STEP", "").strip()
     if not raw:
         return None
     disp_s, _, dur_s = raw.partition(":")
     try:
-        return int(disp_s), float(dur_s) if dur_s else _DEFAULT_STALL_S
+        at = None if disp_s.strip() == "*" else int(disp_s)
+        return at, float(dur_s) if dur_s else _DEFAULT_STALL_S
     except ValueError as e:
         raise ValueError(
-            f"NVS3D_FI_SERVE_SLOW_STEP={raw!r} must be '<dispatch>' or "
-            "'<dispatch>:<seconds>'") from e
+            f"NVS3D_FI_SERVE_SLOW_STEP={raw!r} must be '<dispatch>', "
+            "'<dispatch>:<seconds>', or '*[:<seconds>]'") from e
+
+
+_slow_step_announced = False
 
 
 def maybe_serve_slow_step(dispatch: int) -> float:
     """Hook for the stepper ring: sleep if armed at exactly this dispatch
-    (the wedged-worker drill). Returns seconds slept (0.0 when inert)."""
+    (the wedged-worker drill) or at EVERY dispatch ("*" — the
+    gray-failure drill). Returns seconds slept (0.0 when inert)."""
     spec = serve_slow_step_spec()
-    if spec is None or spec[0] != dispatch:
+    if spec is None or (spec[0] is not None and spec[0] != dispatch):
         return 0.0
     import time
 
-    print(f"[faultinject] slow ring step at dispatch {dispatch} for "
-          f"{spec[1]:.1f}s (NVS3D_FI_SERVE_SLOW_STEP)", flush=True)
+    global _slow_step_announced
+    if spec[0] is not None or not _slow_step_announced:
+        _slow_step_announced = True
+        print(f"[faultinject] slow ring step at dispatch {dispatch} for "
+              f"{spec[1]:.1f}s (NVS3D_FI_SERVE_SLOW_STEP"
+              f"{', every dispatch' if spec[0] is None else ''})",
+              flush=True)
     time.sleep(spec[1])
     return spec[1]
+
+
+def serve_heartbeat_stopped() -> bool:
+    """Hook for the replica process's ready-file heartbeat thread: True
+    while NVS3D_FI_SERVE_HEARTBEAT_STOP is armed, freezing the mtime so
+    the fleet supervisor's heartbeat-age detector sees a wedged process
+    that is still answering nothing-in-particular."""
+    return os.environ.get(
+        "NVS3D_FI_SERVE_HEARTBEAT_STOP", "").strip() == "1"
 
 
 def armed() -> List[str]:

@@ -196,6 +196,51 @@ def test_pre_gate_refuses_while_breaker_open(setup, fleet):
     assert set(versions_of(cores).values()) == {v["v1"]}
 
 
+def test_replica_crash_mid_deploy_rolls_whole_fleet_back(setup, fleet):
+    """A replica DYING between its drain and its swap (the deploy
+    racing a crash) is a per-replica gate failure, not a deploy crash:
+    the report says rolled_back, the corpse is skipped during restore
+    (supervisor/resurrection owns it), and the survivors converge back
+    on the pre-deploy version and keep serving."""
+    _, _, _, conds = setup
+    router, store, v, cores = fleet
+    warm(router, conds)
+
+    class CrashOnPoke:
+        """Delegating handle for replica b that dies exactly when the
+        deploy pokes it — the tightest possible race."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.name = inner.name
+
+        def poke(self):
+            self._inner.close()  # the process is gone...
+            from novel_view_synthesis_3d_tpu.serve import (
+                ReplicaUnreachable)
+            raise ReplicaUnreachable("replica b died at the poke")
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+    router._states["b"].handle = CrashOnPoke(cores[1])
+
+    report = rolling_deploy(router, store, "stable", v["v2"], rcfg=RCFG)
+    assert report["status"] == "rolled_back", report
+    assert "died mid-deploy" in report["reason"]
+    steps = {s["replica"]: s["outcome"] for s in report["steps"]}
+    assert steps == {"a": "ok", "b": "died"}  # a swapped first, then b
+    # the corpse could not be restored; the report names it instead of
+    # aborting the survivors' rollback
+    assert report["unrestored"] == ["b"]
+    # channel and the SURVIVING replica converged back on v1
+    assert store.read_channel("stable") == v["v1"]
+    assert cores[0].healthz()["model_version"] == v["v1"]
+    # and the fleet still serves (failover off the corpse)
+    router.poll_health()
+    warm(router, conds)
+
+
 def test_slo_burned_canary_fails_probation(setup, fleet):
     _, _, _, conds = setup
     router, store, v, cores = fleet

@@ -1,0 +1,267 @@
+"""Fleet supervisor: supervised replica resurrection (docs/DESIGN.md
+"Fleet survivability").
+
+Every external edge is injected — spawn, probe, heartbeat age, clock,
+sleep — so each of the three death detectors (process exit, stale
+ready-file heartbeat, consecutive health-probe failures), the bounded
+exponential backoff, the readiness/version verification, and the loud
+give-up are drilled without a single real subprocess. serve_bench
+--fleet's chaos phase is the end-to-end drill with real processes.
+"""
+
+import json
+import os
+
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import RouterConfig
+from novel_view_synthesis_3d_tpu.obs import MetricsRegistry
+from novel_view_synthesis_3d_tpu.serve import FleetSupervisor, ReplicaSpec
+
+pytestmark = [pytest.mark.smoke]
+
+
+class FakeProc:
+    _next_pid = [1000]
+
+    def __init__(self):
+        FakeProc._next_pid[0] += 1
+        self.pid = FakeProc._next_pid[0]
+        self.rc = None
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+class FakeBus:
+    def __init__(self):
+        self.events = []
+
+    def event(self, step, kind, detail, **kw):
+        self.events.append((kind, detail))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+
+class Harness:
+    """A supervised slot with scriptable spawn/probe and a fake clock:
+    spawn immediately writes a matching ready file (so _await_ready
+    succeeds without wall-clock waits) unless told not to."""
+
+    def __init__(self, tmp_path, **rkw):
+        rkw.setdefault("supervisor_backoff_s", 1.0)
+        rkw.setdefault("supervisor_backoff_cap_s", 60.0)
+        rkw.setdefault("supervisor_max_restarts", 3)
+        rkw.setdefault("supervisor_ready_timeout_s", 5.0)
+        self.spec_path = str(tmp_path / "r0.spec.json")
+        self.ready_file = str(tmp_path / "r0.ready.json")
+        with open(self.spec_path, "w") as fh:
+            json.dump({"name": "r0", "port": 0}, fh)
+        self.spec = ReplicaSpec(name="r0", spec_path=self.spec_path,
+                                ready_file=self.ready_file,
+                                url="http://127.0.0.1:1/")
+        self.bus = FakeBus()
+        self.sleeps = []
+        self.spawned = []
+        self.spawn_ready = True         # write the ready file on spawn
+        self.probe_result = {"status": "ok", "model_version": ""}
+        self.hb_age = 0.0
+        self.now = [0.0]
+
+        def clock():
+            return self.now[0]
+
+        def sleep(s):
+            self.sleeps.append(s)
+            self.now[0] += s
+
+        def spawn(spec):
+            proc = FakeProc()
+            self.spawned.append(proc)
+            if self.spawn_ready:
+                with open(spec.ready_file, "w") as fh:
+                    json.dump({"port": 4242, "pid": proc.pid,
+                               "url": "http://127.0.0.1:4242/"}, fh)
+            return proc
+
+        def probe(spec):
+            r = self.probe_result
+            if isinstance(r, Exception):
+                raise r
+            return dict(r)
+
+        self.sup = FleetSupervisor(
+            [self.spec], rcfg=RouterConfig(**rkw), bus=self.bus,
+            registry=MetricsRegistry(), spawn=spawn, probe=probe,
+            heartbeat_age=lambda spec: self.hb_age,
+            clock=clock, sleep=sleep)
+        self.proc = FakeProc()
+        with open(self.ready_file, "w") as fh:
+            json.dump({"port": 4242, "pid": self.proc.pid,
+                       "url": "http://127.0.0.1:4242/"}, fh)
+        self.sup.adopt("r0", self.proc)
+
+    def slot(self):
+        return self.sup._slots["r0"]
+
+    def backoffs(self):
+        # _await_ready's 0.05 polls never fire (ready file written by
+        # spawn), so every recorded sleep is a restart backoff.
+        return [s for s in self.sleeps if s >= 0.1]
+
+
+def test_adopt_pins_concrete_port_into_spec(tmp_path):
+    h = Harness(tmp_path)
+    with open(h.spec_path) as fh:
+        assert json.load(fh)["port"] == 4242
+    assert h.spec.url == "http://127.0.0.1:4242/"
+
+
+def test_healthy_slot_untouched(tmp_path):
+    h = Harness(tmp_path)
+    assert h.sup.check() == []
+    assert h.slot().restarts == 0
+    assert h.bus.events == []
+    # a successful probe records the serving version for later respawns
+    h.probe_result = {"status": "ok", "model_version": "v7"}
+    h.sup.check()
+    assert h.slot().last_version == "v7"
+
+
+def test_process_exit_detected_and_resurrected(tmp_path):
+    h = Harness(tmp_path)
+    h.proc.rc = -9  # SIGKILL
+    assert h.sup.check() == ["r0"]
+    st = h.sup.status()["r0"]
+    assert st["restarts"] == 1 and st["resurrections"] == 1
+    assert not st["failed"]
+    assert h.bus.kinds() == ["replica_dead", "replica_resurrect"]
+    assert "rc=-9" in h.bus.events[0][1]
+    # the slot now tracks the NEW process
+    assert h.slot().proc is h.spawned[-1]
+    assert h.backoffs() == [1.0]
+
+
+def test_backoff_doubles_then_caps(tmp_path):
+    h = Harness(tmp_path, supervisor_backoff_s=1.0,
+                supervisor_backoff_cap_s=4.0,
+                supervisor_max_restarts=10)
+    for _ in range(4):
+        h.slot().proc.rc = 1  # kill the current incarnation
+        h.sup.check()
+    assert h.backoffs() == [1.0, 2.0, 4.0, 4.0]
+
+
+def test_stale_heartbeat_is_wedged(tmp_path):
+    h = Harness(tmp_path, supervisor_heartbeat_max_age_s=15.0)
+    h.hb_age = 99.0  # alive process, frozen event loop
+    assert h.sup.check() == ["r0"]
+    assert "heartbeat stale" in h.bus.events[0][1]
+    # the wedged process was killed before the respawn
+    assert h.proc.killed
+
+
+def test_health_probe_failures_need_consecutive_run(tmp_path):
+    h = Harness(tmp_path, supervisor_health_fails=3)
+    h.probe_result = ConnectionError("half-dead path")
+    assert h.sup.check() == []  # 1st failure: no action
+    assert h.sup.check() == []  # 2nd
+    # a single success RESETS the streak
+    h.probe_result = {"status": "ok", "model_version": ""}
+    h.sup.check()
+    assert h.slot().health_fails == 0
+    h.probe_result = ConnectionError("half-dead path")
+    h.sup.check()
+    h.sup.check()
+    assert h.sup.check() == ["r0"]  # 3rd consecutive: resurrect
+    assert "consecutive health" in h.bus.events[0][1]
+
+
+def test_respawn_with_wrong_version_is_killed(tmp_path):
+    h = Harness(tmp_path)
+    h.probe_result = {"status": "ok", "model_version": "v1"}
+    h.sup.check()  # records last_version = v1
+    h.proc.rc = 1
+    h.probe_result = {"status": "ok", "model_version": "v0-stale"}
+    h.sup.check()
+    assert h.slot().resurrections == 0
+    assert h.slot().restarts == 1  # the attempt burned budget
+    assert "replica_resurrect_failed" in h.bus.kinds()
+    assert h.spawned[-1].killed  # wrong incarnation removed
+    assert "want 'v1'" in h.bus.events[-1][1]
+
+
+def test_respawn_never_ready_burns_budget_not_success(tmp_path):
+    h = Harness(tmp_path, supervisor_ready_timeout_s=0.2)
+    h.spawn_ready = False  # respawn hangs before its ready file
+    h.proc.rc = 1
+    h.sup.check()
+    assert h.slot().resurrections == 0
+    assert "replica_resurrect_failed" in h.bus.kinds()
+    # next scan re-detects (respawned proc still ready-less but alive,
+    # probe fails against it eventually) — here just assert no crash
+    assert not h.slot().failed
+
+
+def test_giveup_after_budget_marks_slot_failed(tmp_path, capsys):
+    h = Harness(tmp_path, supervisor_max_restarts=1)
+    h.slot().proc.rc = 1
+    h.sup.check()  # restart 1/1: allowed
+    assert h.slot().resurrections == 1
+    h.slot().proc.rc = 1
+    h.sup.check()  # budget spent
+    assert h.slot().failed
+    assert "replica_giveup" in h.bus.kinds()
+    assert "GIVING UP" in capsys.readouterr().err
+    # a failed slot is never touched again
+    assert h.sup.check() == []
+
+
+def test_expected_version_reads_registry_channel_head(tmp_path):
+    h = Harness(tmp_path)
+    from novel_view_synthesis_3d_tpu.registry import RegistryStore
+
+    store = RegistryStore(str(tmp_path / "reg"))
+    with open(h.spec_path) as fh:
+        spec_json = json.load(fh)
+    spec_json["registry"] = {"dir": str(tmp_path / "reg"),
+                             "channel": "stable"}
+    with open(h.spec_path, "w") as fh:
+        json.dump(spec_json, fh)
+    h.slot().last_version = "v-old"
+    # empty channel: falls back to the dead incarnation's last version
+    assert h.sup._expected_version(h.slot()) == "v-old"
+    man = store.publish_bytes(b"weights", step=1, ema=False)
+    store.set_channel("stable", man.version)
+    assert h.sup._expected_version(h.slot()) == man.version
+
+
+def test_ready_file_age_from_mtime(tmp_path):
+    p = tmp_path / "ready.json"
+    p.write_text("{}")
+    age = FleetSupervisor._ready_file_age(
+        ReplicaSpec("x", "spec", str(p)))
+    assert age is not None and age < 60.0
+    os.utime(str(p), (1.0, 1.0))  # 1970: very stale
+    age = FleetSupervisor._ready_file_age(
+        ReplicaSpec("x", "spec", str(p)))
+    assert age > 1e6
+    assert FleetSupervisor._ready_file_age(
+        ReplicaSpec("x", "spec", str(tmp_path / "missing"))) is None
+
+
+def test_status_snapshot_shape(tmp_path):
+    h = Harness(tmp_path)
+    st = h.sup.status()["r0"]
+    assert {"pid", "alive", "restarts", "resurrections",
+            "health_fails", "failed", "model_version"} <= set(st)
+    assert st["alive"] is True

@@ -1,0 +1,454 @@
+"""Fleet survivability policy units (docs/DESIGN.md "Fleet
+survivability"): the consistent-hash affinity ring, the crash-safe
+router journal (replay + reconcile-against-live-healthz), gray-failure
+defenses (hedged dispatch, per-hop timeout budget, p99 demotion), the
+wedged-poller close diagnosis, and the HTTP transport's stale-keepalive
+retry. All against fakes/sockets — serve_bench --fleet is the
+real-process drill.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import RouterConfig
+from novel_view_synthesis_3d_tpu.serve import (
+    FleetRouter,
+    HashRing,
+    HttpReplica,
+    ReplicaUnreachable,
+    RouterJournal,
+)
+from novel_view_synthesis_3d_tpu.serve import journal as journal_mod
+
+pytestmark = [pytest.mark.smoke]
+
+S = 8
+
+
+# ---------------------------------------------------------------------------
+# fakes (mirrors tests/test_router.py, trimmed to what this file drills)
+# ---------------------------------------------------------------------------
+class FakeTicket:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def result(self, timeout=None):
+        return self._fn()
+
+
+class FakeReplica:
+    def __init__(self, name, *, step_debt=0, wedged=False):
+        self.name = name
+        self.health = {"status": "ok", "serve_state": "ok",
+                       "queue_depth": 0, "step_debt": step_debt,
+                       "brownout_level": 0, "breaker": "closed",
+                       "model_version": "v1"}
+        self.frame = np.full((S, S, 3), 0.0, np.float32)
+        self.wedged = wedged  # tickets never resolve
+        self.submits = []
+        self.traj_submits = []
+
+    def healthz(self):
+        if isinstance(self.health, Exception):
+            raise self.health
+        return dict(self.health)
+
+    def _ticket(self, value):
+        def run():
+            if self.wedged:
+                raise TimeoutError("still computing")
+            return value
+        return FakeTicket(run)
+
+    def submit(self, cond, *, seed=0, sample_steps=None,
+               guidance_weight=None, deadline_ms=None, trace_id=None):
+        self.submits.append(trace_id)
+        return self._ticket(self.frame)
+
+    def submit_trajectory(self, cond, poses, *, seed=0,
+                          sample_steps=None, guidance_weight=None,
+                          deadline_ms=None, k_max=None, trace_id=None):
+        n = int(np.asarray(poses["R2"]).shape[0])
+        self.traj_submits.append(trace_id)
+        return self._ticket(np.stack([self.frame] * n))
+
+    def metrics_text(self):
+        return ""
+
+    def begin_drain(self):
+        self.health["serve_state"] = "draining"
+
+    def drain(self, timeout_s=None):
+        return True
+
+    def poke(self):
+        pass
+
+
+class FakeBus:
+    def __init__(self):
+        self.events = []
+
+    def event(self, step, kind, detail, **kw):
+        self.events.append((kind, detail))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+
+def make_router(replicas, *, bus=None, journal=None, **rkw):
+    rkw.setdefault("retry_budget", 2)
+    r = FleetRouter(replicas, rcfg=RouterConfig(**rkw), bus=bus,
+                    journal=journal, sleep=lambda s: None)
+    r.poll_health()
+    return r
+
+
+def session_on(router, name, prefix="orb"):
+    for i in range(1000):
+        s = f"{prefix}{i}"
+        if router.ring_pin(s) == name:
+            return s
+    raise AssertionError(f"no session hashing to {name}")
+
+
+def cond():
+    return {"x": np.zeros((S, S, 3), np.float32),
+            "R1": np.eye(3, dtype=np.float32),
+            "t1": np.zeros((3,), np.float32),
+            "K": np.eye(3, dtype=np.float32)}
+
+
+def poses(n):
+    return {"R2": np.stack([np.eye(3, dtype=np.float32)] * n),
+            "t2": np.zeros((n, 3), np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+# ---------------------------------------------------------------------------
+def test_ring_lookup_is_deterministic_across_instances():
+    names = ["a", "b", "c"]
+    r1, r2 = HashRing(names), HashRing(list(reversed(names)))
+    keys = [f"orbit-{i}" for i in range(200)]
+    assert [r1.lookup(k) for k in keys] == [r2.lookup(k) for k in keys]
+    # every replica owns a share of the keyspace
+    assert {r1.lookup(k) for k in keys} == set(names)
+
+
+def test_ring_exclude_walks_clockwise_consistently():
+    ring = HashRing(["a", "b", "c"])
+    for k in [f"k{i}" for i in range(50)]:
+        home = ring.lookup(k)
+        alt = ring.lookup(k, exclude={home})
+        assert alt is not None and alt != home
+        # keys NOT homed on the excluded replica keep their home
+        if home != "a":
+            assert ring.lookup(k, exclude={"a"}) == home
+    assert ring.lookup("k0", exclude={"a", "b", "c"}) is None
+
+
+def test_router_ring_pin_matches_standalone_ring():
+    vnodes = RouterConfig().affinity_vnodes
+    router = make_router([FakeReplica("a"), FakeReplica("b")])
+    ring = HashRing(["a", "b"], vnodes=vnodes)
+    for i in range(100):
+        assert router.ring_pin(f"s{i}") == ring.lookup(f"s{i}")
+
+
+# ---------------------------------------------------------------------------
+# router journal: replay + reconcile
+# ---------------------------------------------------------------------------
+def test_journal_replay_restores_pins_and_outstanding(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = RouterJournal(path)
+    j.orbit("t-1", "orb-x", 8, 2)
+    j.pin("orb-x", "b", "a")      # failover moved the bank a -> b
+    j.hop("t-2", "a", 5)          # dispatched, never resolved: crash
+    j.hop("t-3", "b", 3)
+    j.hop_done("t-3", "b", 3, "ok")
+    j.close()
+
+    bus = FakeBus()
+    a, b = FakeReplica("a"), FakeReplica("b")
+    router = FleetRouter([a, b], rcfg=RouterConfig(),
+                         bus=bus, journal=path, sleep=lambda s: None)
+    rec = router.recovery
+    assert rec is not None
+    assert rec["pins_restored"] == 1
+    assert rec["recovered_steps"] == {"a": 5}
+    assert rec["orbits_seen"] == 1 and rec["torn"] == 0
+    assert router._pins["orb-x"] == "b"
+    assert router._states["a"].recovered == 5
+    assert "router_journal_replay" in bus.kinds()
+
+    # first successful healthz poll supersedes the journal prior
+    router.poll_health()
+    assert router._states["a"].recovered == 0
+    assert rec["reconciled"] == {"a": 5}
+    assert "router_journal_reconcile" in bus.kinds()
+    router.close()
+
+
+def test_journal_snapshot_bounds_replay_and_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = RouterJournal(path, snapshot_every=4)
+    for i in range(9):
+        j.hop(f"t{i}", "a", 1)
+        j.hop_done(f"t{i}", "a", 1, "ok")
+        j.maybe_snapshot({"a": 0})
+    j.hop("t-last", "b", 7)
+    j.close()
+    with open(path, "a") as fh:
+        fh.write('{"k": "hop", "tid": "t-torn", "repl')  # SIGKILL tear
+    rec = journal_mod.replay(path)
+    assert rec["torn"] == 1
+    assert rec["outstanding"] == {"b": 7}  # folded from newest snap
+    assert rec["records"] > 0
+
+
+def test_journal_replay_missing_file_is_fresh_start(tmp_path):
+    assert journal_mod.replay(str(tmp_path / "nope.jsonl")) is None
+    router = make_router([FakeReplica("a")],
+                         journal=str(tmp_path / "new.jsonl"))
+    assert router.recovery is None  # nothing to report
+    router.close()
+
+
+def test_journal_unpin_drops_override_on_replay(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = RouterJournal(path)
+    j.pin("s1", "b", "a")
+    j.unpin("s1")
+    j.pin("s2", "a", "b")
+    j.close()
+    rec = journal_mod.replay(path)
+    assert rec["pins"] == {"s2": "a"}
+
+
+# ---------------------------------------------------------------------------
+# gray-failure defenses
+# ---------------------------------------------------------------------------
+def test_hop_timeout_abandons_wedged_replica_and_fails_over():
+    # a is alive-but-wedged (tickets never resolve); the per-hop budget
+    # must abandon it and serve from b instead of eating the deadline.
+    a = FakeReplica("a", wedged=True)
+    b = FakeReplica("b", step_debt=50)  # a looks better: picked first
+    bus = FakeBus()
+    router = make_router([a, b], bus=bus, hop_timeout_s=0.05)
+    img = router.request(cond(), sample_steps=1, timeout_s=10.0)
+    assert img.shape == (S, S, 3)
+    assert len(a.submits) == 1 and len(b.submits) == 1
+    assert "router_hop_timeout" in bus.kinds()
+    router.close()
+
+
+def test_hedge_fires_after_delay_and_hedge_wins():
+    a = FakeReplica("a", wedged=True)   # slow primary
+    b = FakeReplica("b", step_debt=50)  # hedge target
+    bus = FakeBus()
+    router = make_router([a, b], bus=bus, hedge_delay_s=0.02)
+    img = router.request(cond(), sample_steps=1, timeout_s=10.0)
+    assert img.shape == (S, S, 3)
+    assert len(a.submits) == 1 and len(b.submits) == 1
+    assert "router_hedge" in bus.kinds()
+    router.close()
+
+
+def test_hedge_disabled_by_default():
+    a, b = FakeReplica("a"), FakeReplica("b", step_debt=50)
+    bus = FakeBus()
+    router = make_router([a, b], bus=bus)
+    router.request(cond(), sample_steps=1, timeout_s=10.0)
+    assert len(b.submits) == 0
+    assert "router_hedge" not in bus.kinds()
+    router.close()
+
+
+def test_trajectories_never_hedge():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    bus = FakeBus()
+    router = make_router([a, b], bus=bus, hedge_delay_s=0.001)
+    sess = session_on(router, "a")
+    frames = router.request_trajectory(cond(), poses(3), sample_steps=1,
+                                       session=sess, timeout_s=10.0)
+    assert frames.shape == (3, S, S, 3)
+    assert len(a.traj_submits) == 1 and len(b.traj_submits) == 0
+    assert "router_hedge" not in bus.kinds()
+    router.close()
+
+
+def test_p99_demotion_and_promotion():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    a.health["latency_p99_s"] = 0.010
+    b.health["latency_p99_s"] = 0.200  # 20x the fleet best
+    bus = FakeBus()
+    router = make_router([a, b], bus=bus, demote_p99_factor=3.0)
+    assert router._states["b"].demoted
+    assert "router_demote" in bus.kinds()
+    # demoted = dispatchable only when nothing better: singles avoid b
+    # even when b's debt is lower
+    a.health["step_debt"] = 40
+    router.poll_health()
+    assert router.pick() == "a"
+    # ...but b still serves when a is excluded (better demoted than dead)
+    assert router.pick(exclude={"a"}) == "b"
+    # recovery promotes
+    b.health["latency_p99_s"] = 0.012
+    router.poll_health()
+    assert not router._states["b"].demoted
+    assert "router_promote" in bus.kinds()
+    router.close()
+
+
+def test_demotion_needs_two_reporters():
+    # a lone p99 reporter has no peer to be slow relative to; when
+    # everyone slows together (shared cause) nobody is demoted.
+    a, b = FakeReplica("a"), FakeReplica("b")
+    a.health["latency_p99_s"] = 5.0
+    router = make_router([a, b], demote_p99_factor=3.0)
+    assert not router._states["a"].demoted
+    b.health["latency_p99_s"] = 5.1  # both slow: shared cause
+    router.poll_health()
+    assert not router._states["a"].demoted
+    assert not router._states["b"].demoted
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# wedged-poller close diagnosis
+# ---------------------------------------------------------------------------
+def test_close_wedged_poller_writes_stall_file(tmp_path):
+    entered = threading.Event()
+    release = threading.Event()
+
+    class Blocker(FakeReplica):
+        def healthz(self):
+            entered.set()
+            release.wait(30.0)  # wedged past every socket timeout
+            return dict(self.health)
+
+    bus = FakeBus()
+    router = FleetRouter([Blocker("a")], rcfg=RouterConfig(),
+                         bus=bus, run_dir=str(tmp_path), start=True)
+    try:
+        assert entered.wait(10.0)
+        with pytest.raises(RuntimeError, match="poller still alive"):
+            router.close(timeout=0.2)
+    finally:
+        release.set()
+    stall = tmp_path / "stall_router_close_0.txt"
+    assert stall.exists()
+    body = stall.read_text()
+    assert "router-health" in body  # the wedged thread's stack is there
+    assert "stall" in bus.kinds()
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport: connect timeout + stale-keepalive retry (satellite)
+# ---------------------------------------------------------------------------
+class OneShotKeepaliveServer:
+    """Accepts connections, answers ONE request per connection with a
+    keep-alive JSON 200, then closes the socket — the idle-keepalive-
+    reset shape HttpReplica must absorb by retrying once on a fresh
+    connection. `slam=True` closes without answering (reset on first
+    use: must NOT be retried)."""
+
+    def __init__(self, slam=False):
+        self.slam = slam
+        self.connections = 0
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self.connections += 1
+            with conn:
+                if self.slam:
+                    continue  # close without a byte: connection reset
+                try:
+                    conn.settimeout(5.0)
+                    data = b""
+                    while b"\r\n\r\n" not in data:
+                        chunk = conn.recv(4096)
+                        if not chunk:
+                            break
+                        data += chunk
+                    body = json.dumps({"status": "ok"}).encode()
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: application/json\r\n"
+                        b"Content-Length: "
+                        + str(len(body)).encode() + b"\r\n"
+                        b"Connection: keep-alive\r\n\r\n" + body)
+                except OSError:
+                    pass
+                # fall out of `with`: the keepalive socket dies IDLE
+
+    def close(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def test_http_retries_once_on_stale_keepalive(tmp_path):
+    srv = OneShotKeepaliveServer()
+    try:
+        h = HttpReplica("x", f"http://127.0.0.1:{srv.port}")
+        assert h.healthz()["status"] == "ok"   # conn 1, then server
+        # drops it idle
+        assert h.healthz()["status"] == "ok"   # stale reuse fails ->
+        # ONE fresh retry
+        assert h.healthz()["status"] == "ok"
+        deadline = time.monotonic() + 5.0
+        while srv.connections < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.connections == 3  # one fresh connection per call
+        h.close()
+    finally:
+        srv.close()
+
+
+def test_http_fresh_connection_reset_is_not_retried():
+    srv = OneShotKeepaliveServer(slam=True)
+    try:
+        h = HttpReplica("x", f"http://127.0.0.1:{srv.port}")
+        with pytest.raises(ReplicaUnreachable):
+            h.healthz()
+        # no blind second attempt against a server that slams fresh
+        # connections
+        assert srv.connections == 1
+        h.close()
+    finally:
+        srv.close()
+
+
+def test_http_connect_timeout_is_separate_and_bounded():
+    # 10.255.255.1:81 blackholes SYNs in most environments; whether the
+    # OS answers "unreachable" instantly or the connect timeout fires,
+    # the call must fail as ReplicaUnreachable well under the READ
+    # timeout (which is 10x longer).
+    h = HttpReplica("x", "http://10.255.255.1:81",
+                    connect_timeout_s=0.3, health_timeout_s=30.0)
+    t0 = time.monotonic()
+    with pytest.raises(ReplicaUnreachable):
+        h.healthz()
+    assert time.monotonic() - t0 < 5.0
+    h.close()

@@ -160,6 +160,17 @@ def orbit_for(n):
     return orbit_poses(n, radius=1.0, elevation=0.3)
 
 
+def session_on(router, name, prefix="orb"):
+    """A session id whose consistent-hash ring home is `name` (the
+    ring is deterministic, so scanning a few candidates always finds
+    one)."""
+    for i in range(1000):
+        s = f"{prefix}{i}"
+        if router.ring_pin(s) == name:
+            return s
+    raise AssertionError(f"no session hashing to {name}")
+
+
 # ---------------------------------------------------------------------------
 # dispatch policy
 # ---------------------------------------------------------------------------
@@ -198,33 +209,56 @@ def test_no_replica_when_all_quiesced():
     assert ei.value.retryable
 
 
-def test_affinity_pins_and_survives_debt_shift():
+def test_affinity_is_ring_home_and_survives_debt_shift():
     a, b = FakeReplica("a", step_debt=5), FakeReplica("b")
     router = make_router([a, b])
-    assert router.pick(session="orbit") == "b"
-    # b becomes the worse choice — the pin must still win (the frame
-    # bank lives there).
-    b.health["step_debt"] = 50
+    home = router.ring_pin("orbit")
+    # Affinity derives from the ring, NOT from load at first sight —
+    # that is what makes pins bit-reproducible across router restarts.
+    assert router.pick(session="orbit") == home
+    # The home becomes the worse choice — affinity must still win (the
+    # frame bank lives there), and no override pin is materialised.
+    fakes = {"a": a, "b": b}
+    fakes[home].health["step_debt"] = 50
     router.poll_health()
-    assert router.pick(session="orbit") == "b"
-    assert router.pick() == "a"  # unpinned traffic rebalances
+    assert router.pick(session="orbit") == home
+    assert "orbit" not in router._pins
+    other = "b" if home == "a" else "a"
+    assert router.pick() == other  # unpinned traffic rebalances
 
 
-def test_affinity_migrates_off_quiesced_replica():
+def test_affinity_deviation_creates_override_pin():
     a, b = FakeReplica("a", step_debt=5), FakeReplica("b")
     router = make_router([a, b])
-    assert router.pick(session="orbit") == "b"
-    router.quiesce("b")
-    assert router.pick(session="orbit") == "a"
-    assert router._affinity["orbit"] == "a"
+    home = router.ring_pin("orbit")
+    other = "b" if home == "a" else "a"
+    assert router.pick(session="orbit") == home
+    router.quiesce(home)
+    # Off the ring home -> the deviation is remembered as an override
+    # (the bank lives on `other` now), and sticks after readmission.
+    assert router.pick(session="orbit") == other
+    assert router._pins["orbit"] == other
+    router.readmit(home)
+    assert router.pick(session="orbit") == other
 
 
-def test_affinity_table_is_bounded():
-    router = make_router([FakeReplica("a")], affinity_entries=2)
-    for i in range(5):
-        router.pick(session=f"s{i}")
-    assert len(router._affinity) == 2
-    assert "s4" in router._affinity and "s0" not in router._affinity
+def test_affinity_override_table_is_bounded():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    router = make_router([a, b], affinity_entries=2)
+    # Force every session OFF its home: only deviations are stored.
+    router.quiesce("a")
+    homed_on_a = [s for s in (f"s{i}" for i in range(40))
+                  if router.ring_pin(s) == "a"][:5]
+    for s in homed_on_a:
+        assert router.pick(session=s) == "b"
+    assert len(router._pins) == 2
+    assert homed_on_a[-1] in router._pins
+    assert homed_on_a[0] not in router._pins
+    # Ring-home dispatches never create overrides at all.
+    router.readmit("a")
+    on_home = session_on(router, "a", prefix="h")
+    assert router.pick(session=on_home) == "a"
+    assert on_home not in router._pins
 
 
 # ---------------------------------------------------------------------------
@@ -270,12 +304,14 @@ def test_trajectory_retry_budget_exhausted_reraises():
     a.traj_script = [SampleAnomaly("nan"), SampleAnomaly("nan"),
                      SampleAnomaly("nan"), SampleAnomaly("nan")]
     router = make_router([a, b], retry_budget=2)
+    sess = session_on(router, "a", prefix="s")
     cond = {"x": np.zeros((S, S, 3), np.float32),
             "R1": np.eye(3, dtype=np.float32),
             "t1": np.zeros(3, np.float32),
             "K": np.eye(3, dtype=np.float32)}
     with pytest.raises(SampleAnomaly):
-        router.request_trajectory(cond, orbit_for(3), sample_steps=T)
+        router.request_trajectory(cond, orbit_for(3), sample_steps=T,
+                                  session=sess)
     # budget=2 failovers -> 3 attempts total, all on the cheap replica
     assert len(a.traj_submits) == 3 and not b.traj_submits
 
@@ -306,12 +342,13 @@ def test_trajectory_stitches_partial_frames_across_replica_death():
     death.frames = partial
     a.traj_script = [death]
     router = make_router([a, b])
+    sess = session_on(router, "a")  # orbit homes on the dying replica
     cond = {"x": np.zeros((S, S, 3), np.float32),
             "R1": np.eye(3, dtype=np.float32),
             "t1": np.zeros(3, np.float32),
             "K": np.eye(3, dtype=np.float32)}
     frames = router.request_trajectory(cond, orbit_for(5), seed=3,
-                                       sample_steps=T, session="orb")
+                                       sample_steps=T, session=sess)
     # 2 partial frames from a + 3 continuation frames from b
     assert frames.shape == (5, S, S, 3)
     assert np.array_equal(frames[1], f_a)
@@ -321,8 +358,9 @@ def test_trajectory_stitches_partial_frames_across_replica_death():
     # own pose, and only the remaining poses are submitted
     assert np.array_equal(hop["cond"]["x"], f_a)
     assert np.asarray(hop["poses"]["R2"]).shape[0] == 3
-    # the orbit's pin moved with the failover
-    assert router._affinity["orb"] == "b"
+    # the orbit's pin moved with the failover: an override, since the
+    # bank now lives off the ring home
+    assert router._pins[sess] == "b"
 
 
 def test_trajectory_anomaly_retries_in_place_with_stitch():
@@ -333,34 +371,36 @@ def test_trajectory_anomaly_retries_in_place_with_stitch():
     a.traj_script = [SampleAnomaly("nan quarantined", frames=partial,
                                    frame_index=2)]
     router = make_router([a, b])
+    sess = session_on(router, "a")
     cond = {"x": np.zeros((S, S, 3), np.float32),
             "R1": np.eye(3, dtype=np.float32),
             "t1": np.zeros(3, np.float32),
             "K": np.eye(3, dtype=np.float32)}
     frames = router.request_trajectory(cond, orbit_for(5), seed=3,
-                                       sample_steps=T, session="orb")
+                                       sample_steps=T, session=sess)
     assert frames.shape == (5, S, S, 3)
-    # transient anomaly: the retry lands back on the same (cheapest)
-    # replica, re-conditioned on the last delivered frame
+    # transient anomaly: the retry lands back on the ring home,
+    # re-conditioned on the last delivered frame — no override needed
     assert len(a.traj_submits) == 2 and not b.traj_submits
     hop = a.traj_submits[1]
     assert np.array_equal(hop["cond"]["x"], f_a)
     assert np.asarray(hop["poses"]["R2"]).shape[0] == 3
-    assert router._affinity["orb"] == "a"
+    assert sess not in router._pins
 
 
 def test_trajectory_session_rejoins_pinned_replica():
     a, b = FakeReplica("a"), FakeReplica("b", step_debt=5)
     router = make_router([a, b])
+    sess = session_on(router, "a", prefix="s")
     cond = {"x": np.zeros((S, S, 3), np.float32),
             "R1": np.eye(3, dtype=np.float32),
             "t1": np.zeros(3, np.float32),
             "K": np.eye(3, dtype=np.float32)}
-    router.request_trajectory(cond, orbit_for(2), session="s",
+    router.request_trajectory(cond, orbit_for(2), session=sess,
                               sample_steps=T)
     a.health["step_debt"] = 80  # pinned replica becomes "worse"
     router.poll_health()
-    router.request_trajectory(cond, orbit_for(2), session="s",
+    router.request_trajectory(cond, orbit_for(2), session=sess,
                               sample_steps=T)
     assert len(a.traj_submits) == 2 and not b.traj_submits
 
@@ -404,7 +444,12 @@ def test_metrics_server_serves_fleet_aggregation():
         router.close()
         body = urllib.request.urlopen(
             server.url("/metrics"), timeout=10).read().decode()
-        assert "replica=" not in body  # unhooked on close
+        # Unhooked on close: the replicas' relabeled families are gone.
+        # (The process-global registry may still hold the router's own
+        # per-replica dispatch counters from earlier tests, so assert on
+        # the fleet-extra families, not on any "replica=" label.)
+        assert "nvs3d_fake_total" not in body
+        assert "nvs3d_fake_bare" not in body
     finally:
         server.close()
 
@@ -516,14 +561,14 @@ def test_router_end_to_end_with_fleet_trace(setup, tmp_path):
 
         # Kill the replica holding the orbit's frame bank; the pinned
         # session MUST fail over and still deliver a complete orbit.
-        pinned = router._affinity["orb"]
+        pinned = router._sessions["orb"]
         victim, survivor = (ra, rb) if pinned == "a" else (rb, ra)
         victim.close()
         frames2 = router.request_trajectory(
             traj_cond(conds[1]), poses, seed=3, sample_steps=T,
             session="orb", trace_id="t-orb2")
         assert frames2.shape[0] == 3
-        assert router._affinity["orb"] == survivor.name
+        assert router._sessions["orb"] == survivor.name
         assert not router._states[victim.name].reachable
     finally:
         router.close()

@@ -1582,7 +1582,7 @@ def _await_ready(path: str, timeout_s: float) -> dict:
 
 
 def _spawn_replica(name: str, base: str, registry_dir: str, args,
-                   jax_cache: str):
+                   jax_cache: str, extra_env=None):
     """One fleet replica as a real OS process (serve/replica_main.py):
     own JAX runtime, own telemetry dir (<base>/replica_<name>/), own
     registry watcher on the 'stable' channel with poke-driven polling
@@ -1597,6 +1597,15 @@ def _spawn_replica(name: str, base: str, registry_dir: str, args,
 
     rdir = os.path.join(base, f"replica_{name}")
     os.makedirs(rdir, exist_ok=True)
+    spec_path = os.path.join(base, f"{name}.spec.json")
+    # FleetSupervisor.adopt() pins the concrete port into the spec so
+    # respawns keep the replica's URL; a rewrite must not unpin it.
+    port = 0
+    try:
+        with open(spec_path) as fh:
+            port = int(json.load(fh).get("port", 0))
+    except (OSError, ValueError, TypeError):
+        pass
     spec = {
         "name": name,
         "results_folder": rdir,
@@ -1604,7 +1613,7 @@ def _spawn_replica(name: str, base: str, registry_dir: str, args,
         "preset": args.preset,
         "sidelength": args.sidelength,
         "steps": args.steps,
-        "port": 0,
+        "port": port,
         "jax_cache_dir": jax_cache,
         "registry": {"dir": registry_dir, "channel": "stable",
                      "poll_s": 3600.0},
@@ -1621,13 +1630,16 @@ def _spawn_replica(name: str, base: str, registry_dir: str, args,
             "obs.device_poll_s": 0.0,
         },
     }
-    spec_path = os.path.join(base, f"{name}.spec.json")
     with open(spec_path, "w") as fh:
         json.dump(spec, fh)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    log = open(os.path.join(rdir, "replica.log"), "w")
+    if extra_env:
+        env.update(extra_env)
+    # Append: a supervisor respawn's output lands after its dead
+    # predecessor's, not over it.
+    log = open(os.path.join(rdir, "replica.log"), "a")
     proc = subprocess.Popen(
         [sys.executable, "-m",
          "novel_view_synthesis_3d_tpu.serve.replica_main", spec_path],
@@ -1675,8 +1687,111 @@ def _fleet_closed_loop(router, conds, n: int, concurrency: int,
             "errors": state["errors"]}
 
 
+def _free_port() -> int:
+    """A port the router process can bind — picked up front so the
+    respawn after the SIGKILL binds the SAME address and the clients'
+    retries land on the new incarnation."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _ingress_closed_loop(ingress, conds, n: int, concurrency: int,
+                         steps: int, seed0: int, prefix: str,
+                         deadline_s: float = 600.0) -> dict:
+    """Closed-loop load through the ROUTER PROCESS (an HttpReplica
+    handle over router_main's ingress). Retryable transport errors —
+    ReplicaUnreachable while the router is down, the wire round-trip of
+    the same — are ridden out with a fresh trace id per attempt (so a
+    dead incarnation's half-trace never collides with the retry's), the
+    exact client discipline sample/client.submit_with_retry encodes.
+    Only errors that exhaust the deadline count as failures."""
+    lock = threading.Lock()
+    state = {"next": 0, "lat": [], "errors": [], "retries": 0}
+
+    def client():
+        while True:
+            with lock:
+                i = state["next"]
+                if i >= n:
+                    return
+                state["next"] = i + 1
+            t0 = time.perf_counter()
+            attempt = 0
+            while True:
+                tid = f"{prefix}-{i}-a{attempt}"
+                try:
+                    ingress.submit(
+                        conds[i % len(conds)], seed=seed0 + i,
+                        sample_steps=steps,
+                        trace_id=tid).result(timeout=deadline_s)
+                    with lock:
+                        state["lat"].append(time.perf_counter() - t0)
+                    break
+                except Exception as e:
+                    attempt += 1
+                    if (not getattr(e, "retryable", False)
+                            or time.perf_counter() - t0 > deadline_s):
+                        with lock:
+                            state["errors"].append(
+                                f"{tid}: {type(e).__name__}: {e}")
+                        break
+                    with lock:
+                        state["retries"] += 1
+                    time.sleep(0.25)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return {"requests": n, "wall_s": round(wall, 3),
+            "rps": round(n / wall, 3),
+            "p99_s": round(_p99(state["lat"]), 3),
+            "retries": state["retries"], "errors": state["errors"]}
+
+
+def _counter_total(metrics_text: str, family: str) -> float:
+    """Sum every sample of one Prometheus counter family."""
+    total = 0.0
+    for line in metrics_text.splitlines():
+        if not line.startswith(family):
+            continue
+        rest = line[len(family):]
+        if rest and rest[0] not in ("{", " "):
+            continue  # a different family sharing the prefix
+        try:
+            total += float(line.rsplit(None, 1)[-1])
+        except ValueError:
+            continue
+    return total
+
+
+def _spawn_router_proc(base: str, spec_path: str) -> "object":
+    """router_main as a real OS process over an existing spec file —
+    the first spawn and the post-SIGKILL respawn run the SAME command,
+    which is the whole crash-safety claim."""
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    log = open(os.path.join(base, "router_proc", "router.log"), "a")
+    return subprocess.Popen(
+        [sys.executable, "-m",
+         "novel_view_synthesis_3d_tpu.serve.router_main", spec_path],
+        stdout=log, stderr=subprocess.STDOUT, env=env, cwd=repo_root)
+
+
 def fleet_bench(args) -> dict:
-    """Three judged drills over one real 4-process fleet:
+    """Six judged drills over one real 4-process fleet:
 
       scaling   closed-loop RPS with 1 replica in rotation vs all N —
                 the router must deliver near-linear fan-out (>= 3.2x at
@@ -1684,14 +1799,32 @@ def fleet_bench(args) -> dict:
       chaos     SIGKILL one replica while it owns a mid-flight orbit
                 and carries single-shot traffic — zero failed requests,
                 every failover hop names the victim (blast radius), and
-                the cross-replica trace reconstructs clean;
+                the cross-replica trace reconstructs clean — then the
+                FleetSupervisor must RESURRECT the victim into the same
+                spec/port under load, verified ready + healthy + on the
+                channel-head version, and the fleet serves through it;
       deploy    three scripted rolling deploys on the survivors: a good
                 version (zero-downtime, status 'deployed'), a corrupt
                 artifact (the swap breaker opens -> auto-rollback), and
                 a version whose canary gets an SLO-burn burst during
                 probation (the PR 14 gate -> auto-rollback) — with
                 closed-loop router traffic across all three asserting
-                zero failures.
+                zero failures;
+      restart   the ROUTER itself as a process (router_main ingress)
+                SIGKILLed mid-load: clients ride the outage on
+                retryable errors (zero failures), the respawn replays
+                the journal (recovery provenance in its ready file),
+                and the consistent-hash ring digest is bit-identical
+                across incarnations — every affinity pin re-derives
+                from zero recovered state;
+      gray      one replica comes back SLOW (fault-injected step delay,
+                not dead — the failure health checks can't see): hedged
+                dispatch + p99 demotion must keep fleet p99 within 2x
+                the steady state, zero failures, hedges observed;
+      recompile survivors that were never restarted end the whole
+                gauntlet with their program-build counters exactly
+                where warmup left them — kills, deploys, and hedges
+                never recompile warm replicas.
     """
     from novel_view_synthesis_3d_tpu import obs
     from novel_view_synthesis_3d_tpu.config import RouterConfig, get_preset
@@ -1699,6 +1832,10 @@ def fleet_bench(args) -> dict:
     from novel_view_synthesis_3d_tpu.registry import RegistryStore
     from novel_view_synthesis_3d_tpu.serve import FleetRouter, HttpReplica
     from novel_view_synthesis_3d_tpu.serve.deploy import rolling_deploy
+    from novel_view_synthesis_3d_tpu.serve.fleet_supervisor import (
+        FleetSupervisor,
+        ReplicaSpec,
+    )
     from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
 
     base = args.fleet_dir or "/tmp/nvs3d_fleet_bench"
@@ -1727,6 +1864,8 @@ def fleet_bench(args) -> dict:
     names = [f"r{i}" for i in range(n)]
     procs = {}
     handles = []
+    supervisor = None
+    router_proc = None
     try:
         # r0 first: its first request compiles the (bucket=1) program
         # into the shared persistent cache; r1..rN then spawn into a
@@ -1754,6 +1893,11 @@ def fleet_bench(args) -> dict:
                 for h in handles[1:]]
         for _, t in warm:
             t.result(timeout=600)
+        # Program-build counters after warmup: the recompile drill at
+        # the end asserts these stay FLAT on every replica the
+        # supervisor never restarted.
+        builds0 = {h.name: int(h.healthz().get("programs_built", -1))
+                   for h in handles}
 
         router_dir = os.path.join(base, "router")
         telemetry = obs.RunTelemetry.create(
@@ -1767,6 +1911,49 @@ def fleet_bench(args) -> dict:
                              tracer=telemetry.tracer, bus=telemetry.bus,
                              start=True)
         router.poll_health()
+
+        # -- fleet supervisor ---------------------------------------
+        # Adopts the bench-spawned processes (pinning each concrete
+        # port into its spec) and owns every respawn from here on. The
+        # slow_env overlay is how the gray-failure drill later arranges
+        # for one replica to come back SLOW instead of healthy.
+        slow_env = {}
+
+        def respawn(spec):
+            return _spawn_replica(spec.name, base, registry_dir, args,
+                                  jax_cache,
+                                  extra_env=slow_env.get(spec.name))
+
+        sup_rcfg = RouterConfig(
+            supervisor_max_restarts=6,
+            supervisor_backoff_s=0.5,
+            supervisor_backoff_cap_s=2.0,
+            supervisor_heartbeat_max_age_s=60.0,
+            supervisor_health_fails=8,
+            supervisor_poll_s=0.5,
+            supervisor_ready_timeout_s=args.fleet_spawn_timeout_s)
+        supervisor = FleetSupervisor(
+            [ReplicaSpec(name=name,
+                         spec_path=os.path.join(base,
+                                                f"{name}.spec.json"),
+                         ready_file=os.path.join(base, f"{name}.ready"))
+             for name in names],
+            rcfg=sup_rcfg, bus=telemetry.bus, spawn=respawn)
+        for name in names:
+            supervisor.adopt(name, procs[name])
+        supervisor.start()
+
+        def await_resurrection(name, want, timeout_s):
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                st = supervisor.status()[name]
+                if st["resurrections"] >= want and st["alive"]:
+                    procs[name] = supervisor.procs()[name]
+                    return True
+                if st["failed"]:
+                    return False
+                time.sleep(0.25)
+            return False
 
         # -- scaling lane -------------------------------------------
         for name in names[1:]:
@@ -1809,9 +1996,10 @@ def fleet_bench(args) -> dict:
         ot.start()
         deadline = time.time() + 15
         while (time.time() < deadline
-               and "chaos-orbit" not in router._affinity):
+               and "chaos-orbit" not in router._sessions):
             time.sleep(0.02)
-        victim = router._affinity.get("chaos-orbit", names[-1])
+        victim = (router._sessions.get("chaos-orbit")
+                  or router.ring_pin("chaos-orbit") or names[-1])
         # Let the orbit get properly mid-flight on the victim's ring,
         # then kill -9: no drain, no goodbye — the transport must
         # surface ReplicaUnreachable and the router must fail over.
@@ -1823,12 +2011,39 @@ def fleet_bench(args) -> dict:
         ot.join(timeout=600)
         procs[victim].wait(timeout=30)
         survivors = [name for name in names if name != victim]
+
+        # Resurrection under load: the supervisor must notice the
+        # corpse, respawn it into the SAME spec (same port — the
+        # router's handle stays valid), verify ready + healthy + on the
+        # channel-head version, and the router readmits it through its
+        # natural health poll. The fleet then serves THROUGH the
+        # resurrected replica with zero failures.
+        resurrected = await_resurrection(victim, 1,
+                                         args.fleet_spawn_timeout_s)
+        victim_back = False
+        if resurrected:
+            back_by = time.time() + 60
+            while time.time() < back_by:
+                snap = router.poll_health().get(victim)
+                if snap is not None:
+                    victim_back = True
+                    break
+                time.sleep(0.25)
+        resur_load = _fleet_closed_loop(
+            router, conds, args.fleet_requests, args.fleet_concurrency,
+            args.steps, 3500, "resur")
         chaos = {
             "victim": victim,
             "orbit": orbit_out,
             "single": single,
             "failed": len(single["errors"])
             + (0 if "frames" in orbit_out else 1),
+            "resurrection": {
+                "resurrected": resurrected,
+                "victim_back_in_rotation": victim_back,
+                "supervisor": supervisor.status()[victim],
+                "load": resur_load,
+            },
         }
 
         # -- rolling-deploy lane ------------------------------------
@@ -1947,9 +2162,158 @@ def fleet_bench(args) -> dict:
             "final_versions": final_versions,
         }
 
-        # -- fleet trace reconstruction -----------------------------
+        # The in-process router's work is done; the remaining drills
+        # target the router AS A PROCESS (router_main ingress). Close
+        # it cleanly so its telemetry is flushed for reconstruction.
         router.close()
         telemetry.finalize()
+
+        # -- router-restart lane (crash-safe ingress) ----------------
+        # The router runs as its own process over ALL N replicas
+        # (including the resurrected victim). Clients speak the replica
+        # wire protocol to it. Mid-load it is SIGKILLed — no drain, no
+        # journal flush beyond the per-append fsync discipline — and
+        # respawned from the same spec: clients ride the outage on
+        # retryable errors, the respawn replays the journal (recovery
+        # provenance lands in its ready file), and the consistent-hash
+        # ring digest must be BIT-IDENTICAL across incarnations: every
+        # session's home re-derives from zero recovered state.
+        router_port = _free_port()
+        rproc_dir = os.path.join(base, "router_proc")
+        os.makedirs(rproc_dir, exist_ok=True)
+        rspec = {
+            "name": "ingress",
+            "results_folder": rproc_dir,
+            "ready_file": os.path.join(base, "router.ready"),
+            "port": router_port,
+            "replicas": [{"name": h.name, "url": h.base_url,
+                          "run_dir": h.run_dir} for h in handles],
+            "journal": os.path.join(rproc_dir, "router_journal.jsonl"),
+            "heartbeat_s": 1.0,
+            "rcfg": {
+                "health_poll_s": 0.25,
+                "health_ttl_s": 5.0,
+                "retry_budget": 3,
+                # Gray-failure defenses, exercised by the NEXT lane:
+                # hedge stalled singles at ~1.5x the healthy service
+                # time; demote a replica whose reported p99 is 4x the
+                # best peer's.
+                "hedge_delay_s": 1.5 * args.steps
+                * args.fleet_floor_ms / 1000.0,
+                "demote_p99_factor": 4.0,
+            },
+        }
+        rspec_path = os.path.join(base, "router.spec.json")
+        with open(rspec_path, "w") as fh:
+            json.dump(rspec, fh)
+        router_proc = _spawn_router_proc(base, rspec_path)
+        ready1 = _await_ready(rspec["ready_file"],
+                              args.fleet_spawn_timeout_s)
+        ingress = HttpReplica("ingress", ready1["url"],
+                              connect_timeout_s=5.0)
+        digest_before = ingress.healthz()["affinity"]["ring_digest"]
+
+        kill_out = {}
+
+        def kill_load():
+            kill_out.update(_ingress_closed_loop(
+                ingress, conds, args.fleet_requests * 2,
+                args.fleet_concurrency, args.steps, 5000, "rr"))
+
+        kt = threading.Thread(target=kill_load, daemon=True)
+        kt.start()
+        # Let the load get properly mid-flight, then kill -9 and
+        # respawn the same spec while the clients are still retrying.
+        time.sleep(3.0 * args.steps * args.fleet_floor_ms / 1000.0)
+        router_proc.kill()
+        router_proc.wait(timeout=30)
+        try:
+            os.remove(rspec["ready_file"])
+        except OSError:
+            pass
+        router_proc = _spawn_router_proc(base, rspec_path)
+        ready2 = _await_ready(rspec["ready_file"],
+                              args.fleet_spawn_timeout_s)
+        kt.join(timeout=900)
+        digest_after = ingress.healthz()["affinity"]["ring_digest"]
+        # Steady-state reference through the SAME ingress, all
+        # replicas healthy and fast — the gray lane's p99 yardstick.
+        steady = _ingress_closed_loop(
+            ingress, conds, args.fleet_requests * 2,
+            args.fleet_concurrency, args.steps, 6000, "steady")
+        restart = {
+            "load": kill_out,
+            "steady": steady,
+            "recovery": (ready2 or {}).get("recovery"),
+            "ring_digest_before": digest_before,
+            "ring_digest_after": digest_after,
+            "ring_digest_match": digest_before == digest_after,
+        }
+
+        # -- gray-failure lane (slow replica, hedged dispatch) -------
+        # One survivor comes back SLOW: its respawn inherits a fault-
+        # injected per-step delay the health checks cannot see (healthz
+        # stays ok). Hedging + p99 demotion must keep fleet p99 within
+        # 2x the steady state with zero failures.
+        slowpoke = sorted(nm for nm in survivors if nm != victim)[0]
+        slow_s = 4.0 * args.fleet_floor_ms / 1000.0
+        slow_env[slowpoke] = {"NVS3D_FI_SERVE_SLOW_STEP": f"*:{slow_s}"}
+        hedges_before = _counter_total(ingress.metrics_text(),
+                                       "nvs3d_router_hedges_total")
+        procs[slowpoke].kill()
+        slow_ok = await_resurrection(slowpoke, 1,
+                                     args.fleet_spawn_timeout_s)
+        # The ingress readmits the respawn through its natural health
+        # poll; the load must find the slowpoke IN rotation, or the
+        # drill would measure failover instead of gray-failure hedging.
+        back_by = time.time() + 60
+        while time.time() < back_by:
+            snap = ingress.healthz()["replicas"].get(slowpoke, {})
+            if snap.get("reachable") and snap.get("in_rotation"):
+                break
+            time.sleep(0.25)
+        gray_load = _ingress_closed_loop(
+            ingress, conds, args.fleet_requests * 2,
+            args.fleet_concurrency, args.steps, 7000, "gray")
+        hedges_after = _counter_total(ingress.metrics_text(),
+                                      "nvs3d_router_hedges_total")
+        gray = {
+            "slowpoke": slowpoke,
+            "slow_step_s": slow_s,
+            "respawned_slow": slow_ok,
+            "load": gray_load,
+            "steady_p99_s": steady["p99_s"],
+            "p99_ratio": round(
+                gray_load["p99_s"] / max(steady["p99_s"], 1e-9), 3),
+            "hedges": hedges_after - hedges_before,
+        }
+
+        # -- recompile audit ----------------------------------------
+        # Replicas the supervisor never restarted must end the whole
+        # gauntlet with their program-build counters untouched —
+        # failover, deploys, router kills, and hedges never recompile
+        # a warm replica. (Restarted replicas are new PROCESSES whose
+        # counters restarted from zero; they are excluded, their
+        # warm-cache boot is covered by the spawn path.)
+        sup_status = supervisor.status()
+        builds1 = {h.name: int(h.healthz().get("programs_built", -1))
+                   for h in handles}
+        never_restarted = [nm for nm in names
+                           if sup_status[nm]["restarts"] == 0]
+        recompiles = {
+            "builds_after_warmup": builds0,
+            "builds_final": builds1,
+            "never_restarted": never_restarted,
+            "flat": all(builds1[nm] == builds0[nm]
+                        for nm in never_restarted),
+        }
+
+        # -- fleet trace reconstruction -----------------------------
+        # The subprocess router's telemetry dir (router_proc/) is
+        # deliberately OUTSIDE the router/ + replica_* fleet layout:
+        # a SIGKILLed incarnation's half-traces are the drill, not a
+        # reconstruction defect. Replica-side rows from its traffic
+        # still verify below.
         per_source = reqtrace.load_fleet_rows(base)
         fleet_tl = reqtrace.reconstruct_fleet(per_source)
         problems = reqtrace.verify_fleet(fleet_tl, per_source)
@@ -1966,14 +2330,27 @@ def fleet_bench(args) -> dict:
             "problem_count": len(problems),
         }
         return {"scaling": scaling, "chaos": chaos, "deploy": deploy,
-                "trace": trace, "fleet_dir": base}
+                "restart": restart, "gray": gray,
+                "recompiles": recompiles, "trace": trace,
+                "fleet_dir": base}
     finally:
         import signal as _signal
 
+        # The supervisor must stand down BEFORE the teardown SIGTERMs,
+        # or it would dutifully resurrect everything we retire; it
+        # also holds the freshest process handle for every respawned
+        # slot.
+        if supervisor is not None:
+            supervisor.close()
+            for nm, proc in supervisor.procs().items():
+                procs[nm] = proc
+        if router_proc is not None and router_proc.poll() is None:
+            router_proc.send_signal(_signal.SIGTERM)
         for proc in procs.values():
             if proc.poll() is None:
                 proc.send_signal(_signal.SIGTERM)
-        for proc in procs.values():
+        for proc in list(procs.values()) + (
+                [router_proc] if router_proc is not None else []):
             try:
                 proc.wait(timeout=120)
             except Exception:
@@ -2003,6 +2380,21 @@ def check_fleet(fleet: dict) -> int:
               f"a single replica kill (orbit={chaos['orbit']}, "
               f"single errors={chaos['single']['errors'][:3]}) — "
               "failover must be transparent", file=sys.stderr)
+        rc = 1
+    resur = chaos["resurrection"]
+    if not resur["resurrected"]:
+        print(f"error: the supervisor never resurrected the killed "
+              f"replica {chaos['victim']} "
+              f"(status={resur['supervisor']})", file=sys.stderr)
+        rc = 1
+    if not resur["victim_back_in_rotation"]:
+        print(f"error: resurrected replica {chaos['victim']} never "
+              "re-entered router rotation", file=sys.stderr)
+        rc = 1
+    if resur["load"]["errors"]:
+        print(f"error: {len(resur['load']['errors'])} request(s) "
+              "failed while serving through the resurrected replica: "
+              f"{resur['load']['errors'][:3]}", file=sys.stderr)
         rc = 1
     if chaos["failovers"] < 1:
         print("error: chaos lane recorded no failover hops — the kill "
@@ -2038,6 +2430,64 @@ def check_fleet(fleet: dict) -> int:
     if wrong:
         print(f"error: fleet did not converge on {want} after the "
               f"rollbacks: {wrong}", file=sys.stderr)
+        rc = 1
+    restart = fleet["restart"]
+    if restart["load"]["errors"]:
+        print(f"error: {len(restart['load']['errors'])} client "
+              "request(s) failed across the router-process kill — "
+              "retryable-error ride-through violated: "
+              f"{restart['load']['errors'][:3]}", file=sys.stderr)
+        rc = 1
+    if restart["load"]["retries"] < 1:
+        print("error: router-restart lane saw zero client retries — "
+              "the kill landed after the load drained, the drill "
+              "proved nothing", file=sys.stderr)
+        rc = 1
+    rec = restart["recovery"] or {}
+    if int(rec.get("records") or 0) < 1:
+        print(f"error: the respawned router replayed no journal "
+              f"records (recovery={restart['recovery']}) — crash-safe "
+              "restart unproven", file=sys.stderr)
+        rc = 1
+    if not restart["ring_digest_match"]:
+        print(f"error: consistent-hash ring digest changed across the "
+              f"router restart ({restart['ring_digest_before']} -> "
+              f"{restart['ring_digest_after']}) — affinity pins are "
+              "NOT bit-reproduced from zero recovered state",
+              file=sys.stderr)
+        rc = 1
+    if restart["steady"]["errors"]:
+        print(f"error: steady-state lane failed requests: "
+              f"{restart['steady']['errors'][:3]}", file=sys.stderr)
+        rc = 1
+    gray = fleet["gray"]
+    if not gray["respawned_slow"]:
+        print(f"error: the gray lane's slow respawn of "
+              f"{gray['slowpoke']} never came back", file=sys.stderr)
+        rc = 1
+    if gray["load"]["errors"]:
+        print(f"error: {len(gray['load']['errors'])} request(s) "
+              "failed with a slow replica in rotation: "
+              f"{gray['load']['errors'][:3]}", file=sys.stderr)
+        rc = 1
+    if gray["p99_ratio"] > 2.0:
+        print(f"error: fleet p99 with one slow replica is "
+              f"{gray['p99_ratio']}x steady state "
+              f"({gray['load']['p99_s']}s vs {gray['steady_p99_s']}s) "
+              "— hedging/demotion failed to contain the gray failure "
+              "(<= 2x required)", file=sys.stderr)
+        rc = 1
+    if gray["hedges"] < 1:
+        print("error: gray lane recorded no hedged dispatches — the "
+              "slow replica never stalled a request past the hedge "
+              "delay, the drill proved nothing", file=sys.stderr)
+        rc = 1
+    recompiles = fleet["recompiles"]
+    if not recompiles["flat"]:
+        print(f"error: program-build counters moved on never-restarted "
+              f"replicas (after warmup {recompiles['builds_after_warmup']}"
+              f" -> final {recompiles['builds_final']}) — the gauntlet "
+              "recompiled a warm replica", file=sys.stderr)
         rc = 1
     if fleet["trace"]["problem_count"]:
         print(f"error: {fleet['trace']['problem_count']} fleet trace "
@@ -2179,12 +2629,22 @@ def main() -> int:
                          "(>= 3.2x RPS at N=4 vs N=1 over step-floor-"
                          "paced replicas), chaos (SIGKILL the replica "
                          "holding a mid-flight orbit, zero failed "
-                         "requests, blast radius = the victim), and "
-                         "three scripted rolling deploys (good / "
-                         "corrupt-artifact breaker rollback / SLO-"
-                         "burned canary rollback) under live load, "
-                         "plus a cross-replica trace reconstruction "
-                         "audit (rc=1 on any violation)")
+                         "requests, blast radius = the victim, then "
+                         "supervised RESURRECTION of the victim into "
+                         "the same spec/port under load), three "
+                         "scripted rolling deploys (good / corrupt-"
+                         "artifact breaker rollback / SLO-burned "
+                         "canary rollback) under live load, a router-"
+                         "PROCESS SIGKILL mid-load (clients ride the "
+                         "restart on retryable errors, the journal "
+                         "replays, the consistent-hash ring digest is "
+                         "bit-identical across incarnations), a gray-"
+                         "failure drill (one replica respawned SLOW; "
+                         "hedging + p99 demotion keep fleet p99 <= 2x "
+                         "steady state), a zero-recompile audit on "
+                         "never-restarted replicas, and a cross-"
+                         "replica trace reconstruction audit (rc=1 on "
+                         "any violation)")
     ap.add_argument("--fleet-replicas", type=int, default=4,
                     help="replica process count for --fleet")
     ap.add_argument("--fleet-requests", type=int, default=12,
