@@ -71,6 +71,28 @@ class ModelConfig:
     # Shared-stats GN (groupnorm_per_frame=False) and over-VMEM slabs fall
     # back to XLA automatically.
     use_fused_groupnorm: Any = False
+    # Fused single-kernel SERVING attention (ops/serving_attention.py):
+    # a forward-only Pallas kernel that keeps one (batch·head) attention
+    # head entirely in VMEM — scores, softmax, and the value contraction
+    # in one pass, no backward residuals. Sized for serving token counts
+    # (H·W at the attn resolutions); shapes whose slabs exceed the VMEM
+    # budget fall back to the XLA path per shape, and every decision is
+    # recorded in a coverage registry that tools/summarize_bench.py
+    # renders. "auto" enables it on TPU backends only; True forces the
+    # kernel (interpret mode off-TPU — exact, slow, the tier-1 parity
+    # path); False keeps XLA. Takes precedence over use_flash_attention
+    # when both resolve on (flash keeps the trained backward path; this
+    # kernel is inference-only).
+    use_serving_attention: Any = False
+    # Fused GroupNorm → FiLM-modulate → SiLU block epilogue
+    # (ops/fused_epilogue.py): the ResnetBlock tail after the FiLM Dense
+    # — normalize, scale/shift by the per-pixel FiLM tensors, activate —
+    # runs as ONE Pallas pass per (B·F) row instead of three HBM
+    # round-trips. The FiLM Dense projection itself stays in XLA (it is
+    # a matmul; the kernel fuses the bandwidth-bound elementwise tail).
+    # Same flag semantics as use_fused_groupnorm; requires
+    # groupnorm_per_frame=True and falls back to XLA for over-VMEM slabs.
+    use_fused_epilogue: Any = False
     # Sequence parallelism: shard the H·W token axis of every attention over
     # the mesh 'seq' axis and run ring attention (parallel/ring_attention.py,
     # ppermute over ICI). Requires mesh.seq > 1 and token counts divisible
@@ -570,6 +592,21 @@ class ServeConfig:
     # stall-style all-thread-stacks diagnosis and raises instead of
     # silently leaking a wedged thread (PR 2 watchdog convention).
     stop_timeout_s: float = 10.0
+    # Conditioning cache (docs/DESIGN.md "Conditioning cache & fused
+    # serving attention"): compute XUNet's conditioning branch — the
+    # per-level pose/FiLM embeddings and the cond-frame stem features,
+    # which never change within a request — ONCE at admission (once per
+    # frame-bank encode for trajectories) instead of inside every
+    # denoise step. The activations live device-resident on the ring
+    # slot alongside z/keys/banks and enter the step program as device
+    # arguments, so program identity stays bucket/shape-only; the CFG
+    # uncond (cond_mask=0) half is cached globally per (H, W) — it is
+    # pose-independent — so guidance pairs share one encode, and a hot
+    # swap invalidates it (in-flight slots die with the drain, pinned
+    # to their start version). False (default) keeps the in-jit encode;
+    # True requires scheduler='step'. Cached and uncached programs are
+    # bit-identical single-key (tests/test_cond_cache.py).
+    cond_cache: bool = False
     # Minimum wall-clock per ring dispatch, milliseconds (0 = off). After
     # the device work of a dispatch completes, the worker sleeps out the
     # residual — a PACING floor, not a slowdown of the device program.
@@ -1158,6 +1195,18 @@ class Config:
                 "denoise steps; the whole-request dispatcher has no ring "
                 "for them to re-enter (set serve.scheduler='step' or "
                 "serve.k_max=0)")
+        if sv.cond_cache not in (True, False):
+            errors.append(
+                f"serve.cond_cache={sv.cond_cache!r} must be True or "
+                "False (the admission-time conditioning cache is host "
+                "orchestration, not a backend kernel — there is no "
+                "'auto' tier)")
+        elif sv.cond_cache and sv.scheduler != "step":
+            errors.append(
+                "serve.cond_cache=True requires serve.scheduler='step' "
+                "— cached cond activations live on stepper ring slots; "
+                "the whole-request dispatcher has no slot to pin them "
+                "to (set serve.scheduler='step' or cond_cache=False)")
         if sv.max_frames < 1:
             errors.append(
                 f"serve.max_frames={sv.max_frames} must be >= 1 (it "
@@ -1284,6 +1333,21 @@ class Config:
                 "cannot run as one fused step (use 'auto' to fuse where "
                 "possible; the step scheduler's first-order dpm++ "
                 "fallback still fuses)")
+        for fname in ("use_serving_attention", "use_fused_epilogue"):
+            fv = getattr(self.model, fname)
+            if fv not in (True, False, "auto"):
+                errors.append(
+                    f"model.{fname}={fv!r} must be True, False, or "
+                    "'auto' (Pallas serving kernel; 'auto' = TPU "
+                    "backends only, interpret mode when forced True "
+                    "off-TPU)")
+        if (self.model.use_fused_epilogue is True
+                and not self.model.groupnorm_per_frame):
+            errors.append(
+                "model.use_fused_epilogue=True requires "
+                "model.groupnorm_per_frame=True — the epilogue kernel "
+                "normalizes one (frame, H·W, C) slab per grid row; "
+                "shared-stats GN spans frames and keeps the XLA path")
         rg = self.registry
         if rg.publish_every < 0:
             errors.append(

@@ -23,6 +23,10 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
+from novel_view_synthesis_3d_tpu.ops.fused_epilogue import (
+    fits_vmem as epilogue_fits_vmem,
+    fused_film_epilogue,
+)
 from novel_view_synthesis_3d_tpu.ops.fused_groupnorm import (
     fits_vmem,
     fused_group_norm,
@@ -134,18 +138,39 @@ class GroupNorm(nn.Module):
 
 
 class FiLM(nn.Module):
-    """Feature-wise linear modulation (reference model/xunet.py:54-61)."""
+    """Feature-wise linear modulation (reference model/xunet.py:54-61).
+
+    `h=None` returns the raw (scale, shift) pair instead of applying the
+    modulation — the fused-epilogue path (ops/fused_epilogue.py) feeds
+    them to the Pallas kernel while this module keeps sole ownership of
+    the Dense projection (same param tree either way)."""
 
     features: int
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, h: jnp.ndarray, emb: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, h: Optional[jnp.ndarray], emb: jnp.ndarray):
         emb = nn.Dense(2 * self.features, dtype=self.dtype,
                        param_dtype=self.param_dtype)(nonlinearity(emb))
         scale, shift = jnp.split(emb, 2, axis=-1)
+        if h is None:
+            return scale, shift
         return h * (1.0 + scale) + shift
+
+
+class _GNParamsNested(nn.Module):
+    """_GNParams one level down (…/GroupNorm_1/GroupNorm_0/{scale,bias}):
+    the tree path a GroupNorm module's nn.GroupNorm child would occupy,
+    so the fused-epilogue path shares the XLA path's checkpoint layout
+    (instantiated with name='GroupNorm_1', the auto-name the second
+    GroupNorm in a ResnetBlock gets)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        return _GNParams(features=self.features, name="GroupNorm_0")()
 
 
 class ResnetBlock(nn.Module):
@@ -161,6 +186,7 @@ class ResnetBlock(nn.Module):
     resample: Optional[str] = None
     per_frame_gn: bool = True
     fused_gn: bool = False
+    fused_epilogue: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -181,8 +207,37 @@ class ResnetBlock(nn.Module):
             h = updown(h)
             h_in = updown(h_in)
         h = FrameConv(features, **kw)(h)
-        h = FiLM(features=features, **kw)(GroupNorm(**gn_kw)(h), emb)
-        h = nonlinearity(h)
+        B, F, H, W, _ = h.shape
+        if (self.fused_epilogue and self.per_frame_gn
+                and epilogue_fits_vmem(H * W, features, h.dtype)):
+            # Fused GN → FiLM-modulate → swish tail (one HBM pass,
+            # ops/fused_epilogue.py). The FiLM Dense stays in XLA; GN
+            # params ride the XLA path's GroupNorm_1/GroupNorm_0 tree.
+            gscale, gbias = _GNParamsNested(features=features,
+                                            name="GroupNorm_1")()
+            fscale, fshift = FiLM(features=features, **kw)(None, emb)
+            flat = (B * F, H * W, features)
+            h = fused_film_epilogue(
+                h.reshape(flat),
+                gscale, gbias,
+                jnp.broadcast_to(fscale, h.shape).reshape(flat),
+                jnp.broadcast_to(fshift, h.shape).reshape(flat),
+                32, 1e-6, self.dtype).reshape(B, F, H, W, features)
+        else:
+            if self.fused_epilogue and self.per_frame_gn:
+                from novel_view_synthesis_3d_tpu.utils.profiling import (
+                    log_once)
+
+                log_once(
+                    ("fused_epilogue_fallback", H * W, features,
+                     str(h.dtype)),
+                    f"note: fused block epilogue falling back to XLA for "
+                    f"slab (H·W={H * W}, C={features}, {h.dtype}): 3× "
+                    "resident rows exceed the kernel's VMEM budget "
+                    "(ops/fused_epilogue.py) — this level pays the "
+                    "three-pass GN→FiLM→swish tail")
+            h = FiLM(features=features, **kw)(GroupNorm(**gn_kw)(h), emb)
+            h = nonlinearity(h)
         h = nn.Dropout(rate=self.dropout)(h, deterministic=not train)
         h = FrameConv(features, zero_init=True, **kw)(h)
         if C != features:
@@ -201,6 +256,7 @@ class AttnLayer(nn.Module):
     attn_heads: int = 4
     out_proj: bool = False
     use_flash: bool = False
+    use_serving: bool = False  # forward-only Pallas serving kernel
     mesh: Optional[object] = None  # jax Mesh → ring attention over 'seq'
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
@@ -221,6 +277,15 @@ class AttnLayer(nn.Module):
                 ring_self_attention)
             out = ring_self_attention(qh, kh, vh, self.mesh,
                                       batch_axis=DATA_AXIS)
+        elif self.use_serving:
+            # Inference twin of the flash kernel: no residuals, no VJP,
+            # per-shape VMEM gate + coverage registry
+            # (ops/serving_attention.py). Takes precedence over
+            # use_flash — both fuse, this one is trace- and HBM-lighter
+            # for forward-only step programs.
+            from novel_view_synthesis_3d_tpu.ops.serving_attention import (
+                serving_attention)
+            out = serving_attention(qh, kh, vh)
         elif self.use_flash:
             from novel_view_synthesis_3d_tpu.ops.flash_attention import (
                 flash_attention)
@@ -247,6 +312,7 @@ class AttnBlock(nn.Module):
     attn_heads: int = 4
     out_proj: bool = False
     use_flash: bool = False
+    use_serving: bool = False
     mesh: Optional[object] = None
     per_frame_gn: bool = True
     fused_gn: bool = False
@@ -260,7 +326,8 @@ class AttnBlock(nn.Module):
                       dtype=self.dtype)(h_in)
         tokens = h.reshape(B, F, H * W, C)
         layer = AttnLayer(attn_heads=self.attn_heads, out_proj=self.out_proj,
-                          use_flash=self.use_flash, mesh=self.mesh,
+                          use_flash=self.use_flash,
+                          use_serving=self.use_serving, mesh=self.mesh,
                           dtype=self.dtype, param_dtype=self.param_dtype)
         if self.attn_type == "self":
             out = layer(q=tokens.reshape(B * F, H * W, C),
@@ -292,11 +359,13 @@ class XUNetBlock(nn.Module):
     attn_heads: int = 4
     attn_out_proj: bool = False
     attn_use_flash: bool = False
+    attn_use_serving: bool = False
     attn_mesh: Optional[object] = None
     dropout: float = 0.0
     train: bool = False  # attribute (not call arg) so nn.remat needs no statics
     per_frame_gn: bool = True
     fused_gn: bool = False
+    fused_epilogue: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -305,9 +374,11 @@ class XUNetBlock(nn.Module):
         kw = dict(per_frame_gn=self.per_frame_gn, fused_gn=self.fused_gn,
                   dtype=self.dtype, param_dtype=self.param_dtype)
         attn_kw = dict(attn_heads=self.attn_heads, out_proj=self.attn_out_proj,
-                       use_flash=self.attn_use_flash, mesh=self.attn_mesh,
+                       use_flash=self.attn_use_flash,
+                       use_serving=self.attn_use_serving, mesh=self.attn_mesh,
                        **kw)
         h = ResnetBlock(features=self.features, dropout=self.dropout,
+                        fused_epilogue=self.fused_epilogue,
                         **kw)(x, emb, train=self.train)
         if self.use_attn:
             h = AttnBlock(attn_type="self", **attn_kw)(h)

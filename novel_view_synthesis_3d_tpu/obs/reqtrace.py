@@ -20,8 +20,12 @@ Trace-context contract (all attrs ride the existing
     ``steps``, ``brownout`` (ladder level at admission), and for
     trajectories ``frames``.
   - request-scoped child spans (``queue_wait``, ``step_wait``,
-    ``trajectory_frame``) carry ``trace_id`` + ``parent_id`` pointing
-    at the root ``span_id``.
+    ``trajectory_frame``, ``cond_cache``) carry ``trace_id`` +
+    ``parent_id`` pointing at the root ``span_id``. ``cond_cache``
+    (PR 18, emitted at admission when the conditioning cache is on)
+    carries ``uncond`` ('hit' | 'miss' for the shared per-resolution
+    uncond entry) and ``bytes`` (device-resident cache size for this
+    request).
   - shared dispatch spans (``ring_step`` / ``compile`` in the stepper
     ring, ``device`` in the request scheduler) carry ``dispatch`` (a
     service-global ordinal), ``riders`` (comma-joined request ids —
@@ -56,7 +60,8 @@ _SAFE = re.compile(r"[^A-Za-z0-9._-]")
 # dispatch rows (carry riders). Reconstruction keys off these. A cold
 # dispatch is named "compile" in both schedulers (the PR 3 convention)
 # but is still a dispatch its riders rode.
-REQUEST_SPAN_NAMES = ("queue_wait", "step_wait", "trajectory_frame")
+REQUEST_SPAN_NAMES = ("queue_wait", "step_wait", "trajectory_frame",
+                      "cond_cache")
 DISPATCH_SPAN_NAMES = ("ring_step", "device", "compile")
 
 

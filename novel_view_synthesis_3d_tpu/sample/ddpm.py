@@ -40,21 +40,30 @@ import numpy as np
 
 from novel_view_synthesis_3d_tpu.config import DiffusionConfig
 from novel_view_synthesis_3d_tpu.diffusion.schedules import DiffusionSchedule
-from novel_view_synthesis_3d_tpu.models.xunet import precompute_pose_embs
+from novel_view_synthesis_3d_tpu.models.xunet import (
+    precompute_cond_feats,
+    precompute_pose_embs,
+)
 from novel_view_synthesis_3d_tpu.ops import fused_step as fused_step_lib
 
 
-def _raw_eps(model, params, model_batch: dict, pose_embs=None):
+def _raw_eps(model, params, model_batch: dict, pose_embs=None,
+             cond_feats=None):
     """(ε̂_cond, ε̂_uncond) network outputs via one doubled-batch forward.
 
     `pose_embs`: per-level pose embeddings already computed for the
     DOUBLED (cond+uncond) layout — injected after the doubling so they are
-    not concatenated twice. See models/xunet.precompute_pose_embs."""
+    not concatenated twice. See models/xunet.precompute_pose_embs.
+    `cond_feats`: stem features of the conditioning frame(s) for the
+    doubled layout (models/xunet.precompute_cond_feats) — with them the
+    step program convolves only the noised target frame."""
     B = model_batch["z"].shape[0]
     doubled = jax.tree.map(lambda a: jnp.concatenate([a, a], axis=0), model_batch)
     mask = jnp.concatenate([jnp.ones((B,)), jnp.zeros((B,))])
     if pose_embs is not None:
         doubled["pose_embs"] = pose_embs
+    if cond_feats is not None:
+        doubled["cond_feats"] = cond_feats
     eps = model.apply({"params": params}, doubled, cond_mask=mask, train=False)
     eps_cond, eps_uncond = jnp.split(eps, 2, axis=0)
     return eps_cond, eps_uncond
@@ -77,6 +86,92 @@ def _doubled_pose_embs(model, params, cond: dict):
     doubled = jax.tree.map(lambda a: jnp.concatenate([a, a], axis=0), cond)
     mask = jnp.concatenate([jnp.ones((B,)), jnp.zeros((B,))])
     return precompute_pose_embs(model, params, doubled, mask)
+
+
+def _per_row_encode(model, params, cond: dict, mask):
+    """Conditioning-branch encode, one row at a time.
+
+    Returns the same `(pose_embs, cond_feats)` a batched
+    `precompute_pose_embs` / `precompute_cond_feats` call would, but
+    computed as B independent B=1 encodes concatenated back together.
+    This is the cond cache's bit-identity keystone: XLA's conv lowering
+    is BATCH-SIZE dependent (a row of a B=4 pose encode can differ ~1e-6
+    from the same row encoded at B=1, observed on the multi-device CPU
+    test mesh), so the cache — which encodes per request at admission,
+    per bank entry at frame boundaries, and consumes rows stacked into
+    arbitrary ring batches — standardizes EVERY encode on the B=1 row
+    computation. A B=1 encode subgraph produces identical bits whether
+    it runs standalone (the admission program) or embedded in a larger
+    program (the uncached step recomputing it in-jit), so cached and
+    uncached rows match bitwise at any batch composition
+    (tests/test_cond_cache.py)."""
+    B = cond["x"].shape[0]
+    pose_rows, feat_rows = [], []
+    for i in range(B):
+        row = jax.tree.map(
+            lambda a: jax.lax.dynamic_slice_in_dim(a, i, 1, 0), cond)
+        m = jax.lax.dynamic_slice_in_dim(mask, i, 1, 0)
+        pose_rows.append(precompute_pose_embs(model, params, row, m))
+        feat_rows.append(precompute_cond_feats(model, params, row))
+    pose_embs = tuple(
+        jnp.concatenate([r[lvl] for r in pose_rows], axis=0)
+        for lvl in range(len(pose_rows[0])))
+    cond_feats = jnp.concatenate(feat_rows, axis=0)
+    return pose_embs, cond_feats
+
+
+def make_cond_encode_fn(model, *, param_transform=None):
+    """Jitted conditioning-branch encode for the serving cond cache.
+
+      encode(params, cond, mask) -> (pose_embs, cond_feats)
+
+    with `pose_embs` a per-level tuple of (B, F, H/2ˡ, W/2ˡ, emb) pose
+    embeddings (CFG mask baked in — zeros(B) encodes the uncond half)
+    and `cond_feats` the (B, Fc, H, W, ch) stem features of the cond
+    frame(s). The service (sample/service.py) calls this ONCE at
+    admission — or once per frame-bank encode for trajectories, with B
+    = k_max and the current target pose broadcast — and the results
+    live device-resident on the ring slot; `make_slot_step_fn` /
+    `make_bank_step_fn` built with `cond_cache=True` consume them as
+    device arguments instead of re-running rays → posenc → convs every
+    denoise step. A separate jitted callable (like make_bank_commit_fn)
+    so the step-program cache's entry accounting is untouched; compiles
+    once per (B, H, W) admission shape, never on the warm step path.
+
+    Internally row-unrolled (_per_row_encode) so a k_max-batched bank
+    encode yields bit-identical rows to the B=1 admission encode — the
+    invariant the steppers' gather/recompute equivalence rests on.
+
+    `param_transform` must match the step program's (the int8 path
+    dequantizes in-jit) so cached activations are computed from exactly
+    the weights the step program would have used."""
+
+    @jax.jit
+    def encode(params, cond, mask):
+        if param_transform is not None:
+            params = param_transform(params)
+        return _per_row_encode(model, params, cond, mask)
+
+    return encode
+
+
+def _assemble_cached_cond(cc3):
+    """Doubled (cond ‖ uncond) pose embeddings + stem features from the
+    cached halves: `cc3 = (pose_c, pose_u, feats_c)` with pose_c per-level
+    (B, …), pose_u per-level (1, …) — the shared uncond half, broadcast
+    here IN-program so guidance pairs store one encode — and feats_c
+    (B, Fc, H, W, ch), which is CFG-mask-independent (only the pose
+    embedding is zeroed) so the same tensor serves both halves. Pinned
+    with optimization_barrier: the forward must see materialized inputs,
+    exactly like the uncached program's in-jit conv outputs, so XLA
+    cannot fuse the assembly into the UNet and drift the two programs a
+    ulp apart (the barrier note above _resolve_request_fused)."""
+    pose_c, pose_u, feats_c = cc3
+    pose_embs = tuple(
+        jnp.concatenate([pc, jnp.broadcast_to(pu, pc.shape)], axis=0)
+        for pc, pu in zip(pose_c, pose_u))
+    cond_feats = jnp.concatenate([feats_c, feats_c], axis=0)
+    return jax.lax.optimization_barrier((pose_embs, cond_feats))
 
 
 def _step_noise(key, z):
@@ -456,7 +551,7 @@ assert fused_step_lib._W_COL == len(STEP_COEF_KEYS)
 
 
 def make_slot_step_fn(model, config: DiffusionConfig, *,
-                      param_transform=None):
+                      param_transform=None, cond_cache=False):
     """ONE reverse-process step over a ring batch with per-row schedules.
 
     The serving stepper's device program (sample/service.py,
@@ -505,7 +600,24 @@ def make_slot_step_fn(model, config: DiffusionConfig, *,
     quarantine consumes (docs/DESIGN.md "Serving survivability"). It is
     computed FROM z_next and never feeds back into the update, so
     clean-path z/keys bits are untouched, and an extra output does not
-    change the program-cache identity (still bucket/shape-only)."""
+    change the program-cache identity (still bucket/shape-only).
+
+    `cond_cache=True` returns the cached-conditioning twin:
+
+      step(params, z, keys, first, cond, coefs, w, cc)
+        -> (z_next, keys_next, finite)
+
+    with `cc = (pose_c, pose_u, feats_c)` the admission-time encode
+    (make_cond_encode_fn): per-level (B, …) cond-half pose embeddings,
+    the shared (1, …) uncond half, and the (B, Fc, H, W, ch) cond stem
+    features — all device arguments stacked by the service from its
+    ring slots, so the program identity stays bucket/shape-only. The
+    doubled CFG layout is assembled in-program (_assemble_cached_cond)
+    and the UNet convolves only the noised target frame
+    (models/xunet.py `cond_feats` seam); everything else — RNG stream,
+    update math, anomaly mask — is byte-for-byte the uncached body, and
+    the two programs produce BIT-identical rows
+    (tests/test_cond_cache.py)."""
     phi = config.cfg_rescale
     if not 0.0 <= phi <= 1.0:
         raise ValueError(f"cfg_rescale must be in [0, 1], got {phi}")
@@ -541,9 +653,23 @@ def make_slot_step_fn(model, config: DiffusionConfig, *,
         both = jax.vmap(jax.random.split)(keys)
         keys_next, k_step = both[:, 0], both[:, 1]
 
-        pose_embs = _doubled_pose_embs(model, params, cond)
+        # Cond branch: computed in-program, but row-unrolled through the
+        # SAME B=1 encode computation (_per_row_encode) and the same
+        # _assemble_cached_cond barrier as the cached twin's admission
+        # encodes, so the downstream UNet sees bit-identical inputs and
+        # identical traced structure in both programs — a batched encode
+        # here would drift co-riding rows ~1e-6 from their admission
+        # encodes (tests/test_cond_cache.py pins array_equal).
+        pose_c, feats_c = _per_row_encode(model, params, cond,
+                                          jnp.ones((B,)))
+        pose_u = precompute_pose_embs(
+            model, params, jax.tree.map(lambda a: a[:1], cond),
+            jnp.zeros((1,)))
+        pose_embs, cond_feats = _assemble_cached_cond(
+            (pose_c, pose_u, feats_c))
         batch = dict(cond, z=z, logsnr=coefs[:, logsnr_col])
-        ec, eu = _raw_eps(model, params, batch, pose_embs=pose_embs)
+        ec, eu = _raw_eps(model, params, batch, pose_embs=pose_embs,
+                          cond_feats=cond_feats)
         noise = _step_noise(k_step, z)
         # Pin the update's inputs so both branches see identical bits
         # (see the barrier note above _resolve_request_fused).
@@ -565,11 +691,45 @@ def make_slot_step_fn(model, config: DiffusionConfig, *,
         finite = jnp.all(jnp.isfinite(z_next).reshape(B, -1), axis=1)
         return z_next, keys_next, finite
 
-    return step
+    @jax.jit
+    def step_cached(params, z, keys, first, cond, coefs, w, cc):
+        # Cached-conditioning twin (see docstring): identical body
+        # except the cond branch arrives as device arguments.
+        if param_transform is not None:
+            params = param_transform(params)
+        B = z.shape[0]
+        both = jax.vmap(jax.random.split)(keys)
+        k_carry, k_init = both[:, 0], both[:, 1]
+        z0 = jax.vmap(lambda k: jax.random.normal(k, z.shape[1:]))(k_init)
+        fmask = first.reshape((B,) + (1,) * (z.ndim - 1))
+        z = jnp.where(fmask, z0.astype(z.dtype), z)
+        keys = jnp.where(first[:, None], k_carry, keys)
+        both = jax.vmap(jax.random.split)(keys)
+        keys_next, k_step = both[:, 0], both[:, 1]
+
+        pose_embs, cond_feats = _assemble_cached_cond(cc)
+        batch = dict(cond, z=z, logsnr=coefs[:, logsnr_col])
+        ec, eu = _raw_eps(model, params, batch, pose_embs=pose_embs,
+                          cond_feats=cond_feats)
+        noise = _step_noise(k_step, z)
+        z_in, ec, eu, noise, coefs_in, w_in = jax.lax.optimization_barrier(
+            (z, ec, eu, noise, coefs, w))
+        fused = use_fused and fused_step_lib.fits_vmem(
+            int(np.prod(z.shape[1:])))
+        step_impl = (fused_step_lib.fused_denoise_step if fused
+                     else fused_step_lib.unfused_reference_step)
+        z_next = step_impl(
+            z_in, ec, eu, noise, coefs_in, w_in, sampler=sampler,
+            objective=objective, eta=eta, cfg_rescale=phi,
+            clip_denoised=clip_denoised)
+        finite = jnp.all(jnp.isfinite(z_next).reshape(B, -1), axis=1)
+        return z_next, keys_next, finite
+
+    return step_cached if cond_cache else step
 
 
 def make_bank_step_fn(model, config: DiffusionConfig, k_max: int, *,
-                      param_transform=None):
+                      param_transform=None, cond_cache=False):
     """`make_slot_step_fn` with an optional per-row FRAME BANK — the
     trajectory-serving stepper program (sample/service.py; docs/DESIGN.md
     "Trajectory serving & stochastic conditioning").
@@ -605,6 +765,25 @@ def make_bank_step_fn(model, config: DiffusionConfig, k_max: int, *,
     count, guidance, pose, bank fill — is a device argument, so the
     program identity stays bucket/shape-only and mixed single-shot +
     trajectory traffic compiles nothing after warmup.
+
+    `cond_cache=True` returns the cached-conditioning twin:
+
+      step(params, z, keys, first, cond, coefs, w, R2, t2,
+           bank_x, bank_R, bank_t, bank_state, cc)
+        -> (z_next, keys_next, finite)
+
+    with `cc = (pose_c, pose_u, feats_c, bank_pose, bank_feats)`:
+    the slot-step triple plus per-level (B, k_max, …) bank-entry pose
+    embeddings and (B, k_max, Fc, H, W, ch) bank-entry stem features —
+    every bank entry encoded against the row's CURRENT target pose at
+    the frame boundary (sample/service.py re-encodes when the target
+    advances, exactly when it restacks R2/t2). The stochastic pick
+    gathers the cached EMBEDDINGS with the same idx (per-row encode
+    commutes with the gather bitwise), single-shot rows select the
+    request-level cache, and the raw bank_x/bank_R/bank_t stay in the
+    signature only for the commit path's carry structure — the forward
+    never reads them, so XLA drops the gathers. RNG stream and update
+    math are byte-for-byte the uncached body.
     """
     if k_max < 1:
         raise ValueError(
@@ -682,9 +861,21 @@ def make_bank_step_fn(model, config: DiffusionConfig, k_max: int, *,
             (x_eff, R1_eff, t1_eff, R2, t2))
         eff = {"x": x_eff, "R1": R1_eff, "t1": t1_eff,
                "R2": R2_in, "t2": t2_in, "K": cond["K"]}
-        pose_embs = _doubled_pose_embs(model, params, eff)
+        # Same row-unrolled encode + assembly barrier as the cached twin
+        # (see the make_slot_step_fn note): every encode everywhere is
+        # the B=1 row computation, so encoding the gathered view here
+        # commutes bitwise with the cached twin's gather over bank
+        # entries that were themselves row-encoded at the frame boundary.
+        pose_c, feats_c = _per_row_encode(model, params, eff,
+                                          jnp.ones((B,)))
+        pose_u = precompute_pose_embs(
+            model, params, jax.tree.map(lambda a: a[:1], eff),
+            jnp.zeros((1,)))
+        pose_embs, cond_feats = _assemble_cached_cond(
+            (pose_c, pose_u, feats_c))
         batch = dict(eff, z=z, logsnr=coefs[:, logsnr_col])
-        ec, eu = _raw_eps(model, params, batch, pose_embs=pose_embs)
+        ec, eu = _raw_eps(model, params, batch, pose_embs=pose_embs,
+                          cond_feats=cond_feats)
         noise = _step_noise(k_step, z)
         z_in, ec, eu, noise, coefs_in, w_in = jax.lax.optimization_barrier(
             (z, ec, eu, noise, coefs, w))
@@ -702,7 +893,71 @@ def make_bank_step_fn(model, config: DiffusionConfig, k_max: int, *,
         finite = jnp.all(jnp.isfinite(z_next).reshape(B, -1), axis=1)
         return z_next, keys_next, finite
 
-    return step
+    @jax.jit
+    def step_cached(params, z, keys, first, cond, coefs, w, R2, t2,
+                    bank_x, bank_R, bank_t, bank_state, cc):
+        # Cached-conditioning twin (see docstring): identical RNG head
+        # and pick, but the gather runs over cached EMBEDDINGS and the
+        # raw bank_x/bank_R/bank_t are never read (kept for the carry
+        # structure only — XLA drops them).
+        if param_transform is not None:
+            params = param_transform(params)
+        pose_c, pose_u, feats_c, bank_pose, bank_feats = cc
+        B = z.shape[0]
+        count, latest = bank_state[:, 0], bank_state[:, 1]
+        traj = count > 0
+        both = jax.vmap(jax.random.split)(keys)
+        k_carry, k_init = both[:, 0], both[:, 1]
+        z0 = jax.vmap(lambda k: jax.random.normal(k, z.shape[1:]))(k_init)
+        fmask = first.reshape((B,) + (1,) * (z.ndim - 1))
+        z = jnp.where(fmask, z0.astype(z.dtype), z)
+        keys = jnp.where(first[:, None], k_carry, keys)
+        two = jax.vmap(jax.random.split)(keys)
+        if stochastic:
+            three = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
+            keys_next = jnp.where(traj[:, None], three[:, 0], two[:, 0])
+            k_step = jnp.where(traj[:, None], three[:, 1], two[:, 1])
+            idx = jax.vmap(
+                lambda k, n: jax.random.randint(k, (), 0, n))(
+                    three[:, 2], jnp.maximum(count, 1))
+        else:
+            keys_next, k_step = two[:, 0], two[:, 1]
+            idx = latest
+        # Same per-row gather/select as the uncached body, lifted from
+        # pixels to cached activations: the per-row encode commutes with
+        # the gather bitwise (the bank entries were encoded row-wise at
+        # the frame boundary), and single-shot rows select the
+        # request-level cache. _assemble_cached_cond pins the assembled
+        # result, so the forward sees materialized inputs exactly like
+        # the uncached program's eff barrier.
+        take = lambda bank: jax.vmap(  # noqa: E731
+            lambda b, i: jax.lax.dynamic_index_in_dim(
+                b, i, 0, keepdims=False))(bank, idx)
+        tmask = traj.reshape((B, 1, 1, 1, 1))
+        sel_pose = tuple(
+            jnp.where(tmask, take(bp), pc)
+            for bp, pc in zip(bank_pose, pose_c))
+        sel_feats = jnp.where(tmask, take(bank_feats), feats_c)
+        pose_embs, cond_feats = _assemble_cached_cond(
+            (sel_pose, pose_u, sel_feats))
+        batch = dict(cond, z=z, logsnr=coefs[:, logsnr_col])
+        ec, eu = _raw_eps(model, params, batch, pose_embs=pose_embs,
+                          cond_feats=cond_feats)
+        noise = _step_noise(k_step, z)
+        z_in, ec, eu, noise, coefs_in, w_in = jax.lax.optimization_barrier(
+            (z, ec, eu, noise, coefs, w))
+        fused = use_fused and fused_step_lib.fits_vmem(
+            int(np.prod(z.shape[1:])))
+        step_impl = (fused_step_lib.fused_denoise_step if fused
+                     else fused_step_lib.unfused_reference_step)
+        z_next = step_impl(
+            z_in, ec, eu, noise, coefs_in, w_in, sampler=sampler,
+            objective=objective, eta=eta, cfg_rescale=phi,
+            clip_denoised=clip_denoised)
+        finite = jnp.all(jnp.isfinite(z_next).reshape(B, -1), axis=1)
+        return z_next, keys_next, finite
+
+    return step_cached if cond_cache else step
 
 
 def make_bank_commit_fn():

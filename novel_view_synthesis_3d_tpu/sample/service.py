@@ -125,6 +125,7 @@ from novel_view_synthesis_3d_tpu.sample import precision as precision_lib
 from novel_view_synthesis_3d_tpu.sample.ddpm import (
     make_bank_commit_fn,
     make_bank_step_fn,
+    make_cond_encode_fn,
     make_request_sampler,
     make_slot_step_fn,
 )
@@ -441,7 +442,7 @@ class _Slot:
     __slots__ = ("req", "bank", "w", "z", "keys", "first", "t", "version",
                  "t_admit", "device_s", "compile_s", "steps_done",
                  "bucket0", "batch0", "fbank", "frame_index", "frame_t0",
-                 "strikes")
+                 "strikes", "cc", "cc_bank")
 
     def __init__(self, req: _Request, bank, version: str, t_admit: float,
                  fbank: Optional[FrameBank] = None):
@@ -467,6 +468,16 @@ class _Slot:
         # Consecutive non-finite steps (the device-side anomaly mask);
         # at serve.anomaly_strikes the slot is quarantined.
         self.strikes = 0
+        # Conditioning cache (serve.cond_cache): the admission-time
+        # encode results, device-resident for the slot's lifetime and
+        # pinned — like the weights — to the version the row was
+        # admitted under (swaps drain the ring, so neither can change
+        # mid-flight). `cc` is (pose_c tuple, feats_c) at B=1;
+        # `cc_bank` is the per-bank-entry encode for trajectory rows
+        # (re-encoded at each frame boundary against the next target
+        # pose), None for single-shot rows.
+        self.cc = None
+        self.cc_bank = None
 
     @property
     def shape(self) -> tuple:
@@ -632,6 +643,21 @@ class SamplingService:
         self._frames_count = 0
         self._frames_t0: Optional[float] = None
         self._traj_in_ring = 0
+        # Conditioning-cache telemetry (docs/DESIGN.md "Conditioning
+        # cache & fused serving attention"): a hit is one ring row served
+        # a step from cached activations, a miss is one encode-program
+        # run (admission, uncond fill, or trajectory frame boundary).
+        self._cond_hits_total = obs.get_registry().counter(
+            "nvs3d_cond_cache_hits_total",
+            "ring row-steps served from cached conditioning activations")
+        self._cond_misses_total = obs.get_registry().counter(
+            "nvs3d_cond_cache_misses_total",
+            "conditioning encode runs (admissions, uncond fills, "
+            "trajectory frame boundaries)")
+        self._cond_resident_gauge = obs.get_registry().gauge(
+            "nvs3d_cond_cache_resident_bytes",
+            "device bytes held by cached conditioning activations "
+            "(ring slots + the shared uncond cache)")
         # Survivability surfaces (docs/DESIGN.md "Serving
         # survivability"): anomaly quarantine, drain state, supervised
         # worker restarts, and the brownout ladder.
@@ -712,6 +738,17 @@ class SamplingService:
                 "'step' — trajectory frames re-enter the stepper ring "
                 "between denoise steps (config.validate names the same "
                 "constraint)")
+        # Conditioning cache (serve.cond_cache; docs/DESIGN.md
+        # "Conditioning cache & fused serving attention"): compute the
+        # request's cond-branch activations ONCE at admission and feed
+        # the step program device arguments instead of re-running rays →
+        # posenc → per-level convs every denoise step.
+        self._cond_cache = bool(self.serve.cond_cache)
+        if self._cond_cache and self.serve.scheduler != "step":
+            raise ValueError(
+                "serve.cond_cache=True requires serve.scheduler='step' — "
+                "the cache lives on stepper ring slots (config.validate "
+                "names the same constraint)")
         if self.serve.scheduler == "step":
             # Stepper programs depend on bucket/shape ONLY (t, steps and
             # guidance ride as device args); the host-side coefficient
@@ -729,6 +766,21 @@ class SamplingService:
             # The in-jit frame commit program (one jitted callable;
             # XLA caches one executable per (k_max, H, W) shape).
             self._commit_fn = make_bank_commit_fn() if self._k_max else None
+            # Admission-time conditioning encode (one jitted callable;
+            # XLA caches one executable per (B, H, W) encode shape —
+            # B=1 requests/uncond, B=k_max trajectory banks). The
+            # per-(H, W) uncond cache is GLOBAL (the CFG uncond half is
+            # pose- and image-independent — only conv biases + learned
+            # embeddings survive the mask) and is invalidated on every
+            # hot swap; per-request caches die with their ring slot.
+            self._encode_fn = (make_cond_encode_fn(
+                self.model, param_transform=self._param_transform)
+                if self._cond_cache else None)
+            self._uncond_cache: Dict[tuple, tuple] = {}
+            self._zero_cc_cache: Dict[tuple, tuple] = {}
+            self._encode_entries = 0
+            self._cc_hits = 0
+            self._cc_misses = 0
         else:
             self._programs = SamplerProgramCache(
                 self._build_program, self.serve.program_cache_entries,
@@ -983,6 +1035,13 @@ class SamplingService:
                 old, self._owned_ids,
                 keep_ids={id(l) for l in jax.tree.leaves(pend["params"])})
             self._owned_ids = pend["owned"]
+            # Conditioning-cache invalidation: the shared uncond halves
+            # were encoded through the OLD weights. Per-request caches
+            # need no action — the drain-on-swap contract means no ring
+            # slot is alive here, so every in-flight row stayed pinned
+            # to the activations (and weights) of its start version.
+            if self._cond_cache:
+                self._uncond_cache.clear()
         self._swaps += 1
         self._model_swaps_total.inc()
         self._model_version_gauge.set(
@@ -1266,6 +1325,16 @@ class SamplingService:
             size = getattr(commit_fn, "_cache_size", None)
             counters["commit_jit_entries"] = (
                 int(size()) if callable(size) else 0)
+        encode_fn = getattr(self, "_encode_fn", None)
+        if encode_fn is not None:
+            # The admission-time cond-encode program compiles once per
+            # (B, H, W) encode shape; counting its executables here puts
+            # it under the same zero-recompile asserts as the step and
+            # commit programs (mixed cached/uncached warm traffic must
+            # compile nothing).
+            size = getattr(encode_fn, "_cache_size", None)
+            counters["encode_jit_entries"] = (
+                int(size()) if callable(size) else 0)
         return counters
 
     def summary(self) -> dict:
@@ -1283,9 +1352,24 @@ class SamplingService:
                    flight_dumps=len(self.flight.dumps))
         if self._banks is not None:
             out["schedule_bank"] = self._banks.counters()
+        if self._cond_cache:
+            out["cond_cache"] = self._cond_cache_stats()
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
         return out
+
+    def _cond_cache_stats(self) -> dict:
+        hits, misses = self._cc_hits, self._cc_misses
+        total = hits + misses
+        return {
+            "enabled": True,
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": (hits / total) if total else 0.0,
+            "uncond_entries": len(self._uncond_cache),
+            "resident_bytes": int(
+                self._cond_resident_gauge.value() or 0),
+        }
 
     def _log_event(self, request_id: int, kind: str, detail: str) -> None:
         """Event-log append via the obs bus, schema-compatible with the
@@ -1586,6 +1670,13 @@ class SamplingService:
                     # grows on device as frames commit in-jit.
                     fbank = FrameBank(self._k_max, r.k_cap, r.cond["x"],
                                       r.cond["R1"], r.cond["t1"])
+                cc = cc_bank = None
+                if self._cond_cache:
+                    # The cond-cache tentpole: encode the request's
+                    # conditioning branch ONCE, here, at admission; the
+                    # step program consumes the activations as device
+                    # arguments every step of the row's lifetime.
+                    cc, cc_bank = self._admit_encode(r, fbank)
             except Exception as exc:
                 # A request the schedule/bank math cannot serve (e.g. a
                 # step count respace() rejects) fails ITS ticket — an
@@ -1600,6 +1691,7 @@ class SamplingService:
                 self._traj_in_ring += 1
                 self._traj_active.set(float(self._traj_in_ring))
             slot = _Slot(r, bank, version, now, fbank=fbank)
+            slot.cc, slot.cc_bank = cc, cc_bank
             ring.append(slot)
             # step_wait: submit → ring admission (the stepper's analogue
             # of queue_wait; bounded by steps in flight, not by whole
@@ -1658,15 +1750,17 @@ class SamplingService:
         return (bucket, H, W, d.sampler, d.cfg_rescale, d.ddim_eta,
                 d.objective, d.clip_denoised, d.schedule, d.timesteps,
                 self.precision, d.fused_step, self._k_max,
-                d.stochastic_cond)
+                d.stochastic_cond, self._cond_cache)
 
     def _build_step_program(self):
         if self._k_max > 0:
             return make_bank_step_fn(
                 self.model, self.diffusion, self._k_max,
-                param_transform=self._param_transform)
+                param_transform=self._param_transform,
+                cond_cache=self._cond_cache)
         return make_slot_step_fn(self.model, self.diffusion,
-                                 param_transform=self._param_transform)
+                                 param_transform=self._param_transform,
+                                 cond_cache=self._cond_cache)
 
     def _zero_bank(self, H: int, W: int) -> tuple:
         """Staged-once zero bank arrays for single-shot rows riding a
@@ -1680,6 +1774,127 @@ class SamplingService:
                   jnp.zeros((self._k_max, 3), jnp.float32))
             self._zero_bank_cache[(H, W)] = zb
         return zb
+
+    # -- conditioning cache (serve.cond_cache) --------------------------
+    @staticmethod
+    def _cc_nbytes(cc) -> int:
+        """Device bytes of one cached-conditioning pytree."""
+        if cc is None:
+            return 0
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in jax.tree.leaves(cc))
+
+    def _encode_call(self, cond: dict, mask: np.ndarray) -> tuple:
+        """Run the admission-time encode program and account it: one
+        miss counter tick per call, and a compile-ledger entry whenever
+        the call grew the encode jit cache (a NEW (B, H, W) encode shape
+        — the event the warm-traffic zero-recompile asserts police,
+        under the name 'serve_cond_encode')."""
+        params, _ = self._live
+        t0 = time.perf_counter()
+        pose, feats = self._encode_fn(params, cond, mask)
+        jax.block_until_ready(feats)
+        wall = time.perf_counter() - t0
+        self._cc_misses += 1
+        self._cond_misses_total.inc()
+        size_fn = getattr(self._encode_fn, "_cache_size", None)
+        size = int(size_fn()) if callable(size_fn) else 0
+        if size > self._encode_entries:
+            self._encode_entries = size
+            x = np.asarray(cond["x"])
+            self._compile_ledger.record(
+                "serve_cond_encode",
+                {"args": {"B": repr(int(x.shape[0])),
+                          "H": repr(int(x.shape[1])),
+                          "W": repr(int(x.shape[2]))}},
+                wall_s=wall, backend=jax.default_backend())
+        return tuple(pose), feats
+
+    def _ensure_uncond(self, H: int, W: int, cond1: dict) -> bool:
+        """Fill the global per-(H, W) uncond pose-embedding cache if
+        empty; True on a hit. The CFG mask zeroes the pose embedding
+        before the per-level convs, so the masked halves are request-
+        independent (but NOT zero — conv biases and learned embeddings
+        survive): any request's conditioning serves, at B=1, and the
+        (1, …) result broadcasts in-program over every guidance pair."""
+        key = (H, W)
+        if key in self._uncond_cache:
+            return True
+        pose, _ = self._encode_call(
+            cond1, np.zeros((cond1["x"].shape[0],), np.float32))
+        if self.mesh is not None:
+            pose = jax.device_put(pose, mesh_lib.replicated(self.mesh))
+        self._uncond_cache[key] = pose
+        return False
+
+    def _encode_bank(self, fbank: FrameBank, R2, t2, K) -> tuple:
+        """Encode every bank entry against the CURRENT target pose, at
+        B=k_max (zero-padded entries encode garbage that idx, bounded by
+        count, never selects). Called at trajectory admission and again
+        at each frame boundary — exactly when the target pose advances
+        and the bank grows."""
+        k = fbank.k_max
+        cond = {
+            "x": fbank.x, "R1": fbank.R, "t1": fbank.t,
+            "R2": np.broadcast_to(
+                np.asarray(R2, np.float32), (k, 3, 3)),
+            "t2": np.broadcast_to(np.asarray(t2, np.float32), (k, 3)),
+            "K": np.broadcast_to(np.asarray(K, np.float32), (k, 3, 3)),
+        }
+        return self._encode_call(cond, np.ones((k,), np.float32))
+
+    def _admit_encode(self, r: _Request,
+                      fbank: Optional[FrameBank]) -> tuple:
+        """The admission-time encode (the cond-cache tentpole): one
+        B=1 encode for the request's cond branch, the shared uncond
+        fill if this (H, W) has none yet, and — for trajectories — the
+        B=k_max bank-entry encode against the first target pose.
+        Returns (cc, cc_bank) for the slot. Runs inside _admit's
+        per-request try: an encode failure fails THIS ticket, never the
+        worker."""
+        H, W = r.shape
+        cond1 = {k: np.asarray(r.cond[k])[None] for k in COND_KEYS}
+        with self.tracer.span(
+                "cond_cache",
+                request_id=r.ticket.request_id,
+                trace_id=r.trace_id,
+                parent_id=reqtrace.root_span_id(r.trace_id)) as span:
+            uncond_hit = self._ensure_uncond(H, W, cond1)
+            cc = self._encode_call(cond1, np.ones((1,), np.float32))
+            cc_bank = None
+            if fbank is not None:
+                cc_bank = self._encode_bank(
+                    fbank, r.poses_R[0], r.poses_t[0], r.cond["K"])
+            span.set(uncond=("hit" if uncond_hit else "miss"),
+                     bytes=self._cc_nbytes(cc) + self._cc_nbytes(cc_bank))
+        return cc, cc_bank
+
+    def _zero_cc_bank(self, H: int, W: int, cc: tuple) -> tuple:
+        """Staged-once zero cached-bank activations for single-shot rows
+        riding a cond-cached bank ring (count=0 rows never select them);
+        shapes derived from a request-level cc, which the admission
+        order guarantees exists before any stack needs zeros."""
+        import jax.numpy as jnp
+
+        zb = self._zero_cc_cache.get((H, W))
+        if zb is None:
+            pose_c, feats_c = cc
+            zb = (tuple(
+                jnp.zeros((self._k_max,) + p.shape[1:], p.dtype)
+                for p in pose_c),
+                jnp.zeros((self._k_max,) + feats_c.shape[1:],
+                          feats_c.dtype))
+            self._zero_cc_cache[(H, W)] = zb
+        return zb
+
+    def _cc_resident(self, ring: List[_Slot]) -> int:
+        """Current device residency of the conditioning cache: every
+        ring slot's activations plus the shared uncond halves."""
+        total = sum(self._cc_nbytes(s.cc) + self._cc_nbytes(s.cc_bank)
+                    for s in ring)
+        total += sum(self._cc_nbytes(p)
+                     for p in self._uncond_cache.values())
+        return total
 
     def _bank_sig(self, ring: List[_Slot]) -> tuple:
         """Identity of the ring's stacked bank content: any commit bumps
@@ -1741,6 +1956,7 @@ class SamplingService:
         sig = (tuple(id(s) for s in ring), bucket)
         bank_mode = self._k_max > 0
         bank_dev = bank_sig = None
+        cc_pose = cc_feats = cc_uncond = cc_bank_dev = None
         with self.tracer.span("batch_form", bucket=bucket, batch_n=n):
             if carry is not None and carry["sig"] != sig:
                 self._materialize(carry)
@@ -1763,6 +1979,25 @@ class SamplingService:
             else:
                 z_dev, keys_dev, cond_dev = (
                     carry["z"], carry["keys"], carry["cond"])
+            if self._cond_cache:
+                # Slot-level cached activations: a DEVICE-side
+                # concatenate of the per-slot B=1 encodes (pad rows
+                # repeat the last real row, like cond) — restacked only
+                # when the ring composition changes, exactly the cond
+                # lifecycle. The shared uncond halves ride as (1, …)
+                # device arguments broadcast in-program.
+                import jax.numpy as jnp
+                if carry is None:
+                    rows = [s.cc for s in ring] + [ring[-1].cc] * pad
+                    cc_pose = tuple(
+                        self._place(jnp.concatenate(
+                            [r[0][lev] for r in rows], axis=0), bucket)
+                        for lev in range(len(rows[0][0])))
+                    cc_feats = self._place(jnp.concatenate(
+                        [r[1] for r in rows], axis=0), bucket)
+                else:
+                    cc_pose, cc_feats = carry["cc"]
+                cc_uncond = self._uncond_cache[(H, W)]
             # Per-row schedule coefficients: ONE packed (B, K) host
             # gather + device transfer per step (bank.table rows) — this
             # is what keeps t/steps/w out of the program identity. Pad
@@ -1804,6 +2039,8 @@ class SamplingService:
                 if carry is not None and carry.get("bank_sig") == bank_sig:
                     R2_dev, t2_dev, state_dev = carry["pose"]
                     bank_dev = carry["bank"]
+                    if self._cond_cache:
+                        cc_bank_dev = carry["cc_bank"]
                 else:
                     tp = [s.target_pose() for s in ring]
                     R2s = np.stack([p[0] for p in tp] + [tp[-1][0]] * pad
@@ -1818,18 +2055,38 @@ class SamplingService:
                     t2_dev = self._place(t2s, bucket)
                     state_dev = self._place(state, bucket)
                     bank_dev = self._stack_banks(ring, bucket, H, W)
+                    if self._cond_cache:
+                        # Cached bank-entry activations follow the bank
+                        # lifecycle: restacked when a commit (or a frame
+                        # boundary's re-encode) bumps the bank_sig.
+                        import jax.numpy as jnp
+                        cbs = [s.cc_bank if s.is_traj
+                               else self._zero_cc_bank(H, W, s.cc)
+                               for s in ring]
+                        cbs += [cbs[-1]] * pad
+                        cc_bank_dev = (
+                            tuple(self._place(jnp.stack(
+                                [c[0][lev] for c in cbs]), bucket)
+                                for lev in range(len(cbs[0][0]))),
+                            self._place(jnp.stack(
+                                [c[1] for c in cbs]), bucket))
             entry = self._programs.get(self._step_cache_key(bucket, H, W))
         cold = not entry["warm"]
         t0 = time.perf_counter()
         if bank_mode:
-            z_next, keys_next, finite_dev = entry["fn"](
-                params, z_dev, keys_dev, first_dev, cond_dev, coefs_dev,
-                w_dev, R2_dev, t2_dev, bank_dev[0], bank_dev[1],
-                bank_dev[2], state_dev)
+            args = (params, z_dev, keys_dev, first_dev, cond_dev,
+                    coefs_dev, w_dev, R2_dev, t2_dev, bank_dev[0],
+                    bank_dev[1], bank_dev[2], state_dev)
+            if self._cond_cache:
+                args += ((cc_pose, cc_uncond, cc_feats,
+                          cc_bank_dev[0], cc_bank_dev[1]),)
+            z_next, keys_next, finite_dev = entry["fn"](*args)
         else:
-            z_next, keys_next, finite_dev = entry["fn"](
-                params, z_dev, keys_dev, first_dev, cond_dev, coefs_dev,
-                w_dev)
+            args = (params, z_dev, keys_dev, first_dev, cond_dev,
+                    coefs_dev, w_dev)
+            if self._cond_cache:
+                args += ((cc_pose, cc_uncond, cc_feats),)
+            z_next, keys_next, finite_dev = entry["fn"](*args)
         jax.block_until_ready(z_next)
         self._pace_dispatch(t0)
         elapsed = time.perf_counter() - t0
@@ -1845,13 +2102,24 @@ class SamplingService:
             for s in ring)
         for s in ring:
             s.req.rides += 1
+        step_attrs = dict(bucket=bucket, batch_n=n,
+                          dispatch=self.dispatches,
+                          riders=",".join(
+                              str(s.req.ticket.request_id)
+                              for s in ring),
+                          debt=debt_in)
+        if self._cond_cache:
+            # Cache-hit attribution: every row this dispatch stepped was
+            # served from cached activations (the cache is filled at
+            # admission, before the row's first step, so there is no
+            # partially-cached row).
+            resident = self._cc_resident(ring)
+            self._cc_hits += n
+            self._cond_hits_total.inc(n)
+            self._cond_resident_gauge.set(float(resident))
+            step_attrs.update(cc_hits=n, cc_bytes=resident)
         self.tracer.add_span("compile" if cold else "ring_step", elapsed,
-                             bucket=bucket, batch_n=n,
-                             dispatch=self.dispatches,
-                             riders=",".join(
-                                 str(s.req.ticket.request_id)
-                                 for s in ring),
-                             debt=debt_in)
+                             **step_attrs)
         self.stats.record_span("ring_step", elapsed)
         # In-ring anomaly quarantine: the step program's third output is
         # a per-row finite mask (a device-side reduce — the host reads a
@@ -1910,7 +2178,8 @@ class SamplingService:
                     "sig": sig, "slots": list(ring),
                     "bank": bank_dev, "bank_sig": bank_sig,
                     "pose": ((R2_dev, t2_dev, state_dev) if bank_mode
-                             else None)}
+                             else None),
+                    "cc": (cc_pose, cc_feats), "cc_bank": cc_bank_dev}
         fin_ids = {id(s) for s in finished}
         rearm_ids = {id(s) for s in rearm}
         z_host = k_host = None
@@ -1941,12 +2210,14 @@ class SamplingService:
                 # Pure frame boundary: the ring composition is
                 # unchanged, the carry stays device-resident. The stale
                 # bank_sig forces a device-side restack next dispatch
-                # (the re-armed slots' banks just grew).
+                # (the re-armed slots' banks just grew — and, under the
+                # cond cache, their cc_bank was just re-encoded).
                 return {"z": z_next, "keys": keys_next, "cond": cond_dev,
                         "first": self._false_rows(bucket), "w": w_dev,
                         "sig": sig, "slots": list(ring),
                         "bank": bank_dev, "bank_sig": bank_sig,
-                        "pose": (R2_dev, t2_dev, state_dev)}
+                        "pose": (R2_dev, t2_dev, state_dev),
+                        "cc": (cc_pose, cc_feats), "cc_bank": cc_bank_dev}
             # Rows exited: rebuild next dispatch from host state.
             if z_host is None:
                 z_host = np.asarray(jax.device_get(z_next))
@@ -2032,6 +2303,18 @@ class SamplingService:
                                frames_done=slot.frame_index)
             self._traj_exit()
             return False
+        if self._cond_cache:
+            # Re-encode the bank-entry activations for the NEXT frame:
+            # its target pose changes every entry's pose embedding, and
+            # the bank just grew by the committed frame. frame_index was
+            # advanced above, so target_pose() is the next pose — the
+            # same one the next dispatch restacks into R2/t2 (the stale
+            # bank_sig forces that restack, which also picks this up).
+            # Runs on the pinned weights: swaps drain the ring, so
+            # self._live cannot change while this slot is in flight.
+            R2n, t2n = slot.target_pose()
+            slot.cc_bank = self._encode_bank(slot.fbank, R2n, t2n,
+                                             req.cond["K"])
         slot.t = slot.bank.n - 1
         slot.first = True  # next frame draws fresh init noise in-jit
         slot.frame_t0 = now
@@ -2190,7 +2473,7 @@ class SamplingService:
     _STEP_KEY_FIELDS = ("bucket", "H", "W", "sampler", "cfg_rescale",
                         "ddim_eta", "objective", "clip_denoised",
                         "schedule", "timesteps", "precision", "fused_step",
-                        "k_max", "stochastic_cond")
+                        "k_max", "stochastic_cond", "cond_cache")
     _BATCH_KEY_FIELDS = ("bucket", "H", "W", "steps", "guidance",
                          "sampler", "cfg_rescale", "ddim_eta", "objective",
                          "schedule", "precision", "fused_step")
@@ -2258,6 +2541,11 @@ class SamplingService:
             # traffic never recompiles) without scraping Prometheus.
             "programs_built": int(self._programs.builds),
         }
+        if self._cond_cache:
+            # Replica health gains the cache's hit/miss/residency facts
+            # so the fleet router (and a probe) can see cache health
+            # without scraping Prometheus.
+            snap["cond_cache"] = self._cond_cache_stats()
         if self.slo is not None:
             slo_snap = self.slo.snapshot()
             burns = [c.get("fast_burn", 0.0) for c in slo_snap.values()]

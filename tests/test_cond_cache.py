@@ -1,0 +1,368 @@
+"""Conditioning cache (serve.cond_cache=True; docs/DESIGN.md
+"Conditioning cache & fused serving attention"): per-request cond
+activations (cond-frame stem features + per-level pose/FiLM embeddings)
+are computed ONCE at admission, live in the ring slot next to z/keys/
+banks, and feed the step program as device arguments — so program
+identity stays bucket/shape-only and warm mixed cached/uncached traffic
+never recompiles.
+
+The acceptance bar is the PR 6/8 one: cached-vs-uncached images are
+BIT-identical on single-key CPU, across ddpm/ddim × fused/unfused step
+paths, under ring interleaving, across hot swaps (in-flight requests
+pinned to their start version's activations), on the trajectory
+bank-entry path (one encode per bank entry, re-encoded at frame
+boundaries), and under the anomaly quarantine — with zero warm
+recompiles asserted via the compile counters."""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import (
+    BrownoutConfig,
+    Config,
+    DiffusionConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+from novel_view_synthesis_3d_tpu.sample.service import (
+    Rejected,
+    SampleAnomaly,
+    SamplingService,
+    request_cond_from_batch,
+)
+from novel_view_synthesis_3d_tpu.utils.geometry import orbit_poses
+
+pytestmark = pytest.mark.smoke
+
+TINY = ModelConfig(ch=32, ch_mult=(1, 2), emb_ch=32, num_res_blocks=1,
+                   attn_resolutions=(8,), dropout=0.0)
+T = 8
+S = 16
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    dcfg = DiffusionConfig(timesteps=T, sample_timesteps=T)
+    model = XUNet(TINY)
+    batch = make_example_batch(batch_size=8, sidelength=S, seed=0)
+    mb = {
+        "x": jnp.asarray(batch["x"]), "z": jnp.asarray(batch["target"]),
+        "logsnr": jnp.zeros((8,)), "R1": jnp.asarray(batch["R1"]),
+        "t1": jnp.asarray(batch["t1"]), "R2": jnp.asarray(batch["R2"]),
+        "t2": jnp.asarray(batch["t2"]), "K": jnp.asarray(batch["K"]),
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        mb, cond_mask=jnp.ones((8,)), train=False)["params"]
+    # Fresh-init XUNets are conditioning-INSENSITIVE (zero-init output
+    # convs; tests/test_cond_sensitivity.py) — perturb so the cached
+    # activations actually influence the images being compared.
+    rng = np.random.default_rng(0)
+    params = jax.tree.map(
+        lambda a: np.asarray(a) + 0.05 * rng.standard_normal(
+            a.shape).astype(np.asarray(a).dtype), params)
+    conds = [request_cond_from_batch(mb, i) for i in range(8)]
+    return model, params, dcfg, conds
+
+
+def make_service(setup, tmp, *, dcfg=None, **serve_kw):
+    model, params, base_dcfg, _ = setup
+    kw = dict(scheduler="step", max_batch=4, flush_timeout_ms=20.0,
+              queue_depth=64)
+    kw.update(serve_kw)
+    return SamplingService(model, params, dcfg or base_dcfg,
+                           ServeConfig(**kw), results_folder=str(tmp))
+
+
+def traj_cond(cond):
+    return {k: cond[k] for k in ("x", "R1", "t1", "K")}
+
+
+def orbit_for(cond, n):
+    return orbit_poses(n, radius=float(np.linalg.norm(cond["t1"])) or 1.0,
+                       elevation=0.3)
+
+
+# ---------------------------------------------------------------------------
+# Config surface
+# ---------------------------------------------------------------------------
+def test_cond_cache_config_validation():
+    Config(serve=ServeConfig(scheduler="step", cond_cache=True)).validate()
+    with pytest.raises(ValueError, match="cond_cache"):
+        Config(serve=ServeConfig(scheduler="request",
+                                 cond_cache=True)).validate()
+    with pytest.raises(ValueError, match="cond_cache"):
+        Config(serve=ServeConfig(scheduler="step",
+                                 cond_cache="yes")).validate()
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity across samplers and step paths
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("sampler,fused", [
+    ("ddpm", False), ("ddpm", True), ("ddim", False), ("ddim", True)])
+def test_cached_bit_identical_across_samplers(setup, tmp_path, sampler,
+                                              fused):
+    """Cache-on and cache-off services return the SAME BITS for the
+    same requests — heterogeneous step counts and guidance weights in
+    one ring — on every sampler × fused-step combination the step
+    scheduler serves."""
+    _, _, base_dcfg, conds = setup
+    dcfg = dataclasses.replace(base_dcfg, sampler=sampler,
+                               fused_step=fused)
+    subs = [dict(seed=11, sample_steps=T),
+            dict(seed=22, sample_steps=4, guidance_weight=1.5),
+            dict(seed=33, sample_steps=2)]
+    imgs = {}
+    for on in (False, True):
+        svc = make_service(setup, tmp_path / f"{sampler}{fused}{on}",
+                           dcfg=dcfg, cond_cache=on)
+        try:
+            tickets = [svc.submit(conds[i], **kw)
+                       for i, kw in enumerate(subs)]
+            imgs[on] = [t.result(timeout=300) for t in tickets]
+        finally:
+            svc.stop()
+    for a, b in zip(imgs[False], imgs[True]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_ring_composition_invariance_mixed_cached(setup, tmp_path):
+    """With the cache on, a request's image is bit-identical solo vs
+    interleaved with co-riders joining mid-flight, and the warm phase
+    compiles nothing (program identity stayed bucket/shape-only — the
+    cached activations ride as device arguments)."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, cond_cache=True,
+                       flush_timeout_ms=30.0)
+    try:
+        # Warm ring buckets 1/2/4 (the stepper compiles once per bucket
+        # shape — the invariance claim is about WARM traffic).
+        seed = 700
+        for b in (1, 2, 4):
+            for t in [svc.submit(conds[j], seed=seed + j, sample_steps=T)
+                      for j in range(b)]:
+                t.result(timeout=300)
+            seed += b
+        a_solo = svc.submit(conds[0], seed=11,
+                            sample_steps=T).result(timeout=300)
+        b_solo = svc.submit(conds[1], seed=22,
+                            sample_steps=2).result(timeout=300)
+        c_solo = svc.submit(conds[2], seed=33,
+                            sample_steps=4).result(timeout=300)
+        warm = svc.compile_counters()
+        before = svc.stats.span_summary("ring_step").get("count", 0)
+        a = svc.submit(conds[0], seed=11, sample_steps=T)
+        deadline = time.monotonic() + 60
+        while (svc.stats.span_summary("ring_step").get("count", 0)
+               <= before and time.monotonic() < deadline):
+            time.sleep(0.002)
+        b = svc.submit(conds[1], seed=22, sample_steps=2)
+        c = svc.submit(conds[2], seed=33, sample_steps=4)
+        np.testing.assert_array_equal(a.result(timeout=300), a_solo)
+        np.testing.assert_array_equal(b.result(timeout=300), b_solo)
+        np.testing.assert_array_equal(c.result(timeout=300), c_solo)
+        after = svc.compile_counters()
+        for k in ("programs_built", "programs_live", "jit_cache_entries",
+                  "encode_jit_entries"):
+            assert after[k] == warm[k], (
+                f"warm mixed cached traffic recompiled {k}: "
+                f"{warm} -> {after}")
+        assert after["cache_hits"] > warm["cache_hits"]
+        stats = svc.summary()["cond_cache"]
+        assert stats["enabled"] and stats["hits"] > 0
+        assert 0.0 < stats["hit_rate"] <= 1.0
+        assert stats["resident_bytes"] >= 0
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Swap invalidation: in-flight pinned, queued re-encoded, uncond dropped
+# ---------------------------------------------------------------------------
+def test_swap_invalidation_pins_inflight(setup, tmp_path):
+    """A hot swap staged under cached in-flight traffic drains first:
+    the in-flight request finishes on activations encoded from its
+    START version, the queued arrival re-encodes against the new
+    weights, and the shared uncond entry is invalidated (v2 images
+    match a v2-only service bit-for-bit — stale v1 activations would
+    show up as a mismatch)."""
+    model, params, dcfg, conds = setup
+    params_v2 = jax.tree.map(lambda p: np.asarray(p) * 1.05,
+                             jax.device_get(params))
+    svc = SamplingService(
+        model, params, dcfg,
+        ServeConfig(scheduler="step", max_batch=4, flush_timeout_ms=10.0,
+                    queue_depth=32, cond_cache=True),
+        results_folder=str(tmp_path / "a"), model_version="v1")
+    try:
+        ref_v1 = svc.submit(conds[0], seed=7,
+                            sample_steps=T).result(timeout=300)
+        before = svc.stats.span_summary("ring_step").get("count", 0)
+        a = svc.submit(conds[0], seed=7, sample_steps=T)
+        deadline = time.monotonic() + 60
+        while (svc.stats.span_summary("ring_step").get("count", 0)
+               <= before and time.monotonic() < deadline):
+            time.sleep(0.002)
+        applied = svc.swap_params(params_v2, "v2", step=2)
+        b = svc.submit(conds[1], seed=8, sample_steps=2)
+        img_a = a.result(timeout=300)
+        img_b = b.result(timeout=300)
+        assert applied.wait(60)
+        assert a.model_version == "v1" and b.model_version == "v2"
+        np.testing.assert_array_equal(img_a, ref_v1)
+        ref_v2 = svc.submit(conds[1], seed=8,
+                            sample_steps=2).result(timeout=300)
+        np.testing.assert_array_equal(img_b, ref_v2)
+    finally:
+        svc.stop()
+    # Cross-check the post-swap bits against a service BORN on v2 (no
+    # v1 encode ever happened there — catches stale-uncond reuse).
+    svc2 = SamplingService(
+        model, params_v2, dcfg,
+        ServeConfig(scheduler="step", max_batch=4, flush_timeout_ms=10.0,
+                    queue_depth=32, cond_cache=True),
+        results_folder=str(tmp_path / "b"), model_version="v2")
+    try:
+        born_v2 = svc2.submit(conds[1], seed=8,
+                              sample_steps=2).result(timeout=300)
+        np.testing.assert_array_equal(img_b, born_v2)
+    finally:
+        svc2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Trajectory bank-entry caching
+# ---------------------------------------------------------------------------
+def test_trajectory_bank_entry_caching(setup, tmp_path):
+    """Orbits cache per bank entry (re-encoded at frame boundaries as
+    committed frames enter the window): cached orbits are bit-identical
+    to uncached ones, with a single-shot co-rider in the same ring also
+    unchanged, and the encode program compiles once per admission shape
+    (B=1 single-shot + B=k_max bank) — never again for warm traffic."""
+    _, _, _, conds = setup
+    poses4 = orbit_for(conds[0], 4)
+    ref = {}
+    for on in (False, True):
+        svc = make_service(setup, tmp_path / f"t{on}", cond_cache=on,
+                           k_max=3, flush_timeout_ms=30.0)
+        try:
+            ref[on] = svc.submit_trajectory(
+                traj_cond(conds[0]), poses=poses4, seed=11,
+                sample_steps=2).result(timeout=300)
+            if not on:
+                continue
+            # Warm the mixed trajectory+single-shot bucket before the
+            # zero-recompile window (one program per bucket shape).
+            wt = svc.submit_trajectory(traj_cond(conds[0]), poses=poses4,
+                                       seed=99, sample_steps=2)
+            ws = svc.submit(conds[1], seed=98, sample_steps=2)
+            wt.result(timeout=300)
+            ws.result(timeout=300)
+            warm = svc.compile_counters()
+            assert warm["encode_jit_entries"] == 2  # B=1 + B=k_max
+            tk = svc.submit_trajectory(traj_cond(conds[0]), poses=poses4,
+                                       seed=11, sample_steps=2)
+            single = svc.submit(conds[1], seed=44, sample_steps=2)
+            traj_again = tk.result(timeout=300)
+            img = single.result(timeout=300)
+            np.testing.assert_array_equal(traj_again, ref[on])
+            solo = svc.submit(conds[1], seed=44,
+                              sample_steps=2).result(timeout=300)
+            np.testing.assert_array_equal(img, solo)
+            after = svc.compile_counters()
+            for k in ("programs_built", "programs_live",
+                      "jit_cache_entries", "commit_jit_entries",
+                      "encode_jit_entries"):
+                assert after[k] == warm[k], (
+                    f"warm trajectory traffic recompiled {k}: "
+                    f"{warm} -> {after}")
+            assert after["cache_hits"] > warm["cache_hits"]
+        finally:
+            svc.stop()
+    assert ref[True].shape == (4, S, S, 3)
+    np.testing.assert_array_equal(ref[False], ref[True])
+
+
+# ---------------------------------------------------------------------------
+# Interaction with survivability: brownout shed + anomaly quarantine
+# ---------------------------------------------------------------------------
+def test_shed_requests_never_encode(setup, tmp_path):
+    """Brownout shedding happens at admission-gate time, BEFORE the
+    conditioning encode — a shed request must not burn an encode (the
+    miss counter stays put) and must carry the structured retryable
+    reason."""
+    _, _, _, conds = setup
+    svc = make_service(
+        setup, tmp_path, cond_cache=True, max_batch=1,
+        flush_timeout_ms=5000.0, queue_depth=64,
+        brownout=BrownoutConfig(queue_soft=1, queue_hard=2,
+                                retry_after_s=0.25))
+    try:
+        svc.submit(conds[0], seed=1, sample_steps=T)
+        # Wait until the first request is admitted to the ring (queue
+        # drained) so the two queued fills below land at depths 1 and 2
+        # deterministically — not racing the worker's dequeue.
+        deadline = time.monotonic() + 60
+        while (svc.stats.span_summary("ring_step").get("count", 0) < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        svc.submit(conds[1], seed=2, sample_steps=T)
+        svc.submit(conds[2], seed=3, sample_steps=T)
+        misses_before = svc.summary()["cond_cache"]["misses"]
+        with pytest.raises(Rejected) as ei:
+            svc.submit(conds[3], seed=4, sample_steps=T)
+        assert ei.value.retryable
+        assert ei.value.retry_after_s == 0.25
+        assert svc.summary()["cond_cache"]["misses"] == misses_before
+    finally:
+        svc.stop()
+
+
+def test_quarantine_corider_bit_identical_with_cache(setup, tmp_path,
+                                                     monkeypatch):
+    """A poisoned ring row under the cache quarantines alone: the
+    cached co-rider returns its solo bits, the anomaly path compiles
+    nothing, and the service keeps serving (the dead slot's activations
+    die with it — resubmission re-encodes cleanly)."""
+    _, _, _, conds = setup
+    svc = make_service(setup, tmp_path, cond_cache=True,
+                       anomaly_strikes=1, flush_timeout_ms=300.0)
+    try:
+        ref = svc.submit(conds[1], seed=77,
+                         sample_steps=4).result(timeout=300)
+        svc.submit(conds[0], seed=7, sample_steps=T).result(timeout=300)
+        # Warm the co-riding bucket the poisoned pair below will use.
+        wa = svc.submit(conds[0], seed=7, sample_steps=T)
+        wb = svc.submit(conds[1], seed=77, sample_steps=4)
+        wa.result(timeout=300)
+        wb.result(timeout=300)
+        before = svc.compile_counters()
+        monkeypatch.setenv("NVS3D_FI_SERVE_NAN_AT",
+                           f"{svc.dispatches + 2}:0")
+        poisoned = svc.submit(conds[0], seed=7, sample_steps=T)
+        corider = svc.submit(conds[1], seed=77, sample_steps=4)
+        img = corider.result(timeout=300)
+        with pytest.raises(SampleAnomaly):
+            poisoned.result(timeout=300)
+        np.testing.assert_array_equal(img, ref)
+        monkeypatch.delenv("NVS3D_FI_SERVE_NAN_AT")
+        again = svc.submit(conds[0], seed=7,
+                           sample_steps=T).result(timeout=300)
+        assert np.isfinite(again).all()
+        after = svc.compile_counters()
+        for k in ("programs_built", "programs_live", "jit_cache_entries",
+                  "encode_jit_entries"):
+            assert after[k] == before[k], (
+                f"anomaly path recompiled {k}: {before} -> {after}")
+        assert svc.summary()["anomalies"] == 1
+    finally:
+        svc.stop()

@@ -668,6 +668,61 @@ def test_fleet_survivability_writer_surfaces_route_through_bus():
                 "serve/journal.py must not name the csv sinks")
 
 
+def test_cond_cache_writer_surfaces_route_through_bus():
+    """The conditioning-cache surfaces (PR 18) — the `cond_cache`
+    admission span, hit/miss/resident metrics, and the fused-attention
+    coverage attribution — are NEW writer surfaces: every module
+    outside obs/ that names one must route through the tracer/bus,
+    never a private csv path (the walk above already bans the
+    telemetry-file literals); the writer the DESIGN doc promises lives
+    in the sampling service; and the span name is registered as a
+    request-scoped span so reqtrace reconstruction attaches it to the
+    request's timeline."""
+    import novel_view_synthesis_3d_tpu as pkg
+    from novel_view_synthesis_3d_tpu.obs import reqtrace
+
+    pkg_root = os.path.dirname(os.path.abspath(pkg.__file__))
+    names = ("cond_cache", "nvs3d_cond_cache_hits_total",
+             "nvs3d_cond_cache_misses_total",
+             "nvs3d_cond_cache_resident_bytes")
+    emitters = []
+    for root, _, files in os.walk(pkg_root):
+        if os.path.basename(root) == "obs":
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+            names_surface = imports_csv = False
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in names):
+                    names_surface = True
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    mod = getattr(node, "module", None) or ""
+                    if "csv" in [a.name for a in node.names] \
+                            or mod == "csv":
+                        imports_csv = True
+            if names_surface:
+                rel = os.path.relpath(path, pkg_root)
+                emitters.append(rel)
+                assert not imports_csv, (
+                    f"{rel} names cond-cache surfaces AND imports csv "
+                    "— telemetry writes belong to obs.bus only")
+                assert "tracer" in src or "obs." in src \
+                    or "bus." in src, (
+                        f"{rel} names cond-cache surfaces but has no "
+                        "bus-routed path")
+    assert any(e.endswith(os.path.join("sample", "service.py"))
+               for e in emitters)
+    # Reconstruction attaches cond_cache rows to request timelines.
+    assert "cond_cache" in reqtrace.REQUEST_SPAN_NAMES
+
+
 # ---------------------------------------------------------------------------
 # Device monitor / MFU
 # ---------------------------------------------------------------------------

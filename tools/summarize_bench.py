@@ -388,6 +388,66 @@ def trajectory_serving_lines(rows):
     return lines
 
 
+def cond_cache_lines(rows):
+    """Tables for serve_bench --cond-cache artifacts: the cached vs
+    re-encode-every-step lanes with the cache-hit attribution
+    (hits/misses/resident bytes from the service's cond_cache summary)
+    and the fused serving-attention coverage table — which attention
+    shapes ran the Pallas kernel vs the XLA fallback."""
+    lines = []
+    for name, d in rows:
+        cc = d.get("cond_cache")
+        if not isinstance(cc, dict) or "off" not in cc:
+            continue
+        lines += ["", f"## Conditioning cache — {name}", ""]
+        tr = cc.get("trace", {})
+        lines.append(
+            f"- trace: {tr.get('requests')} arrivals @ "
+            f"{tr.get('rate_per_s')}/s ({tr.get('util_target')}× the "
+            f"cache-off lane's solo capacity), {tr.get('orbits')} "
+            f"orbit(s) × {tr.get('frames_per_orbit')} frames, "
+            f"{tr.get('steps')} steps/request, emb_ch "
+            f"{tr.get('emb_ch')}")
+        lines.append(
+            f"- cached vs re-encode-every-step: **{cc.get('speedup')}×** "
+            f"({cc.get('on', {}).get('row_steps_per_sec')} vs "
+            f"{cc.get('off', {}).get('row_steps_per_sec')} row-steps/s)")
+        stats = cc.get("on", {}).get("cond_cache") or {}
+        if stats:
+            lines.append(
+                f"- cache hits: {stats.get('hits')} / misses "
+                f"{stats.get('misses')} (hit rate "
+                f"{fmt(100 * stats.get('hit_rate', 0.0))}%), "
+                f"{stats.get('uncond_entries')} uncond entr(y/ies), "
+                f"resident {stats.get('resident_bytes', 0) / 1e6:.1f} MB")
+        lines += ["",
+                  "| lane | row-steps | window (s) | row-steps/s | "
+                  "built | jit Δ | encode Δ | delivery |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for lane in ("off", "on"):
+            ln = cc.get(lane, {})
+            deltas = ln.get("deltas", {})
+            lines.append(
+                "| {} | {} | {} | {} | {} | {} | {} | {} |".format(
+                    lane, ln.get("row_steps_delivered"),
+                    fmt(ln.get("window_s", 0.0)),
+                    fmt(ln.get("row_steps_per_sec", 0.0)),
+                    deltas.get("programs_built"),
+                    deltas.get("jit_cache_entries"),
+                    deltas.get("encode_jit_entries"),
+                    "ok" if ln.get("delivery_ok") else "INCOMPLETE"))
+        cov = cc.get("attention_coverage") or {}
+        lines += ["", "### Fused serving-attention coverage", ""]
+        if cov:
+            lines += ["| shape | path |", "|---|---|"]
+            for shape, mode in sorted(cov.items()):
+                lines.append(f"| {shape} | {mode} |")
+        else:
+            lines.append("- none recorded — SKIPPED: the coverage probe "
+                         "left no shapes in the registry")
+    return lines
+
+
 def precision_sweep_lines(rows):
     """Per-lane tables for serve_bench --precision-sweep artifacts:
     precision/fused-step delivery + the per-precision PSNR probe deltas
@@ -674,6 +734,9 @@ def main() -> int:
     lines += precision_sweep_lines(rows)
     # Ring-native vs naive orbit serving for --trajectory artifacts.
     lines += trajectory_serving_lines(rows)
+    # Conditioning-cache A/B + fused-attention coverage for --cond-cache
+    # artifacts.
+    lines += cond_cache_lines(rows)
     # Survivability drill tables for any --chaos artifacts.
     lines += chaos_lines(rows)
     # The restored CPU-lane trajectory from the repo-root BENCH archives,
