@@ -646,8 +646,20 @@ def _probe_failure_result(rc: int, reason) -> dict:
 
 
 def main():
-    args = [a for a in sys.argv[1:] if "=" not in a]
-    overrides = [a for a in sys.argv[1:] if "=" in a]
+    argv = list(sys.argv[1:])
+    # --ledger DIR: where the bench's compile ledger + cost map land.
+    # Default is a per-run directory under results/ (bench_<preset>) —
+    # the shared repo-level results/compiles.jsonl grew a few committed
+    # rows per PR before this flag existed and is retired.
+    ledger_dir = None
+    if "--ledger" in argv:
+        i = argv.index("--ledger")
+        if i + 1 >= len(argv):
+            raise SystemExit("--ledger needs a directory argument")
+        ledger_dir = argv[i + 1]
+        del argv[i:i + 2]
+    args = [a for a in argv if "=" not in a]
+    overrides = [a for a in argv if "=" in a]
     if args and args[0] == "data":
         # Host-side pipeline bench: pin CPU up front so it neither touches
         # nor waits on the accelerator tunnel.
@@ -727,6 +739,12 @@ def main():
 
     flops = byts = None
     costmap_rows = []
+    # Per-run artifact directory: the ledger and cost map land here, NOT
+    # in the shared results/ root (whose compiles.jsonl used to collect
+    # one appended row per PR's bench run — now retired). --ledger
+    # overrides for lanes that bank artifacts elsewhere.
+    run_dir = ledger_dir or os.path.join(cfg.train.results_folder,
+                                         f"bench_{preset}")
     if os.environ.get("NVS3D_BENCH_COST", "1") != "0":
         try:
             lowered = step.lower(state, device_batch)
@@ -734,7 +752,7 @@ def main():
             # bench rounds on shifting presets are exactly where a
             # surprise-recompile diff ("batch_size changed", "static
             # digest changed") pays for itself.
-            _obs.CompileLedger(cfg.train.results_folder).record(
+            _obs.CompileLedger(run_dir).record(
                 "bench_train_step",
                 _obs.fingerprint_args(state, device_batch, static=(
                     cfg.model, cfg.diffusion, cfg.train, cfg.mesh)),
@@ -756,8 +774,7 @@ def main():
                 _sample_model_batch as _smb)
 
             costmap_rows = _obs.xunet_costmap(cfg, _smb(batch))
-            path = _obs.write_costmap(cfg.train.results_folder,
-                                      costmap_rows)
+            path = _obs.write_costmap(run_dir, costmap_rows)
             print(f"note: per-op cost map -> {path}", file=sys.stderr)
         except Exception as e:
             print(f"note: cost map unavailable ({e})", file=sys.stderr)
@@ -892,6 +909,15 @@ def _run_sentry(result: dict) -> None:
         # moved most vs the banked trajectory.
         print(f"sentry attribution: {verdict['attribution']}",
               file=sys.stderr)
+    if verdict["regressed"]:
+        # Doctor embedding (obs/doctor.py): top ranked findings ride in
+        # the page itself.
+        for i, f in enumerate(verdict.get("doctor") or [], 1):
+            if i > 3:
+                break
+            print(f"sentry doctor {i}. "
+                  f"[{f.get('severity', '?').upper()}] "
+                  f"{f.get('title', '')}", file=sys.stderr)
     if verdict["regressed"] and os.environ.get(
             "NVS3D_BENCH_SENTRY") == "1":
         sys.exit(bench_sentry.REGRESSION_RC)

@@ -503,10 +503,15 @@ def cmd_serve(args, overrides: List[str]) -> int:
     from novel_view_synthesis_3d_tpu import obs
 
     telemetry = obs.RunTelemetry.create(cfg.obs, args.out)
+    profiler = (obs.make_profiler(cfg.obs.profile, args.out, cfg.model,
+                                  telemetry.bus, telemetry.registry,
+                                  unit="dispatch")
+                if cfg.obs.enabled else None)
     service = SamplingService(model, params, cfg.diffusion, cfg.serve,
                               mesh=mesh, results_folder=args.out,
                               tracer=telemetry.tracer,
                               flight=telemetry.flight,
+                              profiler=profiler,
                               model_version=model_version)
     if telemetry.server is not None:
         # /healthz progress facts: last_dispatch_age_s + the live
@@ -1335,7 +1340,65 @@ def cmd_obs(args, overrides: List[str]) -> int:
     if sub == "compiles":
         return _obs_compiles(args)
 
+    if sub == "roofline":
+        return _obs_roofline(args)
+
+    if sub == "doctor":
+        return _obs_doctor(args)
+
     raise SystemExit(f"unknown obs command {sub!r}")
+
+
+def _obs_roofline(args) -> int:
+    """Roofline a run: measured per-group device time (profile_window
+    rows) × analytic costmap FLOPs/bytes × chip peaks → per-group MFU,
+    bandwidth utilization, bound class, and the top-k headroom list
+    (the aim list for the ROADMAP perf arcs)."""
+    from novel_view_synthesis_3d_tpu.obs import roofline as roofline_lib
+
+    report = roofline_lib.analyze_run(
+        args.run, peak_flops=args.peak_flops,
+        peak_bytes_per_s=args.peak_bytes)
+    if not report["rows"]:
+        raise SystemExit(
+            f"nothing to roofline under {args.run!r}: no costmap.json "
+            "and no profile_window rows in telemetry.jsonl (run with "
+            "obs.profile.enabled and obs.cost_analysis)")
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(roofline_lib.render(report, k=args.top))
+    return 0
+
+
+def _obs_doctor(args) -> int:
+    """The regression doctor: rank every artifact-backed finding. Two
+    modes — `doctor RUN_A RUN_B` diffs two results folders; `doctor
+    --trajectory [ROOT]` reads the banked BENCH_r*/MULTICHIP_r* archive
+    via the run index. rc=1 when any page-severity finding lands (the
+    sentry's embedding reads the same ranked list)."""
+    from novel_view_synthesis_3d_tpu.obs import doctor as doctor_lib
+
+    if args.trajectory:
+        root = args.run_a or "."
+        doc = doctor_lib.diagnose_trajectory(
+            root, tolerance_pct=args.tolerance_pct)
+    else:
+        if not args.run_a or not args.run_b:
+            raise SystemExit(
+                "doctor needs RUN_A RUN_B (pair mode) or --trajectory "
+                "[ROOT] (archive mode)")
+        doc = doctor_lib.diagnose_pair(args.run_a, args.run_b)
+    if args.out:
+        path = doctor_lib.write_doctor(args.out, doc)
+        print(f"wrote {path}")
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        print(doctor_lib.render(doc, limit=args.limit))
+    pages = [f for f in doc.get("findings", [])
+             if f.get("severity") == "page"]
+    return 1 if pages else 0
 
 
 def _obs_numerics(args) -> int:
@@ -1912,6 +1975,46 @@ def make_parser() -> argparse.ArgumentParser:
                    help="machine-readable output")
     q.add_argument("--why", type=int, default=None, metavar="N",
                    help="show the Nth recompile's full fingerprint diff")
+
+    q = obs_sub.add_parser(
+        "roofline",
+        help="per-op-group roofline: measured device time (profile "
+             "windows) × costmap FLOPs/bytes × chip peaks → MFU, "
+             "bandwidth utilization, compute/memory/comm-bound class, "
+             "top-k headroom")
+    q.add_argument("run", help="run dir holding telemetry.jsonl "
+                               "(+ costmap.json)")
+    q.add_argument("--top", type=int, default=3,
+                   help="top-k groups by headroom (default 3)")
+    q.add_argument("--peak-flops", type=float, default=None,
+                   help="override chip peak FLOPs/s (default: this "
+                        "process's devices via obs.devmon)")
+    q.add_argument("--peak-bytes", type=float, default=None,
+                   help="override chip peak HBM bytes/s")
+    q.add_argument("--json", action="store_true")
+
+    q = obs_sub.add_parser(
+        "doctor",
+        help="ranked cross-run diagnosis: span drift, recompiles, "
+             "numerics spikes, costmap drift, profile-window group "
+             "drift (pair mode), or the whole banked BENCH_r* archive "
+             "(--trajectory); rc=1 on a page-severity finding")
+    q.add_argument("run_a", nargs="?", default=None,
+                   help="baseline run dir (pair mode) or archive root "
+                        "(--trajectory; default '.')")
+    q.add_argument("run_b", nargs="?", default=None,
+                   help="candidate run dir (pair mode)")
+    q.add_argument("--trajectory", action="store_true",
+                   help="diagnose the banked BENCH_r*/MULTICHIP_r* "
+                        "archive instead of a run pair")
+    q.add_argument("--tolerance-pct", type=float, default=2.0,
+                   help="bench_sentry's rolling-median tolerance "
+                        "(trajectory mode, default 2)")
+    q.add_argument("--out", default=None, metavar="DIR",
+                   help="also land the diagnosis as doctor.json in DIR")
+    q.add_argument("--limit", type=int, default=0,
+                   help="show at most N findings (0 = all)")
+    q.add_argument("--json", action="store_true")
 
     p = sub.add_parser(
         "route",

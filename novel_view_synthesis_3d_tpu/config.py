@@ -803,6 +803,31 @@ class RegistryConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsProfileConfig:
+    """Continuous profiling windows (obs/profiler.py; docs/DESIGN.md
+    "Performance observatory"): periodically re-arm a bounded
+    jax.profiler window, attribute the captured device time to the
+    shared op-group vocabulary, and land profile_window rows +
+    nvs3d_group_device_time_seconds gauges. Host-side only — bitwise
+    outputs and compile identity are unchanged; window-armed steps are
+    excluded from the step-rate gauges. On by default: the defaults
+    amortize to well under the 1% overhead contract (one ~2-step window
+    per 500 steps), and tiny test runs never reach the first cadence."""
+
+    enabled: bool = True
+    # Training cadence: arm a window every N steps (window covers
+    # [N, N + window_steps) etc.). 0 disables the training profiler.
+    every_steps: int = 500
+    # Steps per window. Short on purpose: a window prices ~window/every
+    # in excluded step-rate samples plus the host-side parse.
+    window_steps: int = 2
+    # Serving cadence, counted in dispatches (SamplingService.dispatches
+    # spans ring steps and batched dispatches). 0 disables in serving.
+    serve_every_dispatches: int = 2000
+    serve_window_dispatches: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
 class ObsConfig:
     """Unified telemetry layer (novel_view_synthesis_3d_tpu/obs/;
     docs/DESIGN.md "Observability"): span tracing with Perfetto export,
@@ -848,6 +873,9 @@ class ObsConfig:
     # column in metrics.csv. Costs one extra trace (no XLA compile) at
     # startup.
     cost_analysis: bool = True
+    # Continuous per-op-group profiling windows (obs/profiler.py).
+    profile: ObsProfileConfig = dataclasses.field(
+        default_factory=ObsProfileConfig)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1444,6 +1472,26 @@ class Config:
             errors.append(
                 f"obs.xprof_steps={ob.xprof_steps} must be (start, end) "
                 "with 0 <= start < end, or (0, 0) for off")
+        pf = ob.profile
+        for fname in ("every_steps", "window_steps",
+                      "serve_every_dispatches", "serve_window_dispatches"):
+            if getattr(pf, fname) < 0:
+                errors.append(
+                    f"obs.profile.{fname}={getattr(pf, fname)} must be "
+                    ">= 0 (0 disables)")
+        if (pf.every_steps > 0 and pf.window_steps > 0
+                and pf.window_steps >= pf.every_steps):
+            errors.append(
+                f"obs.profile.window_steps={pf.window_steps} must be < "
+                f"every_steps={pf.every_steps} (a window must close "
+                "before the next cadence)")
+        if (pf.serve_every_dispatches > 0
+                and pf.serve_window_dispatches > 0
+                and pf.serve_window_dispatches >= pf.serve_every_dispatches):
+            errors.append(
+                f"obs.profile.serve_window_dispatches="
+                f"{pf.serve_window_dispatches} must be < "
+                f"serve_every_dispatches={pf.serve_every_dispatches}")
         for axis in ("model", "seq", "stages"):
             if getattr(self.mesh, axis) < 1:
                 errors.append(f"mesh.{axis} must be >= 1")

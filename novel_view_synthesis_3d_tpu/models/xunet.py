@@ -454,5 +454,12 @@ class XUNet(nn.Module):
         a, b = (0, len(specs)) if ops is None else ops
         state = carry
         for kind, info in specs[a:b]:
-            state = run_op(kind, info, state)
+            # og.<label> named scope: stamps each op's HLO with its
+            # op-group label (the op_groups vocabulary) so profiler
+            # traces attribute device time per group (obs/profiler.py).
+            # Metadata only — no effect on the computation, the param
+            # tree, or flax's module naming/rng folding.
+            label = kind if kind in ("prelude", "final") else info["name"]
+            with jax.named_scope(f"og.{label}"):
+                state = run_op(kind, info, state)
         return state

@@ -45,6 +45,12 @@ from novel_view_synthesis_3d_tpu.obs.compiles import (  # noqa: F401
     write_costmap,
     xunet_costmap,
 )
+from novel_view_synthesis_3d_tpu.obs.doctor import (  # noqa: F401
+    diagnose_pair,
+    diagnose_trajectory,
+    load_doctor,
+    write_doctor,
+)
 from novel_view_synthesis_3d_tpu.obs.flight import (  # noqa: F401
     FlightRecorder,
     NullFlightRecorder,
@@ -56,11 +62,22 @@ from novel_view_synthesis_3d_tpu.obs.numerics import (  # noqa: F401
     group_labels,
     group_stats,
 )
+from novel_view_synthesis_3d_tpu.obs.profiler import (  # noqa: F401
+    ContinuousProfiler,
+    attribute_device_time,
+    make_profiler,
+    profile_rows,
+)
 from novel_view_synthesis_3d_tpu.obs.registry import (  # noqa: F401
     MetricsRegistry,
     get_registry,
     reset_registry,
 )
+from novel_view_synthesis_3d_tpu.obs.roofline import (  # noqa: F401
+    roofline_rows,
+    top_headroom,
+)
+from novel_view_synthesis_3d_tpu.obs.runindex import RunIndex  # noqa: F401
 from novel_view_synthesis_3d_tpu.obs.server import (  # noqa: F401
     MetricsServer,
     start_metrics_server,

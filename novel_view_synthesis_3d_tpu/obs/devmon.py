@@ -34,9 +34,15 @@ from typing import Callable, List, Optional
 _PEAK_FLOPS_BY_KIND = (("v5lite", 197e12), ("v5e", 197e12),
                        ("v6", 918e12), ("v4", 275e12))
 
+# HBM bandwidth per chip, same spec sheets and keying: v5e/v5litepod
+# 819 GB/s, v4 1228 GB/s, v6e/trillium 1640 GB/s. The roofline join
+# (obs/roofline.py) divides by this to classify memory-bound groups —
+# this table is its one home, next to the FLOPs peaks it pairs with.
+_PEAK_BYTES_BY_KIND = (("v5lite", 819e9), ("v5e", 819e9),
+                       ("v6", 1640e9), ("v4", 1228e9))
 
-def device_peak_flops(device=None) -> Optional[float]:
-    """Dense bf16 peak FLOPs/s for one chip, or None if unknown."""
+
+def _peak_by_kind(table, device) -> Optional[float]:
     import jax
 
     if device is None:
@@ -45,7 +51,17 @@ def device_peak_flops(device=None) -> Optional[float]:
             return None
         device = devices[0]
     kind = device.device_kind.lower().replace(" ", "")
-    return next((v for k, v in _PEAK_FLOPS_BY_KIND if k in kind), None)
+    return next((v for k, v in table if k in kind), None)
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Dense bf16 peak FLOPs/s for one chip, or None if unknown."""
+    return _peak_by_kind(_PEAK_FLOPS_BY_KIND, device)
+
+
+def device_peak_bytes_per_s(device=None) -> Optional[float]:
+    """Peak HBM bytes/s for one chip, or None if unknown (CPU)."""
+    return _peak_by_kind(_PEAK_BYTES_BY_KIND, device)
 
 
 def mfu(flops_per_step: float, steps_per_sec: float,

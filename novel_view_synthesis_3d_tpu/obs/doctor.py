@@ -1,0 +1,454 @@
+"""The regression doctor: every banked artifact, one ranked diagnosis
+(docs/DESIGN.md "Performance observatory").
+
+PRs 14–15 built the evidence — span percentiles, the compile ledger,
+the per-op cost map, numerics spikes, bench banks — but reading them
+together was still a human job (BENCH_r09's 0.973× sat unnamed until
+someone eyeballed the archive). The doctor joins all of it:
+
+  - ``diagnose_pair(run_a, run_b)``: two results folders → span p50
+    drifts, recompiles, numerics spikes, cost-map drift, per-group
+    device-time drift from profile windows (time up while FLOPs flat →
+    named a memory-bound regression), input-pipeline overlap drift.
+  - ``diagnose_trajectory(root)``: the banked BENCH_r*/MULTICHIP_r*
+    archive (via obs/runindex) → every regressed round named with its
+    number and ratio, recovery arcs, span/cost drift of the newest
+    round against its own history, infra-gap accounting.
+  - ``attribute_fresh(prior, newest)``: the sentry-trip path —
+    tools/bench_sentry feeds the round it just judged and embeds the
+    top findings in the rc=4 page (replacing its one-line ad-hoc
+    ``attribute_regression``).
+
+Findings are dicts {severity: page|warn|info, kind, title, detail,
+rank} ranked page-first then by magnitude; ``write_doctor`` lands the
+whole diagnosis as ``doctor.json`` in the run folder (this module is
+the ONLY place that names that file — conformance-tested, same
+single-writer rule as the bus) and ``render`` prints the table the CLI
+and sentry show. Ranking heuristic, deliberately simple: severity is
+decided by contract (a regressed newest round or a recompile pages; a
+drifted-but-healthy signal warns; context is info) and ties break on
+the magnitude of the drift — the doctor orders evidence, it does not
+hide any.
+
+Stdlib only, no jax: the doctor must run on a machine that never ran
+the job, against artifacts alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from novel_view_synthesis_3d_tpu.obs.runindex import RunIndex
+
+DOCTOR_FILE = "doctor.json"
+SEVERITIES = ("page", "warn", "info")
+_SEV_ORDER = {s: i for i, s in enumerate(SEVERITIES)}
+# Relative drift (percent) below which a span/group delta is noise.
+SPAN_DRIFT_PCT = 5.0
+COST_DRIFT_PCT = 0.5
+DEFAULT_TOLERANCE_PCT = 2.0
+
+
+def finding(severity: str, kind: str, title: str, detail: str = "",
+            rank: float = 0.0, **evidence) -> dict:
+    assert severity in SEVERITIES, severity
+    out = {"severity": severity, "kind": kind, "title": title,
+           "rank": round(float(rank), 3)}
+    if detail:
+        out["detail"] = detail
+    if evidence:
+        out["evidence"] = evidence
+    return out
+
+
+def rank_findings(findings: Sequence[dict]) -> List[dict]:
+    return sorted(findings,
+                  key=lambda f: (_SEV_ORDER.get(f.get("severity"), 9),
+                                 -abs(f.get("rank", 0.0))))
+
+
+# -- artifact readers (run-folder granularity) ------------------------
+
+def _span_p50s(run_dir: str) -> Dict[str, float]:
+    """Per-span p50 seconds from a run's telemetry.jsonl span rows."""
+    from novel_view_synthesis_3d_tpu.obs.bus import jsonl_path
+
+    path = jsonl_path(run_dir)
+    durs: Dict[str, List[float]] = {}
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        for line in fh:
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if (isinstance(row, dict) and row.get("kind") == "span"
+                    and isinstance(row.get("dur_s"), (int, float))):
+                durs.setdefault(str(row.get("name")), []).append(
+                    float(row["dur_s"]))
+    return {name: statistics.median(v) for name, v in durs.items() if v}
+
+
+def _span_sums(run_dir: str) -> Dict[str, float]:
+    from novel_view_synthesis_3d_tpu.obs.bus import jsonl_path
+
+    path = jsonl_path(run_dir)
+    sums: Dict[str, float] = {}
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        for line in fh:
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if (isinstance(row, dict) and row.get("kind") == "span"
+                    and isinstance(row.get("dur_s"), (int, float))):
+                name = str(row.get("name"))
+                sums[name] = sums.get(name, 0.0) + float(row["dur_s"])
+    return sums
+
+
+def _overlap(run_dir: str) -> Optional[float]:
+    """Input-pipeline overlap = 1 − Σdata_fetch/Σtrain_step, the
+    summarize_bench definition — one number per run."""
+    sums = _span_sums(run_dir)
+    step = sums.get("train_step")
+    fetch = sums.get("data_fetch")
+    if not step or fetch is None:
+        return None
+    return max(0.0, min(1.0, 1.0 - fetch / step))
+
+
+def _latest_window(run_dir: str) -> Optional[dict]:
+    from novel_view_synthesis_3d_tpu.obs.profiler import profile_rows
+
+    ok = [r for r in profile_rows(run_dir) if not r.get("error")]
+    return ok[-1] if ok else None
+
+
+def _costmap_flops(run_dir: str) -> Dict[str, float]:
+    from novel_view_synthesis_3d_tpu.obs.compiles import load_costmap
+
+    rows = load_costmap(run_dir)
+    return {str(r.get("group")): float(r.get("flops") or 0.0)
+            for r in rows if r.get("group")}
+
+
+def _drift_pct(old: float, new: float) -> Optional[float]:
+    if not old:
+        return None
+    return (new - old) / old * 100.0
+
+
+# -- pairwise diagnosis ----------------------------------------------
+
+def diagnose_pair(run_a: str, run_b: str, *,
+                  span_drift_pct: float = SPAN_DRIFT_PCT) -> dict:
+    """Compare two results folders (A = before, B = after)."""
+    findings: List[dict] = []
+    spans_a, spans_b = _span_p50s(run_a), _span_p50s(run_b)
+    for name in sorted(set(spans_a) & set(spans_b)):
+        drift = _drift_pct(spans_a[name], spans_b[name])
+        if drift is None:
+            continue
+        title = (f"span '{name}' p50 {spans_a[name] * 1e3:.1f}ms → "
+                 f"{spans_b[name] * 1e3:.1f}ms ({drift:+.1f}%)")
+        if abs(drift) >= span_drift_pct:
+            findings.append(finding(
+                "warn" if drift > 0 else "info", "span_drift", title,
+                rank=drift, span=name, drift_pct=round(drift, 1)))
+        else:
+            findings.append(finding("info", "span_drift", title,
+                                    rank=drift))
+    from novel_view_synthesis_3d_tpu.obs.compiles import (
+        last_recompile,
+        load_ledger,
+    )
+
+    recompiles = [e for e in load_ledger(run_b)
+                  if e.get("kind") == "recompile"]
+    if recompiles:
+        culprit = last_recompile(run_b) or {}
+        findings.append(finding(
+            "page", "recompile",
+            f"{len(recompiles)} recompile(s) in run B",
+            detail=f"changed: {culprit.get('changed', '?')} "
+                   f"(name {culprit.get('name', '?')})",
+            rank=len(recompiles)))
+    else:
+        findings.append(finding("info", "recompile",
+                                "0 recompiles in run B"))
+    from novel_view_synthesis_3d_tpu.obs.bus import read_events
+
+    spikes = [e for e in read_events(run_b)
+              if e.get("event") == "numerics_spike"]
+    if spikes:
+        last = spikes[-1].get("detail", "")
+        findings.append(finding(
+            "warn", "numerics", f"{len(spikes)} numerics spike(s) in "
+            f"run B", detail=last, rank=len(spikes)))
+    cm_a, cm_b = _costmap_flops(run_a), _costmap_flops(run_b)
+    worst_cm: Optional[Tuple[str, float]] = None
+    for group in set(cm_a) & set(cm_b):
+        drift = _drift_pct(cm_a[group], cm_b[group])
+        if drift is None:
+            continue
+        if worst_cm is None or abs(drift) > abs(worst_cm[1]):
+            worst_cm = (group, drift)
+    if worst_cm is not None and abs(worst_cm[1]) >= COST_DRIFT_PCT:
+        findings.append(finding(
+            "warn", "costmap_drift",
+            f"costmap: group '{worst_cm[0]}' flops "
+            f"{worst_cm[1]:+.1f}%", rank=worst_cm[1]))
+    win_a, win_b = _latest_window(run_a), _latest_window(run_b)
+    if win_a and win_b:
+        ga, gb = win_a.get("groups") or {}, win_b.get("groups") or {}
+        for group in sorted(set(ga) & set(gb)):
+            drift = _drift_pct(ga[group], gb[group])
+            if drift is None or abs(drift) < span_drift_pct:
+                continue
+            flops_drift = _drift_pct(cm_a.get(group, 0.0),
+                                     cm_b.get(group, 0.0))
+            flat = flops_drift is not None and abs(flops_drift) < 1.0
+            title = (f"group '{group}' device time {drift:+.1f}%"
+                     + (" while flops flat → memory-bound regression"
+                        if flat and drift > 0 else ""))
+            findings.append(finding(
+                "warn" if drift > 0 else "info", "group_time_drift",
+                title, rank=drift, group=group,
+                drift_pct=round(drift, 1)))
+    ov_a, ov_b = _overlap(run_a), _overlap(run_b)
+    if ov_a is not None and ov_b is not None:
+        title = f"input-pipeline overlap {ov_a:.2f} → {ov_b:.2f}"
+        findings.append(finding(
+            "warn" if ov_b < ov_a - 0.01 else "info",
+            "pipeline_overlap", title, rank=(ov_a - ov_b) * 100))
+    return {"mode": "pair", "run_a": run_a, "run_b": run_b,
+            "findings": rank_findings(findings)}
+
+
+# -- trajectory diagnosis --------------------------------------------
+
+def _judge_points(docs: Sequence[Tuple[int, Optional[dict]]],
+                  tolerance_pct: float) -> List[dict]:
+    """bench_sentry's judging rules over (round, doc) pairs: judgeable
+    iff rc==0 with numeric parsed.vs_baseline; regressed when below 1.0
+    absolute or below the prior rolling median − tolerance."""
+    points: List[dict] = []
+    prior: List[float] = []
+    for rnd, doc in docs:
+        doc = doc or {}
+        parsed = doc.get("parsed") or {}
+        vs = parsed.get("vs_baseline")
+        if doc.get("rc") != 0 or not isinstance(vs, (int, float)):
+            points.append({"round": rnd, "judged": False,
+                           "rc": doc.get("rc")})
+            continue
+        vs = float(vs)
+        floor = (statistics.median(prior)
+                 * (1.0 - tolerance_pct / 100.0)) if prior else None
+        regressed = vs < 1.0 or (floor is not None and vs < floor)
+        points.append({"round": rnd, "judged": True, "vs_baseline": vs,
+                       "regressed": regressed,
+                       "lane": parsed.get("lane")
+                       or parsed.get("platform")})
+        prior.append(vs)
+    return points
+
+
+def diagnose_trajectory(root: str = ".", *,
+                        tolerance_pct: float = DEFAULT_TOLERANCE_PCT
+                        ) -> dict:
+    """Diagnose the banked archive at `root` from artifacts alone."""
+    index = RunIndex(root)
+    bench_entries = index.rounds("BENCH")
+    docs = [(e["round"], index.load_doc(e)) for e in bench_entries]
+    points = _judge_points(docs, tolerance_pct)
+    findings: List[dict] = []
+    judged = [p for p in points if p["judged"]]
+    for p in judged:
+        if not p["regressed"]:
+            continue
+        sev = "page" if p is judged[-1] else "warn"
+        findings.append(finding(
+            sev, "bench_regression",
+            f"r{p['round']:02d} regressed: vs_baseline "
+            f"{p['vs_baseline']:.3f}×",
+            detail=(f"lane {p.get('lane') or '?'}; below its own "
+                    "baseline" if p["vs_baseline"] < 1.0
+                    else f"below rolling median − {tolerance_pct:g}%"),
+            rank=100.0 * (1.0 - p["vs_baseline"]),
+            round=p["round"], vs_baseline=p["vs_baseline"]))
+    # Recovery arc: from the first judged round AFTER the last
+    # regression to the newest — named iff the trajectory actually rose.
+    reg_idx = [i for i, p in enumerate(judged) if p["regressed"]]
+    if reg_idx and reg_idx[-1] + 1 < len(judged):
+        seg = judged[reg_idx[-1] + 1:]
+        first, last = seg[0], seg[-1]
+        if len(seg) >= 2 and last["vs_baseline"] > first["vs_baseline"]:
+            findings.append(finding(
+                "info", "recovery",
+                f"recovery r{first['round']:02d}→r{last['round']:02d}: "
+                f"vs_baseline {first['vs_baseline']:.3f}→"
+                f"{last['vs_baseline']:.3f}",
+                rank=last["vs_baseline"] - first["vs_baseline"]))
+    if judged:
+        newest = judged[-1]
+        findings.append(finding(
+            "info", "newest",
+            f"newest judged round r{newest['round']:02d}: "
+            f"{newest['vs_baseline']:.3f}× "
+            + ("(REGRESSED)" if newest["regressed"] else "(healthy)")))
+    unjudged = [p for p in points if not p["judged"]]
+    if unjudged:
+        rcs = sorted({str(p.get("rc")) for p in unjudged})
+        findings.append(finding(
+            "info", "infra_gap",
+            f"{len(unjudged)} round(s) unjudgeable "
+            f"(rc={','.join(rcs)} — infra, no measurement)"))
+    # Newest round's embedded telemetry vs its own judged history.
+    judged_docs = [doc.get("parsed") or {} for rnd, doc in docs
+                   if (doc or {}).get("rc") == 0
+                   and isinstance(((doc or {}).get("parsed") or {})
+                                  .get("vs_baseline"), (int, float))]
+    if len(judged_docs) >= 2:
+        findings.extend(_history_drift(judged_docs[:-1],
+                                       judged_docs[-1],
+                                       since_round=judged[-2]["round"]
+                                       if len(judged) >= 2 else None))
+    mc_entries = index.rounds("MULTICHIP")
+    mc_ok = [e for e in mc_entries if e.get("rc") == 0 and e.get("ok")]
+    if mc_entries:
+        findings.append(finding(
+            "info", "multichip",
+            f"multichip: {len(mc_ok)}/{len(mc_entries)} rounds ok"))
+    return {"mode": "trajectory", "root": root,
+            "tolerance_pct": tolerance_pct, "points": points,
+            "findings": rank_findings(findings)}
+
+
+def _history_drift(prior_parsed: Sequence[dict], newest: dict, *,
+                   since_round: Optional[int] = None) -> List[dict]:
+    """Span / costmap / profile-group drift of one parsed bench record
+    against its judged predecessors (the embedded-telemetry join)."""
+    findings: List[dict] = []
+    since = f" since r{since_round:02d}" if since_round else ""
+    spans_new = (newest.get("telemetry") or {}).get("spans") or {}
+    for name, s in sorted(spans_new.items()):
+        p50 = s.get("p50_s")
+        if not isinstance(p50, (int, float)) or p50 <= 0:
+            continue
+        prior = [((d.get("telemetry") or {}).get("spans") or {})
+                 .get(name, {}).get("p50_s") for d in prior_parsed]
+        prior = [p for p in prior
+                 if isinstance(p, (int, float)) and p > 0]
+        if not prior:
+            continue
+        base = statistics.median(prior)
+        drift = _drift_pct(base, p50)
+        if drift is None or abs(drift) < SPAN_DRIFT_PCT:
+            continue
+        findings.append(finding(
+            "warn" if drift > 0 else "info", "span_drift",
+            f"span '{name}' p50 {drift:+.1f}%{since} "
+            f"({base * 1e3:.1f}ms → {p50 * 1e3:.1f}ms)",
+            rank=drift, span=name))
+    cm_new = {r.get("group"): r.get("flops")
+              for r in (newest.get("costmap") or [])
+              if isinstance(r.get("flops"), (int, float))}
+    cm_old: Dict[str, float] = {}
+    for d in reversed(list(prior_parsed)):
+        cm_old = {r.get("group"): r.get("flops")
+                  for r in (d.get("costmap") or [])
+                  if isinstance(r.get("flops"), (int, float))}
+        if cm_old:
+            break
+    worst: Optional[Tuple[str, float]] = None
+    for group, flops in cm_new.items():
+        drift = _drift_pct(cm_old.get(group, 0.0), flops)
+        if drift is None:
+            continue
+        if worst is None or abs(drift) > abs(worst[1]):
+            worst = (group, drift)
+    if worst is not None and abs(worst[1]) >= COST_DRIFT_PCT:
+        findings.append(finding(
+            "warn", "costmap_drift",
+            f"costmap: group '{worst[0]}' flops {worst[1]:+.1f}% vs "
+            "last mapped round", rank=worst[1]))
+    return findings
+
+
+# -- sentry-trip attribution -----------------------------------------
+
+def attribute_fresh(prior_parsed: Sequence[dict],
+                    newest_parsed: Optional[dict]) -> dict:
+    """The bench_sentry rc=4 path: diagnose the round it just judged.
+    Returns {"summary": one-liner, "findings": ranked list} — the
+    sentry prints the summary in its page and embeds the findings in
+    its JSON verdict."""
+    if not newest_parsed:
+        return {"summary": None, "findings": []}
+    findings = _history_drift(prior_parsed, newest_parsed)
+    findings = rank_findings(findings)
+    if not findings:
+        return {"summary": ("no span/costmap telemetry in the compared "
+                            "rounds — re-run with telemetry-era "
+                            "bench.py for attribution"),
+                "findings": []}
+    summary = "; ".join(f["title"] for f in findings[:2])
+    return {"summary": summary, "findings": findings}
+
+
+# -- persistence + rendering -----------------------------------------
+
+def doctor_path(results_folder: str) -> str:
+    return os.path.join(results_folder, DOCTOR_FILE)
+
+
+def write_doctor(results_folder: str, doc: dict) -> str:
+    """Land a diagnosis as doctor.json (atomic tmp+rename; this module
+    is the only writer of that filename)."""
+    os.makedirs(results_folder, exist_ok=True)
+    path = doctor_path(results_folder)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_doctor(results_folder: str) -> Optional[dict]:
+    try:
+        with open(doctor_path(results_folder)) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def render(doc: dict, limit: int = 0) -> str:
+    """The ranked findings table `nvs3d obs doctor` prints."""
+    findings = doc.get("findings") or []
+    if limit:
+        findings = findings[:limit]
+    lines: List[str] = []
+    header = f"doctor ({doc.get('mode', '?')})"
+    if doc.get("mode") == "pair":
+        header += f": {doc.get('run_a')} → {doc.get('run_b')}"
+    elif doc.get("mode") == "trajectory":
+        header += f": archive {doc.get('root')}"
+    lines.append(header)
+    if not findings:
+        lines.append("  (no findings — artifacts carry no comparable "
+                     "telemetry)")
+    for i, f in enumerate(findings, 1):
+        sev = f.get("severity", "?").upper()
+        lines.append(f"  {i:>2}. [{sev:<4}] {f.get('title', '')}")
+        if f.get("detail"):
+            lines.append(f"      {f['detail']}")
+    return "\n".join(lines)

@@ -1,0 +1,164 @@
+"""RunIndex: append-only index over the banked perf artifacts.
+
+Every trajectory question so far (bench_sentry, summarize_bench, humans)
+re-globbed ``BENCH_r*.json`` and re-parsed every round from scratch. The
+index scans once and appends one JSONL entry per NEW or CHANGED artifact
+to ``results/runindex.jsonl`` (keyed by path + mtime + size, so a
+re-banked round re-indexes); queries then read the index, not the tree.
+Entries carry just enough to rank without re-opening the bank —
+rc / vs_baseline / ok — while ``load_doc`` fetches the full JSON when
+the doctor needs spans or costmaps.
+
+This module is the ONLY place that names ``runindex.jsonl`` (the same
+single-writer conformance the bus enforces for telemetry files). The
+index is derived state: deleting it merely costs one rescan, so it is
+gitignored, and an unwritable results/ degrades to an in-memory index
+rather than an error. No jax anywhere — the doctor runs on machines
+that never ran the job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+_INDEX_FILE = "runindex.jsonl"
+_ROUND_RE = re.compile(r"^([A-Z][A-Z0-9_]*)_r(\d+)\.json$")
+# Files that mark a directory as a run folder worth indexing.
+_RUN_ARTIFACTS = ("telemetry.jsonl", "metrics.csv", "costmap.json",
+                  "compiles.jsonl", "doctor.json")
+
+
+def runindex_path(root: str) -> str:
+    return os.path.join(root, "results", _INDEX_FILE)
+
+
+def _round_entry(root: str, fname: str) -> Optional[dict]:
+    m = _ROUND_RE.match(fname)
+    if not m:
+        return None
+    path = os.path.join(root, fname)
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    entry = {"kind": "round", "prefix": m.group(1),
+             "round": int(m.group(2)), "path": fname,
+             "mtime": round(st.st_mtime, 3), "size": st.st_size}
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        entry["torn"] = True
+        return entry
+    if isinstance(doc, dict):
+        for key in ("rc", "vs_baseline", "ok", "lane", "preset",
+                    "steps_per_sec"):
+            if key in doc:
+                entry[key] = doc[key]
+    return entry
+
+
+def _run_dir_entry(root: str, rel: str) -> Optional[dict]:
+    d = os.path.join(root, rel)
+    artifacts = [a for a in _RUN_ARTIFACTS
+                 if os.path.exists(os.path.join(d, a))]
+    if not artifacts:
+        return None
+    newest = max(os.path.getmtime(os.path.join(d, a)) for a in artifacts)
+    size = sum(os.path.getsize(os.path.join(d, a)) for a in artifacts)
+    return {"kind": "run_dir", "path": rel, "artifacts": artifacts,
+            "mtime": round(newest, 3), "size": size}
+
+
+class RunIndex:
+    """Index over one archive root (the repo root in the banked layout:
+    BENCH_r*/MULTICHIP_r* at top level, run folders under results/)."""
+
+    def __init__(self, root: str = "."):
+        self.root = root
+        self.path = runindex_path(root)
+
+    # -- persistence ---------------------------------------------------
+    def _read(self) -> Dict[str, dict]:
+        """Last indexed entry per path (later lines supersede)."""
+        out: Dict[str, dict] = {}
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue  # torn tail, crash-tolerant like the ledger
+                if isinstance(entry, dict) and "path" in entry:
+                    out[entry["path"]] = entry
+        return out
+
+    def _scan(self) -> List[dict]:
+        entries: List[dict] = []
+        try:
+            top = sorted(os.listdir(self.root))
+        except OSError:
+            return entries
+        for fname in top:
+            e = _round_entry(self.root, fname)
+            if e is not None:
+                entries.append(e)
+        results = os.path.join(self.root, "results")
+        if os.path.isdir(results):
+            e = _run_dir_entry(self.root, "results")
+            if e is not None:
+                entries.append(e)
+            for sub in sorted(os.listdir(results)):
+                rel = os.path.join("results", sub)
+                if os.path.isdir(os.path.join(self.root, rel)):
+                    e = _run_dir_entry(self.root, rel)
+                    if e is not None:
+                        entries.append(e)
+        return entries
+
+    def refresh(self) -> List[dict]:
+        """Scan the tree, append entries for new/changed artifacts, and
+        return the CURRENT full entry list. A read-only results/ keeps
+        the scan result in memory (index file simply not advanced)."""
+        known = self._read()
+        scanned = self._scan()
+        fresh = [e for e in scanned
+                 if known.get(e["path"], {}).get("mtime") != e["mtime"]
+                 or known.get(e["path"], {}).get("size") != e["size"]]
+        if fresh:
+            try:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                with open(self.path, "a") as fh:
+                    for e in fresh:
+                        fh.write(json.dumps(e) + "\n")
+            except OSError:
+                pass  # derived state; in-memory result still correct
+        return scanned
+
+    # -- queries -------------------------------------------------------
+    def rounds(self, prefix: str = "BENCH") -> List[dict]:
+        """Indexed round entries for one bank prefix, round-ordered."""
+        entries = [e for e in self.refresh()
+                   if e.get("kind") == "round"
+                   and e.get("prefix") == prefix]
+        entries.sort(key=lambda e: e["round"])
+        return entries
+
+    def run_dirs(self) -> List[dict]:
+        return [e for e in self.refresh() if e.get("kind") == "run_dir"]
+
+    def load_doc(self, entry: dict) -> Optional[dict]:
+        """Full JSON for a round entry; None on torn files."""
+        try:
+            with open(os.path.join(self.root, entry["path"])) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None

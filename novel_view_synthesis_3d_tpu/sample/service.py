@@ -589,7 +589,7 @@ class SamplingService:
                  serve: Optional[ServeConfig] = None, *,
                  mesh=None, results_folder: Optional[str] = None,
                  start: bool = True, tracer=None, flight=None,
-                 model_version: str = ""):
+                 profiler=None, model_version: str = ""):
         self.model = model
         self.diffusion = diffusion
         self.serve = serve or ServeConfig()
@@ -677,6 +677,11 @@ class SamplingService:
         self.anomalies = 0
         self.worker_restarts = 0
         self.dispatches = 0
+        # Continuous profiler (obs/profiler.py, obs.profile.serve_*):
+        # windows counted in dispatches, advanced on the worker thread
+        # at each dispatch site. `nvs3d serve` passes one wired to its
+        # RunTelemetry bus; embedded/test use defaults to None (off).
+        self._profiler = profiler
         # Compile ledger (obs/compiles.py): every sampler-program build
         # lands in compiles.jsonl with a field-named fingerprint, so a
         # recompile names the knob that changed (bucket, steps, shape…) —
@@ -830,6 +835,10 @@ class SamplingService:
                     f"diagnosis written under {self._results_folder!r} "
                     "(stall_serve_stop_*.txt)")
             self._worker = None
+        if self._profiler is not None:
+            # Close out a window left open mid-capture; the worker is
+            # joined, so no dispatch races the stop_trace/parse.
+            self._profiler.close()
         self._serve_state_gauge.set(2.0)
         # A swap staged but not yet applied must not leave its waiter
         # hanging: apply it inline (no dispatch can be in flight now).
@@ -1935,6 +1944,8 @@ class SamplingService:
         the orbit's LAST frame makes the slot exit the ring."""
         self.dispatches += 1
         self._last_dispatch_t = time.time()
+        if self._profiler is not None:
+            self._profiler.on_step(self.dispatches)
         faultinject.maybe_serve_dispatch_raise(self.dispatches)
         faultinject.maybe_serve_slow_step(self.dispatches)
         nan_at = faultinject.serve_nan_spec()
@@ -2585,6 +2596,8 @@ class SamplingService:
     def _dispatch(self, group: List[_Request]) -> None:
         self.dispatches += 1
         self._last_dispatch_t = time.time()
+        if self._profiler is not None:
+            self._profiler.on_step(self.dispatches)
         faultinject.maybe_serve_dispatch_raise(self.dispatches)
         n = len(group)
         bucket = bucket_for(n, self.serve.max_batch)

@@ -145,10 +145,15 @@ def main(argv=None) -> int:
             model_version = vid
 
     telemetry = obs.RunTelemetry.create(cfg.obs, results_folder)
+    profiler = (obs.make_profiler(cfg.obs.profile, results_folder,
+                                  cfg.model, telemetry.bus,
+                                  telemetry.registry, unit="dispatch")
+                if cfg.obs.enabled else None)
     service = SamplingService(
         model, params, cfg.diffusion, cfg.serve,
         results_folder=results_folder, tracer=telemetry.tracer,
-        flight=telemetry.flight, model_version=model_version)
+        flight=telemetry.flight, profiler=profiler,
+        model_version=model_version)
     watcher = None
     if store is not None:
         from novel_view_synthesis_3d_tpu.registry import RegistryWatcher

@@ -435,6 +435,16 @@ class Trainer:
                 every=tcfg.numerics.every,
                 spike_z=tcfg.numerics.spike_z,
                 ewma_decay=tcfg.numerics.ewma_decay)
+        # Continuous profiler (obs.profile): re-arming jax.profiler
+        # windows attributed to the same op-group vocabulary. Host-side
+        # only; the loop hook sits next to the one-shot xprof window's.
+        self._profiler = obs.make_profiler(
+            config.obs.profile, tcfg.results_folder, config.model,
+            self.telemetry.bus, reg) if config.obs.enabled else None
+        # armed_steps_total snapshot at the last metrics log: a log
+        # interval that overlapped a profile window skips the step-rate
+        # gauges (the overhead-exclusion contract).
+        self._profiler_armed_mark = 0
         # /healthz progress facts: an external probe distinguishes
         # wedged-but-listening from healthy by last_step_age_s.
         self._last_step_t = time.time()
@@ -867,6 +877,10 @@ class Trainer:
                 # Drain, don't drop: the final snapshot is usually the
                 # one an operator wants to promote.
                 self._publisher.stop(drain=True)
+            # A window open at exit (run ended mid-capture) still stops,
+            # parses, and lands its row — before the bus closes.
+            if self._profiler is not None:
+                self._profiler.close()
             # Export trace.json, stop the device monitor, close the bus
             # and endpoint. Idempotent; a crashed run still gets its
             # trace up to the fault.
@@ -898,6 +912,9 @@ class Trainer:
                 # ±1-dispatch skew; a device_get here would add a sync to
                 # EVERY iteration just to arm a rarely-used capture.
                 self.telemetry.xprof.on_step(self._step_host)
+            if self._profiler is not None:
+                # Continuous profiling window (same sync-free estimate).
+                self._profiler.on_step(self._step_host)
             if self._device_batch is None:
                 try:
                     self._device_batch = self._staged_batch()
@@ -985,7 +1002,17 @@ class Trainer:
                          rollbacks=self._rollbacks,
                          restarts=self._restarts, **util),
                     tcfg.batch_size)
-                self._update_gauges(logged, util)
+                # Overhead-exclusion contract (obs.profile): a log
+                # interval that overlapped a profile window carries the
+                # window's arm/parse host time in its wall clock, so its
+                # step-rate samples are excluded from the rate gauges
+                # (metrics.csv keeps every row — the gauges feed alerts).
+                armed = (self._profiler.armed_steps_total
+                         if self._profiler is not None else 0)
+                self._update_gauges(
+                    logged, util,
+                    exclude_rates=armed != self._profiler_armed_mark)
+                self._profiler_armed_mark = armed
                 print(f"{step_now}: loss={logged['loss']:.5f} "
                       f"imgs/s/chip={logged['imgs_per_sec_per_chip']:.2f}")
                 last_metrics = logged
@@ -1127,12 +1154,18 @@ class Trainer:
                 out["mfu"] = m
         return out
 
-    def _update_gauges(self, logged: dict, util: dict) -> None:
-        self._gauge_steps_per_sec.set(logged["steps_per_sec"])
-        self._gauge_imgs_per_sec.set(logged["imgs_per_sec_per_chip"])
+    def _update_gauges(self, logged: dict, util: dict,
+                       exclude_rates: bool = False) -> None:
+        # exclude_rates: this log interval overlapped a continuous-
+        # profiler window, so its wall clock includes arm/parse host
+        # time — rate gauges (and the rate-derived MFU) keep their last
+        # clean sample rather than alerting on profiler overhead.
+        if not exclude_rates:
+            self._gauge_steps_per_sec.set(logged["steps_per_sec"])
+            self._gauge_imgs_per_sec.set(logged["imgs_per_sec_per_chip"])
+            if "mfu" in util:
+                self._gauge_mfu.set(util["mfu"])
         self._gauge_loss.set(logged["loss"])
-        if "mfu" in util:
-            self._gauge_mfu.set(util["mfu"])
 
     def _registry_snapshot(self, step_now: int):
         """Host numpy copy of the publishable tree: the EMA when the run

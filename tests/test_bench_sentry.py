@@ -46,14 +46,15 @@ def chip_doc(n_devices=8, ok=True, skipped=False, rc=0):
 def test_real_archive_flags_r09(sentry, capsys):
     verdict = sentry.judge(REPO_ROOT)
     # The banked r09 regression (0.973x) stays flagged in the table
-    # forever — but only the NEWEST judged round pages, and r16/r17
-    # won the speed back (1.026x/1.085x), so the verdict is healthy.
+    # forever — but only the NEWEST judged round pages, and r16-r18
+    # won the speed back (1.026x/1.085x/1.372x), so the verdict is
+    # healthy.
     r09 = next(p for p in verdict["bench"] if p["round"] == 9)
     assert r09["judged"] and r09["regressed"]
     assert r09["vs_baseline"] == pytest.approx(0.973)
     nb = verdict["newest_bench"]
-    assert nb["round"] == 17 and not nb["regressed"]
-    assert nb["vs_baseline"] == pytest.approx(1.085)
+    assert nb["round"] == 18 and not nb["regressed"]
+    assert nb["vs_baseline"] == pytest.approx(1.372)
     # Infra rounds (r02 timeout, r03-r05 probe refusals) were skipped,
     # not judged against the trajectory.
     skipped = [p["round"] for p in verdict["bench"] if not p["judged"]]

@@ -279,7 +279,10 @@ def test_no_direct_csv_writers_outside_obs():
                         and node.value in ("events.csv", "metrics.csv",
                                            "telemetry.jsonl",
                                            "numerics.jsonl",
-                                           "compiles.jsonl")):
+                                           "compiles.jsonl",
+                                           "doctor.json",
+                                           "runindex.jsonl",
+                                           "profile_window")):
                     offenders.append(
                         f"{os.path.relpath(path, pkg_root)}:{node.lineno}"
                         f" -> {node.value!r}")

@@ -158,60 +158,24 @@ def multichip_verdicts(rounds: List[dict]) -> List[dict]:
     return points
 
 
-def attribute_regression(prior_docs: List[dict],
-                         newest_doc: Optional[dict]) -> Optional[str]:
-    """One-line WHERE for a sentry trip: the span whose p50 grew most
-    vs the prior rounds' median, plus the cost-map group whose FLOPs
-    moved most vs the last round that carried a cost map. Reads the
-    telemetry bench.py embeds in each judged record; returns None when
-    neither the newest nor the prior rounds carry any."""
+def doctor_attribution(prior_docs: List[dict],
+                       newest_doc: Optional[dict]) -> dict:
+    """Trip attribution, delegated to the obs regression doctor
+    (novel_view_synthesis_3d_tpu/obs/doctor.py — the ranked diagnosis
+    engine this tool's ad-hoc attribute_regression grew into). Returns
+    {"summary": one-liner or None, "findings": ranked list} so the
+    rc=4 page can embed the doctor's top findings, not just one line."""
     if not newest_doc:
-        return None
-    parts = []
-    spans = ((newest_doc.get("telemetry") or {}).get("spans") or {})
-    worst = None
-    for name, s in spans.items():
-        p50 = s.get("p50_s")
-        if not isinstance(p50, (int, float)) or p50 <= 0:
-            continue
-        prior = [((d.get("telemetry") or {}).get("spans") or {})
-                 .get(name, {}).get("p50_s") for d in prior_docs]
-        prior = [p for p in prior if isinstance(p, (int, float)) and p > 0]
-        if not prior:
-            continue
-        base = statistics.median(prior)
-        drift = (p50 - base) / base * 100.0
-        if worst is None or drift > worst[1]:
-            worst = (name, drift, p50, base)
-    if worst is not None:
-        name, drift, p50, base = worst
-        parts.append(f"span '{name}' p50 {p50 * 1e3:.1f}ms vs prior "
-                     f"median {base * 1e3:.1f}ms ({drift:+.0f}%)")
-    new_cm = {r.get("group"): r.get("flops")
-              for r in (newest_doc.get("costmap") or [])
-              if isinstance(r.get("flops"), (int, float))}
-    old_cm = {}
-    for d in reversed(prior_docs):
-        old_cm = {r.get("group"): r.get("flops")
-                  for r in (d.get("costmap") or [])
-                  if isinstance(r.get("flops"), (int, float))}
-        if old_cm:
-            break
-    worst_cm = None
-    for group, flops in new_cm.items():
-        base = old_cm.get(group)
-        if not base:
-            continue
-        drift = (flops - base) / base * 100.0
-        if worst_cm is None or abs(drift) > abs(worst_cm[1]):
-            worst_cm = (group, drift)
-    if worst_cm is not None and abs(worst_cm[1]) >= 0.5:
-        parts.append(f"costmap: group '{worst_cm[0]}' flops "
-                     f"{worst_cm[1]:+.0f}% vs last mapped round")
-    if not parts:
-        return ("no span/costmap telemetry in the compared rounds — "
-                "re-run with telemetry-era bench.py for attribution")
-    return "; ".join(parts)
+        return {"summary": None, "findings": []}
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    try:
+        from novel_view_synthesis_3d_tpu.obs import doctor as doctor_lib
+    except ImportError:
+        return {"summary": ("obs.doctor unavailable (package not "
+                            "importable from this checkout) — no "
+                            "attribution"), "findings": []}
+    return doctor_lib.attribute_fresh(prior_docs, newest_doc)
 
 
 def judge(dirpath: str,
@@ -233,15 +197,19 @@ def judge(dirpath: str,
 
     nb, nm = newest(bench), newest(multichip)
     attribution = None
+    doctor: List[dict] = []
     if nb and nb["regressed"]:
         judged_docs = [(r["doc"] or {}).get("parsed") or {}
                        for r in rounds
                        if (r["doc"] or {}).get("rc") == 0]
         if fresh_vs is not None:
-            attribution = attribute_regression(judged_docs, fresh_doc)
+            diag = doctor_attribution(judged_docs, fresh_doc)
         elif judged_docs:
-            attribution = attribute_regression(judged_docs[:-1],
-                                               judged_docs[-1])
+            diag = doctor_attribution(judged_docs[:-1], judged_docs[-1])
+        else:
+            diag = {"summary": None, "findings": []}
+        attribution = diag["summary"]
+        doctor = diag["findings"]
     return {
         "bench": bench,
         "multichip": multichip,
@@ -250,6 +218,7 @@ def judge(dirpath: str,
         "regressed": bool((nb and nb["regressed"])
                           or (nm and nm["regressed"])),
         "attribution": attribution,
+        "doctor": doctor,
         "tolerance_pct": tolerance_pct,
     }
 
@@ -296,6 +265,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                  if verdict["regressed"] else "healthy"))
         if verdict["regressed"] and verdict.get("attribution"):
             print(f"attribution: {verdict['attribution']}")
+        # Doctor embedding: the rc=4 page carries the top ranked
+        # findings, so the on-call reads WHAT moved without re-running
+        # anything.
+        for i, f in enumerate(verdict.get("doctor") or [], 1):
+            if i > 3:
+                break
+            print(f"doctor {i}. [{f.get('severity', '?').upper()}] "
+                  f"{f.get('title', '')}")
     return REGRESSION_RC if verdict["regressed"] else 0
 
 

@@ -689,6 +689,101 @@ def costmap_lines(search_dirs, rows):
     return lines
 
 
+def doctor_lines(search_dirs, repo_root):
+    """Performance-observatory digest: the top ranked findings from any
+    banked doctor.json (obs/doctor.py's cross-run regression doctor)
+    plus the roofline top-k headroom table for runs that captured
+    continuous-profiler windows. Both joins are loud about absence —
+    'no doctor verdict' must read as 'doctor never ran', never as
+    'nothing wrong'."""
+    import glob
+
+    lines = ["", "## Doctor (ranked cross-run diagnosis + roofline "
+                 "headroom, from doctor.json / profile windows)", ""]
+    docs = []  # (path, findings)
+    seen = set()
+    for d in search_dirs:
+        for path in sorted(glob.glob(
+                os.path.join(d, "**", "doctor.json"), recursive=True)):
+            if path in seen:
+                continue
+            seen.add(path)
+            try:
+                with open(path) as fh:
+                    doc = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                lines.append(f"- `{path}`: SKIPPED (malformed)")
+                continue
+            docs.append((path, doc.get("findings") or []))
+    if docs:
+        for path, findings in docs:
+            lines.append(f"- `{path}`: {len(findings)} finding(s)")
+            for f in findings[:3]:
+                lines.append(
+                    "  - [{}] {}{}".format(
+                        str(f.get("severity", "?")).upper(),
+                        f.get("title", ""),
+                        f" — {f['detail']}" if f.get("detail") else ""))
+    else:
+        lines.append("- none recorded — SKIPPED: no doctor.json under "
+                     "the scanned dirs (run `nvs3d obs doctor "
+                     "--trajectory --out RUN/doctor.json` to bank a "
+                     "verdict)")
+    # Roofline: measured per-group device time (continuous-profiler
+    # windows in telemetry.jsonl) joined against costmap FLOPs/bytes.
+    # Needs the package importable — summarize_bench is otherwise
+    # stdlib-only, so the join degrades to a named skip, not a crash.
+    lines += ["", "### Roofline (measured group time vs costmap "
+                  "FLOPs/bytes)", ""]
+    try:
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        from novel_view_synthesis_3d_tpu.obs import roofline
+    except ImportError:
+        lines.append("- SKIPPED: novel_view_synthesis_3d_tpu not "
+                     "importable from this checkout — no roofline join")
+        return lines
+    run_dirs = []
+    for d in search_dirs:
+        for path in sorted(glob.glob(
+                os.path.join(d, "**", "telemetry.jsonl"),
+                recursive=True)):
+            run_dirs.append(os.path.dirname(path))
+    if not run_dirs:
+        lines.append("- SKIPPED: no telemetry.jsonl under the scanned "
+                     "dirs — no profile windows to attribute")
+        return lines
+    reported = False
+    for rd in run_dirs:
+        try:
+            report = roofline.analyze_run(rd)
+        except Exception as exc:  # noqa: BLE001 — digest must not crash
+            lines.append(f"- `{rd}`: SKIPPED (roofline failed: {exc})")
+            continue
+        if not report.get("rows"):
+            continue  # no profile windows in this run; note below
+        reported = True
+        lines.append(f"- `{rd}`:")
+        for note in report.get("notes") or []:
+            lines.append(f"  - note: {note}")
+        # Headroom needs chip peaks (TPU); on peak-less runs fall back
+        # to the biggest measured time sinks so the table never empties.
+        top = (roofline.top_headroom(report["rows"], k=3)
+               or report["rows"][:3])
+        for r in top:
+            mfu = r.get("mfu")
+            lines.append(
+                "  - {}: {:.1f}ms {}{}".format(
+                    r.get("group"), 1e3 * float(r.get("time_s") or 0.0),
+                    r.get("bound", "?"),
+                    f" mfu={mfu:.1%}" if isinstance(mfu, float) else ""))
+    if not reported:
+        lines.append("- SKIPPED: no profile_window rows in any scanned "
+                     "telemetry.jsonl (obs.profile.enabled=false, or a "
+                     "pre-observatory round)")
+    return lines
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     out_dir = args[0] if args else os.path.join("results", "tpu_r04")
@@ -789,6 +884,9 @@ def main() -> int:
     # costmap.json (or the copy embedded in a judged bench record).
     lines += numerics_lines([out_dir] + quality_dirs)
     lines += costmap_lines([out_dir] + quality_dirs, rows)
+    # Performance observatory: ranked doctor findings + roofline
+    # headroom for runs that captured continuous-profiler windows.
+    lines += doctor_lines([out_dir] + quality_dirs, repo_root)
     text = "\n".join(lines) + "\n"
     print(text)
     if "--write" in sys.argv:
