@@ -100,6 +100,16 @@ def cmd_train(args, overrides: List[str]) -> int:
     # train run paid the full XLA compile (utils/xla_cache.py).
     setup_compilation_cache()
 
+    if cfg.train.ladder:
+        # Resolution ladder (train/ladder.py): consecutive rung runs over
+        # one checkpoint_dir; rung selection and mid-rung fast-forward
+        # both derive from the restored step, so plain re-invocation
+        # resumes exactly where the last run stopped.
+        from novel_view_synthesis_3d_tpu.train.ladder import run_ladder
+
+        last = run_ladder(cfg, use_grain=not args.no_grain)
+        return (EXIT_STALL if last is not None and last.stalled else 0)
+
     from novel_view_synthesis_3d_tpu.train.trainer import Trainer
 
     trainer = Trainer(config=cfg, use_grain=not args.no_grain)
@@ -153,7 +163,12 @@ def _restore_params(cfg: Config, model, sample_batch: dict, step: Optional[int],
         raise FileNotFoundError(
             f"no checkpoint under {cfg.train.checkpoint_dir!r} — train first "
             "(the reference fails the same way: sampling.py:111-112)")
-    state = ckpt.restore(template, step=step)
+    # Growth-compat restore (train/ladder.py): a pre-num_classes
+    # checkpoint loads into the grown template with the category table's
+    # zero-init spliced in (asserted neutral).
+    from novel_view_synthesis_3d_tpu.train.ladder import restore_with_growth
+
+    state = restore_with_growth(ckpt, template, step=step)
     ckpt.close()
     params = state.ema_params if state.ema_params is not None else state.params
     return jax.device_get(params), int(jax.device_get(state.step))
@@ -795,6 +810,7 @@ def cmd_pack(args, overrides: List[str]) -> int:
     index = records.pack_srn(
         args.src, args.out, shard_mb=args.shard_mb,
         max_num_instances=args.max_instances,
+        name=args.name, classes=args.classes,
         progress=((lambda name, views, shard: print(
             f"  packed {name} ({views} views) -> shard {shard}"))
             if args.progress else None))
@@ -804,6 +820,7 @@ def cmd_pack(args, overrides: List[str]) -> int:
         "instances": index["num_instances"],
         "views": index["num_views"],
         "bytes": sum(s["bytes"] for s in index["shards"]),
+        "meta": index.get("meta"),
     }))
     if args.verify:
         return run_verify(args.out)
@@ -1018,6 +1035,45 @@ def _gate_probe_batch(cfg, folder: Optional[str]) -> dict:
                               seed=rcfg.gate_seed)
 
 
+def _gate_matrix_cells(cfg, model, folder, *, psnr_sample_steps: int):
+    """Probe cells for the (corpus × rung-resolution) gate matrix.
+
+    One PSNR probe per corpus of `data.mix` (or the single training
+    root) at EVERY resolution the run trains at (train/ladder.py
+    `ladder_resolutions`) — a candidate that regressed at the 64px rung
+    must not ship on the strength of its 128px cells, and vice versa.
+    Each cell's batch is drawn fixed-seed from that corpus at that
+    resolution, falling back to the synthetic harness per cell."""
+    from novel_view_synthesis_3d_tpu.registry import make_psnr_probe
+    from novel_view_synthesis_3d_tpu.train.ladder import ladder_resolutions
+
+    rcfg = cfg.registry
+    if cfg.data.mix:
+        from novel_view_synthesis_3d_tpu.data.corpus import parse_mix_spec
+
+        corpora = [(s.name, s.path) for s in parse_mix_spec(cfg.data.mix)]
+    else:
+        corpora = [("train", folder or cfg.data.root_dir)]
+    cells = []
+    for name, root in corpora:
+        for res in ladder_resolutions(cfg):
+            ccfg = cfg.override(**{
+                "data.root_dir": root or "",
+                "data.img_sidelength": res,
+                "data.mix": "",
+            })
+            cells.append({
+                "corpus": name,
+                "resolution": res,
+                "metric": "psnr",
+                "probe_fn": make_psnr_probe(
+                    model, cfg.diffusion, _gate_probe_batch(ccfg, None),
+                    sample_steps=psnr_sample_steps, seed=rcfg.gate_seed,
+                    precision=cfg.serve.precision),
+            })
+    return cells
+
+
 def _run_gates(cfg, model, store, vid: str, channel: str, batch: dict,
                *, psnr_sample_steps: int, event_cb, folder=None):
     """Run every configured promotion gate for one candidate.
@@ -1027,11 +1083,16 @@ def _run_gates(cfg, model, store, vid: str, channel: str, batch: dict,
     probe (adjacent-frame PSNR over a fixed stochastic-conditioning
     orbit, registry/gate.make_trajectory_probe) under the SAME
     gate_margin_db — so distilled/quantized candidates are judged on
-    trajectory quality, not just single-frame fidelity. Prints one JSON
-    line per gate; returns (all_passed, gate_result_for_promote)."""
-    del folder
+    trajectory quality, not just single-frame fidelity. A `data.mix` or
+    `train.ladder` run additionally gates on the per-corpus ×
+    per-rung-resolution PSNR MATRIX (registry/gate.run_gate_matrix; one
+    regressed cell refuses the promotion), with the matrix landed as
+    gate_matrix.json in the registry root for summarize_bench. Prints
+    one JSON line per gate; returns (all_passed,
+    gate_result_for_promote)."""
     from novel_view_synthesis_3d_tpu.registry import (
-        make_psnr_probe, make_trajectory_probe, run_gate)
+        GateResult, make_psnr_probe, make_trajectory_probe, run_gate,
+        run_gate_matrix)
 
     rcfg = cfg.registry
     probes = [("psnr", make_psnr_probe(
@@ -1060,6 +1121,38 @@ def _run_gates(cfg, model, store, vid: str, channel: str, batch: dict,
         last = gate
         if not gate.passed:
             return False, gate
+    if cfg.data.mix or cfg.train.ladder:
+        matrix = run_gate_matrix(
+            store, vid, channel=channel,
+            cells=_gate_matrix_cells(cfg, model, folder,
+                                     psnr_sample_steps=psnr_sample_steps),
+            margin_db=cfg.registry.gate_margin_db, event_cb=event_cb)
+        artifact = os.path.join(store.root, "gate_matrix.json")
+        with open(artifact, "w") as fh:
+            json.dump({
+                "candidate": matrix.candidate,
+                "incumbent": matrix.incumbent,
+                "margin_db": matrix.margin_db,
+                "passed": matrix.passed,
+                "cells": list(matrix.cells),
+            }, fh, indent=2)
+        print(json.dumps({
+            "metric": "matrix", "passed": matrix.passed,
+            "cells": len(matrix.cells),
+            "failed": sum(1 for c in matrix.cells if not c["passed"]),
+            "artifact": artifact}))
+        if not matrix.passed:
+            worst = min((c for c in matrix.cells if not c["passed"]),
+                        key=lambda c: (c["delta_db"]
+                                       if c["delta_db"] is not None
+                                       else 0.0))
+            return False, GateResult(
+                passed=False, candidate=vid, incumbent=matrix.incumbent,
+                candidate_psnr=worst["candidate_psnr"],
+                incumbent_psnr=worst["incumbent_psnr"],
+                margin_db=matrix.margin_db,
+                reason=(f"matrix cell {worst['corpus']}@"
+                        f"{worst['resolution']}px: {worst['reason']}"))
     return True, last
 
 
@@ -1815,6 +1908,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "— per-host reads slice at shard granularity")
     p.add_argument("--max-instances", type=int, default=-1,
                    help="pack only the first N instances (-1 = all)")
+    p.add_argument("--name", default=None,
+                   help="corpus name recorded in index.json meta (default: "
+                        "the source dir's basename); the mixer's stats and "
+                        "gauges use it")
+    p.add_argument("--class", dest="classes", action="append", default=None,
+                   metavar="NAME",
+                   help="scene-class vocab entry for index.json meta "
+                        "(repeatable; default: the corpus name)")
     p.add_argument("--verify", action="store_true",
                    help="after packing (or on an existing corpus with no "
                         "--out): re-hash every shard, cross-check "

@@ -98,6 +98,17 @@ class ModelConfig:
     # ppermute over ICI). Requires mesh.seq > 1 and token counts divisible
     # by it; a no-op when the mesh has seq=1.
     sequence_parallel: bool = False
+    # Scene-category conditioning (ROADMAP item 5): > 0 adds a ZERO-INIT
+    # category embedding table (num_classes, emb_ch) inside
+    # ConditioningProcessor_0, looked up by the batch's int32 `category`
+    # ids and added to the logsnr embedding BEHIND the CFG cond-drop mask
+    # (so classifier-free guidance and distillation drop it together with
+    # the pose conditioning). Zero-init makes enabling it a numeric no-op
+    # at init, and lets checkpoints taken at num_classes=0 load into a
+    # num_classes>0 model via the versioned param-tree splice
+    # (train/ladder.restore_with_growth). 0 = off (no table, param tree
+    # unchanged).
+    num_classes: int = 0
 
     @property
     def num_frames(self) -> int:
@@ -202,6 +213,18 @@ class DataConfig:
     # before the batch is declared unbuildable. Uniform across the python,
     # Grain, and native backends. 0 = faults are fatal (old behavior).
     max_record_retries: int = 3
+    # Corpus mixer (data/corpus.py; ROADMAP item 5): '' = off (root_dir is
+    # the single corpus, exactly the pre-mixer behavior). Otherwise a
+    # comma-separated list of `name:weight:path` entries, e.g.
+    # "cars:3:/data/cars_packed,chairs:1:/data/chairs_packed" — N named
+    # packed corpora sampled per batch-slot with probability weight/Σ,
+    # drawn from the SAME single sequential rng as the plain packed
+    # loader (a one-corpus mix is bit-identical to backend='packed'
+    # today). Requires backend='packed'; every corpus must be a `nvs3d
+    # pack` output dir. Batches gain int32 `corpus_id` (loss attribution)
+    # and `category` (scene-category conditioning when model.num_classes
+    # > 0) fields.
+    mix: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -426,6 +449,21 @@ class TrainConfig:
     # integrity walk-back) at most this many times, with exponential
     # backoff, then gives up loudly.
     max_restarts: int = 3
+    # Resolution ladder (train/ladder.py; ROADMAP item 5): '' = off (one
+    # flat run at data.img_sidelength for num_steps). Otherwise a
+    # comma-separated `res:steps` schedule, e.g. "64:20000,128:10000" —
+    # progressive training that runs each rung at its resolution for its
+    # step count against ONE checkpoint_dir (the fully-convolutional
+    # XUNet keeps an identical param tree at every resolution, PROVIDED
+    # model.attn_resolutions selects the same UNet levels at every rung
+    # — validate() enforces this). Rung
+    # boundaries are canonical checkpoint boundaries (forced save), rung
+    # selection on resume derives from the restored step alone, and
+    # mid-rung resume is bit-identical to an uninterrupted rung. The
+    # promotion gate probes at EVERY rung resolution
+    # (registry/gate.run_gate_matrix). Overrides train.num_steps with the
+    # schedule's cumulative total.
+    ladder: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1135,6 +1173,100 @@ class Config:
                 f"data.prefetch={d.prefetch} must be >= 1 with "
                 "data.backend='packed' (it sizes the pipelined loader's "
                 "decode-ahead depth)")
+        if d.mix:
+            # Mirrors the train.adam_mu_dtype style: structural checks
+            # with the semantics in the message — a malformed mix spec
+            # must fail at startup, never as a mid-run KeyError.
+            if d.backend != "packed":
+                errors.append(
+                    f"data.mix requires data.backend='packed' (got "
+                    f"{d.backend!r}) — the mixer samples across `nvs3d "
+                    "pack` corpora, the files backend has no corpus "
+                    "identity")
+            seen_names = set()
+            for entry in d.mix.split(","):
+                parts = entry.strip().split(":", 2)
+                if len(parts) != 3 or not all(p.strip() for p in parts):
+                    errors.append(
+                        f"data.mix entry {entry.strip()!r} must be "
+                        "'name:weight:path' (e.g. "
+                        "'cars:3:/data/cars_packed')")
+                    continue
+                name, weight, _path = (p.strip() for p in parts)
+                if name in seen_names:
+                    errors.append(
+                        f"data.mix names corpus {name!r} twice — names "
+                        "key the per-corpus metrics and must be unique")
+                seen_names.add(name)
+                try:
+                    w = float(weight)
+                except ValueError:
+                    w = -1.0
+                if w <= 0:
+                    errors.append(
+                        f"data.mix corpus {name!r} has weight "
+                        f"{weight!r} — must be a number > 0 (weights "
+                        "are relative sampling odds, normalized over "
+                        "the mix)")
+        if m.num_classes < 0:
+            errors.append(
+                f"model.num_classes={m.num_classes} must be >= 0 (0 = no "
+                "category conditioning, > 0 sizes the zero-init category "
+                "embedding table)")
+        if t.ladder:
+            # Same loud-at-startup contract as data.mix above.
+            rungs = []
+            for entry in t.ladder.split(","):
+                parts = entry.strip().split(":")
+                if len(parts) != 2:
+                    errors.append(
+                        f"train.ladder entry {entry.strip()!r} must be "
+                        "'resolution:steps' (e.g. '64:20000,128:10000')")
+                    continue
+                try:
+                    res, steps = int(parts[0]), int(parts[1])
+                except ValueError:
+                    errors.append(
+                        f"train.ladder entry {entry.strip()!r} must be "
+                        "two integers 'resolution:steps'")
+                    continue
+                if res < 8 or res & (res - 1) != 0:
+                    errors.append(
+                        f"train.ladder resolution {res} must be a power "
+                        "of two >= 8 (the UNet downsample chain halves "
+                        "H/W per level)")
+                if steps < 1:
+                    errors.append(
+                        f"train.ladder rung {entry.strip()!r} must train "
+                        "for >= 1 step")
+                rungs.append(res)
+            if rungs != sorted(rungs):
+                errors.append(
+                    f"train.ladder={t.ladder!r} resolutions must be "
+                    "non-decreasing — the ladder is progressive "
+                    "low-to-high (64 before 128)")
+            # The rung param trees must be STRUCTURALLY identical (one
+            # checkpoint spans the ladder). Conv/norm shapes are
+            # resolution-free, but model.attn_resolutions is keyed on
+            # absolute feature-map resolution — if it selects different
+            # UNet LEVELS at different rung resolutions, the trees
+            # diverge (AttnBlock params appear under different blocks).
+            patterns = {
+                res: tuple(
+                    lvl for lvl in range(len(m.ch_mult))
+                    if (res >> lvl) in m.attn_resolutions)
+                for res in sorted(set(rungs))}
+            if len(set(patterns.values())) > 1:
+                errors.append(
+                    f"train.ladder={t.ladder!r} places attention at "
+                    "different UNet levels per rung "
+                    f"({ {r: list(p) for r, p in patterns.items()} }): "
+                    "model.attn_resolutions is keyed on absolute "
+                    "feature-map resolution, so the rung param trees "
+                    "would be structurally incompatible — choose "
+                    "attn_resolutions that select the SAME levels at "
+                    "every rung resolution (e.g. [] to disable "
+                    "attention for the ladder run)")
         if t.max_restarts < 0:
             errors.append(
                 f"train.max_restarts={t.max_restarts} must be >= 0")

@@ -1,0 +1,427 @@
+"""Corpus mixer: weighted sampling across N named packed corpora.
+
+ROADMAP item 5's data plane. One training run draws each batch slot from
+one of N `nvs3d pack` corpora ("cars", "chairs", ...) with probability
+weight/Σweights, while keeping every contract the single-corpus packed
+path earned:
+
+  - ONE sequential rng drives everything (the per-slot corpus draw AND
+    the per-corpus shuffle epochs), so the stream is deterministic in
+    (seed, shard_index) and stable across restarts — and a ONE-corpus
+    mix consumes the rng exactly like the plain packed loader, making it
+    BIT-IDENTICAL to `backend='packed'` without a mix (tested);
+  - the plan/assemble split survives: the mixer loader plans on the
+    coordinator thread and decodes on the PipelinedLoader worker pool,
+    so mixing never stalls the step loop (MinatoLoader's rule);
+  - quarantine stays per-corpus: a corrupt record costs one record of
+    ONE corpus, fault substitutes are redrawn WITHIN the same corpus
+    (per-corpus loss attribution stays honest), and per-corpus
+    quarantine/decode-error stats publish as nvs3d_corpus_* gauges.
+
+Batch records gain two int32 fields:
+  corpus_id  — position of the owning corpus in the mix spec; the train
+               step segment-sums per-sample losses by it (per-corpus
+               loss attribution in metrics.csv/telemetry.jsonl);
+  category   — scene-category id for conditioning (ConditioningProcessor
+               category table, model.num_classes). Defaults to the
+               corpus position; a corpus whose packed metadata carries a
+               class vocab still maps to one category per corpus (the
+               mix is the category vocabulary).
+
+Resolution safety: a corpus packed from images NATIVELY smaller than the
+requested training sidelength would silently upsample — at a 128 ladder
+rung that poisons the high-res phase with blurry 64px data. The mixer
+reads each corpus's index.json `meta` block (written by `nvs3d pack`)
+and REFUSES a resolution-mismatched corpus with an error naming it.
+Corpora packed before the meta block existed skip the check (nothing to
+cross-check against).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from novel_view_synthesis_3d_tpu.data.pipeline import PipelinedLoader
+from novel_view_synthesis_3d_tpu.data.records import (
+    INDEX_NAME,
+    PackedDataset,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CorpusSpec:
+    """One `name:weight:path` entry of a data.mix string."""
+
+    name: str
+    weight: float
+    path: str
+
+
+def parse_mix_spec(spec: str) -> List[CorpusSpec]:
+    """data.mix string → ordered CorpusSpec list.
+
+    Config.validate() already rejects malformed specs loudly at startup;
+    this re-raises on the same conditions so direct callers (tools,
+    tests) get the same contract.
+    """
+    out: List[CorpusSpec] = []
+    seen = set()
+    for entry in spec.split(","):
+        parts = entry.strip().split(":", 2)
+        if len(parts) != 3 or not all(p.strip() for p in parts):
+            raise ValueError(
+                f"mix entry {entry.strip()!r} must be 'name:weight:path'")
+        name, weight, path = (p.strip() for p in parts)
+        if name in seen:
+            raise ValueError(f"mix names corpus {name!r} twice")
+        seen.add(name)
+        w = float(weight)
+        if w <= 0:
+            raise ValueError(
+                f"mix corpus {name!r} weight must be > 0, got {w}")
+        out.append(CorpusSpec(name=name, weight=w, path=path))
+    if not out:
+        raise ValueError("empty mix spec")
+    return out
+
+
+def corpus_meta(root_dir: str) -> Optional[dict]:
+    """The `meta` block of a packed corpus's index.json, or None when
+    absent (corpus packed before `nvs3d pack` wrote metadata)."""
+    try:
+        with open(os.path.join(root_dir, INDEX_NAME)) as fh:
+            return json.load(fh).get("meta")
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def check_corpus_resolution(name: str, root_dir: str,
+                            img_sidelength: int) -> None:
+    """Refuse a corpus whose native capture resolution is below the
+    requested training sidelength (loud, naming the corpus) — the
+    resolution-ladder guard: a 64px-native corpus must not silently
+    upsample into a 128 rung."""
+    meta = corpus_meta(root_dir)
+    if meta is None or not meta.get("resolution"):
+        return  # pre-metadata corpus: nothing to cross-check
+    native = int(meta["resolution"])
+    if img_sidelength > native:
+        raise ValueError(
+            f"corpus {name!r} ({root_dir}) has native resolution "
+            f"{native} but the run (ladder rung) wants img_sidelength="
+            f"{img_sidelength} — training would silently UPSAMPLE this "
+            "corpus; drop it from data.mix at this rung or repack it "
+            "from higher-resolution sources")
+
+
+class MixedDataset:
+    """N packed corpora behind one FlatViewDataset-shaped surface.
+
+    Flat indices are the concatenation of the member corpora's index
+    spaces (corpus c owns [base[c], base[c+1])); plan/assemble/quarantine
+    delegate to the owning PackedDataset with index translation, so every
+    packed-plane behavior (shard re-hash at open, scene LRU, record
+    quarantine) applies unchanged per corpus. Assembled records gain the
+    mixer's `corpus_id` and `category` int32 fields.
+    """
+
+    def __init__(self, specs: Sequence[CorpusSpec],
+                 datasets: Sequence[PackedDataset]):
+        if len(specs) != len(datasets) or not specs:
+            raise ValueError("specs and datasets must align and be "
+                             "non-empty")
+        self.specs = list(specs)
+        self.datasets = list(datasets)
+        spis = {ds.samples_per_instance for ds in datasets}
+        if len(spis) != 1:
+            raise ValueError(
+                f"mixed corpora disagree on samples_per_instance: {spis}")
+        self.samples_per_instance = spis.pop()
+        self.max_record_retries = max(ds.max_record_retries
+                                      for ds in datasets)
+        self.root_dir = "mix(" + ",".join(
+            f"{s.name}:{s.path}" for s in specs) + ")"
+        self._bases = np.concatenate(
+            [[0], np.cumsum([len(ds) for ds in datasets])])
+        w = np.asarray([s.weight for s in specs], dtype=np.float64)
+        self.weights = w / w.sum()
+        self.decode_errors = [0] * len(specs)
+        self._publish_gauges()
+
+    # -- index space ----------------------------------------------------
+    def __len__(self) -> int:
+        return int(self._bases[-1])
+
+    def corpus_of(self, flat_idx: int) -> int:
+        c = int(np.searchsorted(self._bases, flat_idx, side="right") - 1)
+        if not 0 <= c < len(self.datasets):
+            raise IndexError(f"flat index {flat_idx} outside the mix "
+                             f"(len {len(self)})")
+        return c
+
+    def corpus_range(self, c: int) -> Tuple[int, int]:
+        return int(self._bases[c]), int(self._bases[c + 1])
+
+    def locate_corpus(self, flat_idx: int) -> Tuple[int, int]:
+        c = self.corpus_of(flat_idx)
+        return c, int(flat_idx - self._bases[c])
+
+    @property
+    def quarantined(self) -> set:
+        """Union of the member corpora's quarantine sets, globalized.
+        Live view — the loaders only do membership tests and len()."""
+        out: set = set()
+        for c, ds in enumerate(self.datasets):
+            base = int(self._bases[c])
+            out.update(base + i for i in ds.quarantined)
+        return out
+
+    def live_indices(self) -> np.ndarray:
+        return np.concatenate([
+            int(self._bases[c]) + ds.live_indices()
+            for c, ds in enumerate(self.datasets)])
+
+    def live_indices_of(self, c: int) -> np.ndarray:
+        return int(self._bases[c]) + self.datasets[c].live_indices()
+
+    # -- plan/assemble delegation (index + exception translation) -------
+    def _globalize(self, exc: Exception, c: int) -> None:
+        flat = getattr(exc, "flat_index", None)
+        if flat is not None:
+            exc.flat_index = int(self._bases[c]) + int(flat)
+
+    def _plan_pair(self, flat_idx: int, rng: np.random.Generator,
+                   num_cond: int = 1) -> tuple:
+        c, local = self.locate_corpus(flat_idx)
+        try:
+            return (c, self.datasets[c]._plan_pair(local, rng,
+                                                   num_cond=num_cond))
+        except Exception as exc:
+            self._globalize(exc, c)
+            raise
+
+    def _plan_samples(self, flat_idx: int, rng: np.random.Generator,
+                      num_cond: int = 1) -> List[tuple]:
+        c, local = self.locate_corpus(flat_idx)
+        try:
+            plans = self.datasets[c]._plan_samples(local, rng,
+                                                   num_cond=num_cond)
+        except Exception as exc:
+            self._globalize(exc, c)
+            raise
+        return [(c, p) for p in plans]
+
+    def _assemble_pair(self, plan: tuple) -> dict:
+        c, sub_plan = plan
+        try:
+            rec = self.datasets[c]._assemble_pair(sub_plan)
+        except Exception as exc:
+            self._globalize(exc, c)
+            raise
+        rec["corpus_id"] = np.int32(c)
+        rec["category"] = np.int32(c)
+        return rec
+
+    def pair(self, flat_idx: int, rng: np.random.Generator,
+             num_cond: int = 1) -> dict:
+        return self._assemble_pair(
+            self._plan_pair(flat_idx, rng, num_cond=num_cond))
+
+    def _quarantine(self, flat_idx: int, exc: Exception) -> None:
+        c, local = self.locate_corpus(flat_idx)
+        self.datasets[c]._quarantine(local, exc)
+        self.decode_errors[c] += 1
+        self._publish_gauges()
+
+    # -- per-corpus stats ----------------------------------------------
+    def corpus_stats(self) -> List[dict]:
+        """One dict per corpus: identity, weight, and quarantine health —
+        the rows the trainer lands in telemetry.jsonl via the bus."""
+        out = []
+        for c, (spec, ds) in enumerate(zip(self.specs, self.datasets)):
+            out.append({
+                "corpus": spec.name,
+                "corpus_id": c,
+                "weight": float(self.weights[c]),
+                "records": len(ds),
+                "quarantined": len(ds.quarantined),
+                "decode_errors": self.decode_errors[c],
+                "shards_open": getattr(ds, "shards_open", None),
+                "shards_quarantined": getattr(ds, "shards_quarantined",
+                                              None),
+            })
+        return out
+
+    def _publish_gauges(self) -> None:
+        """nvs3d_corpus_* gauges: per-corpus quarantine/decode health on
+        the shared obs registry, next to the packed plane's shard
+        gauges."""
+        try:
+            from novel_view_synthesis_3d_tpu import obs
+
+            reg = obs.get_registry()
+            for c, (spec, ds) in enumerate(zip(self.specs,
+                                               self.datasets)):
+                reg.gauge(
+                    f"nvs3d_corpus_{spec.name}_records",
+                    f"records corpus {spec.name!r} serves").set(len(ds))
+                reg.gauge(
+                    f"nvs3d_corpus_{spec.name}_quarantined",
+                    f"records of corpus {spec.name!r} quarantined by "
+                    "the fault ladder").set(len(ds.quarantined))
+                reg.gauge(
+                    f"nvs3d_corpus_{spec.name}_decode_errors",
+                    f"decode errors charged to corpus "
+                    f"{spec.name!r}").set(self.decode_errors[c])
+        except Exception:
+            pass  # telemetry must never fail the data path
+
+
+class MixedLoader(PipelinedLoader):
+    """PipelinedLoader whose plan stream draws each batch slot's corpus
+    first (one rng.choice per batch from the SINGLE sequential rng),
+    then pulls the slot's index from that corpus's own permutation
+    epoch — replenished from the same rng, in draw order.
+
+    With ONE corpus the override defers to the base per-epoch
+    permutation stream verbatim: rng consumption is identical to the
+    plain packed loader, so a one-corpus mix is bit-identical to
+    `backend='packed'` (tests/test_corpus.py asserts it).
+
+    Fault substitutes are redrawn WITHIN the failed slot's corpus (from
+    the dedicated redraw rng) — substitution must not shift loss/
+    quarantine attribution across corpora.
+    """
+
+    def __init__(self, dataset: MixedDataset, batch_size: int, *,
+                 seed: int = 0, shard_index: int = 0, num_cond: int = 1,
+                 workers: int = 4, depth: int = 2,
+                 skip_batches: int = 0):
+        self._mix = dataset
+        self.corpus_draws = [0] * len(dataset.datasets)
+        super().__init__(dataset, batch_size, seed=seed,
+                         shard_index=shard_index, num_cond=num_cond,
+                         workers=workers, depth=depth,
+                         skip_batches=skip_batches)
+
+    def _plan_stream(self):
+        mix = self._mix
+        n = len(mix.datasets)
+        if n == 1:
+            # One corpus: the base stream IS the mixer stream — same rng
+            # calls in the same order as the plain packed loader.
+            yield from super()._plan_stream()
+            return
+        queues: List[deque] = [deque() for _ in range(n)]
+        while True:
+            cids = self._rng.choice(n, size=self._draws, p=mix.weights)
+            idxs = []
+            for c in cids:
+                c = int(c)
+                if not queues[c]:
+                    queues[c].extend(
+                        int(i) for i in self._rng.permutation(
+                            mix.live_indices_of(c)))
+                idxs.append(queues[c].popleft())
+                self.corpus_draws[c] += 1
+            yield idxs
+
+    # -- corpus-confined fault substitution -----------------------------
+    def _plan_draw_safe(self, flat_idx: int) -> list:
+        if flat_idx not in self._ds.quarantined:
+            try:
+                return self._plan_draw(flat_idx, self._rng)
+            except Exception as exc:
+                self._ds._quarantine(
+                    getattr(exc, "flat_index", flat_idx), exc)
+        return self._substitute_plan(
+            corpus=self._mix.corpus_of(flat_idx))[1]
+
+    def _substitute_plan(self, corpus: Optional[int] = None) -> tuple:
+        if corpus is None:
+            return super()._substitute_plan()
+        lo, hi = self._mix.corpus_range(corpus)
+        quarantined = self._ds.quarantined
+        for _ in range(self._ds.max_record_retries + 1):
+            j = lo + int(self._redraw_rng.integers(hi - lo))
+            if j in quarantined:
+                quarantined = self._ds.quarantined  # refresh the view
+                continue
+            try:
+                return j, self._plan_draw(j, self._redraw_rng)
+            except Exception as exc:
+                self._ds._quarantine(getattr(exc, "flat_index", j), exc)
+                quarantined = self._ds.quarantined
+        name = self._mix.specs[corpus].name
+        raise RuntimeError(
+            f"data: {self._ds.max_record_retries + 1} consecutive "
+            f"substitute draws inside corpus {name!r} failed or were "
+            f"quarantined ({len(self._mix.datasets[corpus].quarantined)} "
+            f"quarantined in that corpus) — the corpus is too corrupt "
+            "to keep training; see the quarantine reports above")
+
+    def _substitute_decoded(self, flat_idx: int, exc: Exception) -> list:
+        corpus = self._mix.corpus_of(
+            int(getattr(exc, "flat_index", flat_idx)))
+        self._ds._quarantine(getattr(exc, "flat_index", flat_idx), exc)
+        if self._c_decode_errors is not None:
+            self._c_decode_errors.inc()
+        last: Exception = exc
+        for _ in range(self._ds.max_record_retries + 1):
+            sub_idx, plans = self._substitute_plan(corpus=corpus)
+            try:
+                return self._decode_draw(plans)
+            except Exception as exc2:
+                self._ds._quarantine(
+                    getattr(exc2, "flat_index", sub_idx), exc2)
+                last = exc2
+        name = self._mix.specs[corpus].name
+        raise RuntimeError(
+            f"data: substitute decodes inside corpus {name!r} kept "
+            f"failing — the corpus is too corrupt to keep training; "
+            f"last error: {last}")
+
+
+def make_mixed_dataset(cfg, *, shard_index: int = 0,
+                       shard_count: int = 1) -> MixedDataset:
+    """MixedDataset from a DataConfig with data.mix set.
+
+    Each corpus is a full PackedDataset (per-host shard slice, open-time
+    re-hash, scene cache) built with the shared DataConfig knobs;
+    check_corpus_resolution refuses any corpus whose packed metadata
+    says it cannot honestly serve cfg.img_sidelength.
+    """
+    specs = parse_mix_spec(cfg.mix)
+    datasets = []
+    for spec in specs:
+        check_corpus_resolution(spec.name, spec.path, cfg.img_sidelength)
+        datasets.append(PackedDataset(
+            root_dir=spec.path,
+            img_sidelength=cfg.img_sidelength,
+            max_num_instances=cfg.max_num_instances,
+            max_observations_per_instance=(
+                cfg.max_observations_per_instance),
+            specific_observation_idcs=cfg.specific_observation_idcs,
+            samples_per_instance=cfg.samples_per_instance,
+            max_record_retries=cfg.max_record_retries,
+            shard_index=shard_index,
+            shard_count=shard_count,
+        ))
+    return MixedDataset(specs, datasets)
+
+
+def make_mixed_loader(dataset: MixedDataset, batch_size: int, *,
+                      seed: int = 0, shard_index: int = 0,
+                      num_cond: int = 1, workers: int = 4,
+                      depth: int = 2, skip_batches: int = 0) -> MixedLoader:
+    """Compute-overlapped mixer loader (`data.mix` non-empty)."""
+    return MixedLoader(dataset, batch_size, seed=seed,
+                       shard_index=shard_index, num_cond=num_cond,
+                       workers=workers, depth=depth,
+                       skip_batches=skip_batches)

@@ -251,7 +251,8 @@ class PipelinedLoader:
 
     def __init__(self, dataset, batch_size: int, *, seed: int = 0,
                  shard_index: int = 0, num_cond: int = 1,
-                 workers: int = 4, depth: int = 2):
+                 workers: int = 4, depth: int = 2,
+                 skip_batches: int = 0):
         from concurrent.futures import ThreadPoolExecutor
 
         spi = getattr(dataset, "samples_per_instance", 1)
@@ -282,6 +283,14 @@ class PipelinedLoader:
         self._pending: deque = deque()
         self._plans = self._plan_stream()
         self._init_gauges()
+        # Mid-run resume fast-forward (train/ladder.py): replay the first
+        # `skip_batches` batches' PLANNING — the rng draws, not the
+        # decodes — so the first batch actually yielded is bit-identical
+        # to batch skip_batches of an uninterrupted run. Must happen
+        # BEFORE priming, which consumes plans.
+        for _ in range(max(0, skip_batches)):
+            for i in next(self._plans):
+                self._plan_draw_safe(i)
         # Prime the pipeline: decode starts NOW, so by the time the
         # consumer (trainer init, then the device prefetcher) wants the
         # first batch it is already in flight or done.
@@ -415,7 +424,8 @@ class PipelinedLoader:
 
 def make_packed_loader(dataset, batch_size: int, *, seed: int = 0,
                        shard_index: int = 0, num_cond: int = 1,
-                       workers: int = 4, depth: int = 2) -> PipelinedLoader:
+                       workers: int = 4, depth: int = 2,
+                       skip_batches: int = 0) -> PipelinedLoader:
     """Compute-overlapped loader for `data.backend='packed'`.
 
     `shard_index` here only decorrelates the per-host rng (seed +
@@ -425,4 +435,5 @@ def make_packed_loader(dataset, batch_size: int, *, seed: int = 0,
     (a num_workers=0 debug config still needs one decode thread)."""
     return PipelinedLoader(dataset, batch_size, seed=seed,
                            shard_index=shard_index, num_cond=num_cond,
-                           workers=workers, depth=depth)
+                           workers=workers, depth=depth,
+                           skip_batches=skip_batches)

@@ -161,13 +161,22 @@ class ShardWriter:
 
 def pack_srn(root_dir: str, out_dir: str, *, shard_mb: float = 64.0,
              max_num_instances: int = -1,
+             name: Optional[str] = None,
+             classes: Optional[Sequence[str]] = None,
              progress: Optional[callable] = None) -> dict:
     """Pack an SRN-layout directory into sharded records + index.json.
 
     Shards by scene: a shard is closed once it crosses `shard_mb` (so
     every scene's views stay together). RGB bytes are stored as found on
     disk (no re-encode — see the module docstring), poses as parsed f32,
-    intrinsics as raw text. Returns the index dict that was written."""
+    intrinsics as raw text. Returns the index dict that was written.
+
+    The index gains a `meta` block — corpus identity for the mixer
+    (data/corpus.py): `name` (default: the source dir's basename),
+    native `resolution` (min dimension of the first image after square
+    crop — what the corpus can honestly serve without upsampling),
+    scene/view counts, and the `classes` vocab (default: [name]).
+    `nvs3d pack --verify` cross-checks the block against the shards."""
     instance_dirs = sorted(glob(os.path.join(root_dir, "*/")))
     if not instance_dirs:
         raise FileNotFoundError(f"no instances under {root_dir!r}")
@@ -176,9 +185,16 @@ def pack_srn(root_dir: str, out_dir: str, *, shard_mb: float = 64.0,
     os.makedirs(out_dir, exist_ok=True)
     target_bytes = max(1, int(shard_mb * 1e6))
 
+    # Resolve the corpus identity BEFORE the pack loop — the loop reuses
+    # `name` for instance names, and the meta block must not inherit the
+    # last instance's.
+    corpus_name = name or os.path.basename(
+        os.path.normpath(root_dir)) or "corpus"
+
     shards: List[dict] = []
     instances: List[dict] = []
     writer: Optional[ShardWriter] = None
+    native_resolution: Optional[int] = None
 
     def close_shard():
         nonlocal writer
@@ -200,6 +216,14 @@ def pack_srn(root_dir: str, out_dir: str, *, shard_mb: float = 64.0,
         for c, p in zip(colors, poses):
             with open(c, "rb") as fh:
                 rgb = fh.read()
+            if native_resolution is None:
+                # Native corpus resolution = the square-crop sidelength
+                # of the first image (min dimension) — the largest
+                # sidelength this corpus serves without upsampling.
+                from PIL import Image
+
+                with Image.open(io.BytesIO(rgb)) as im:
+                    native_resolution = min(im.size)
             views.append({"rgb": rgb,
                           "pose": load_pose(p).astype("<f4").tobytes()})
         payload = msgpack.packb(
@@ -225,6 +249,13 @@ def pack_srn(root_dir: str, out_dir: str, *, shard_mb: float = 64.0,
         "source": os.path.abspath(root_dir),
         "num_instances": len(instances),
         "num_views": sum(e["views"] for e in instances),
+        "meta": {
+            "name": corpus_name,
+            "resolution": native_resolution,
+            "num_scenes": len(instances),
+            "num_views": sum(e["views"] for e in instances),
+            "classes": (list(classes) if classes else [corpus_name]),
+        },
         "shards": shards,
         "instances": instances,
     }
@@ -309,20 +340,39 @@ def verify_packed(root_dir: str, *, decode: str = "first") -> List[str]:
     if index.get("format") != FORMAT_NAME:
         return [f"{index_path}: format {index.get('format')!r} != "
                 f"{FORMAT_NAME!r}"]
+    # Corpus metadata cross-check (the mixer trusts this block for its
+    # resolution-mismatch refusal — a stale block must fail verify).
+    meta = index.get("meta")
+    if meta is not None:
+        n_inst = len(index.get("instances", []))
+        n_views = sum(int(e["views"]) for e in index.get("instances", []))
+        if int(meta.get("num_scenes", -1)) != n_inst:
+            problems.append(
+                f"{index_path}: meta.num_scenes={meta.get('num_scenes')} "
+                f"disagrees with the {n_inst} indexed instances")
+        if int(meta.get("num_views", -1)) != n_views:
+            problems.append(
+                f"{index_path}: meta.num_views={meta.get('num_views')} "
+                f"disagrees with the {n_views} indexed views")
+        if not meta.get("name"):
+            problems.append(f"{index_path}: meta.name is empty")
+        if not meta.get("classes"):
+            problems.append(f"{index_path}: meta.classes vocab is empty")
+    first_decode_res: Optional[int] = None
     by_shard: Dict[int, List[dict]] = {}
     for e in index.get("instances", []):
         by_shard.setdefault(int(e["shard"]), []).append(e)
-    for ordinal, meta in enumerate(index.get("shards", [])):
-        path = os.path.join(root_dir, meta["file"])
+    for ordinal, smeta in enumerate(index.get("shards", [])):
+        path = os.path.join(root_dir, smeta["file"])
         try:
             footer = read_shard_footer(path, ordinal)
         except (ShardCorrupt, OSError) as exc:
             problems.append(str(exc))
             continue
-        if meta.get("sha256"):
+        if smeta.get("sha256"):
             with open(path, "rb") as fh:
                 body = fh.read()[:-TAIL_LEN]
-            if hashlib.sha256(body).hexdigest() != meta["sha256"]:
+            if hashlib.sha256(body).hexdigest() != smeta["sha256"]:
                 problems.append(f"{path}: sha256 differs from index.json")
         footer_map = {e[0]: tuple(e[1:]) for e in footer["instances"]}
         for entry in by_shard.get(ordinal, []):
@@ -350,7 +400,9 @@ def verify_packed(root_dir: str, *, decode: str = "first") -> List[str]:
                              else ([0] if decode == "first" else []))
                 for v in to_decode:
                     view = rec["views"][v]
-                    decode_rgb(io.BytesIO(view["rgb"]))
+                    img = decode_rgb(io.BytesIO(view["rgb"]))
+                    if first_decode_res is None:
+                        first_decode_res = int(min(img.shape[:2]))
                     pose = np.frombuffer(view["pose"], dtype="<f4")
                     if pose.shape != (16,):
                         raise ValueError(
@@ -359,6 +411,13 @@ def verify_packed(root_dir: str, *, decode: str = "first") -> List[str]:
                 problems.append(
                     f"{path}: record {entry['name']!r}: "
                     f"{type(exc).__name__}: {exc}")
+    if (meta is not None and meta.get("resolution")
+            and first_decode_res is not None
+            and int(meta["resolution"]) != first_decode_res):
+        problems.append(
+            f"{index_path}: meta.resolution={meta['resolution']} but the "
+            f"first decoded view is {first_decode_res}px — the mixer's "
+            "resolution guard would trust a lie; re-pack")
     return problems
 
 

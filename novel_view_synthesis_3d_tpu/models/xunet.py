@@ -105,6 +105,14 @@ class ConditioningProcessor(nn.Module):
     num_resolutions: int
     use_pos_emb: bool = False
     use_ref_pose_emb: bool = False
+    # Scene-category conditioning (model.num_classes): > 0 adds a
+    # ZERO-INIT (num_classes, emb_ch) embedding table looked up by the
+    # batch's int32 `category` ids and added into logsnr_emb, behind the
+    # same CFG cond-drop mask as the pose embedding. Zero init makes the
+    # table a numeric no-op at creation, which is what lets checkpoints
+    # trained at num_classes=0 load into a num_classes>0 model by
+    # splicing the fresh zero table (train/ladder.py).
+    num_classes: int = 0
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -123,6 +131,29 @@ class ConditioningProcessor(nn.Module):
                                  dtype=self.dtype)
         logsnr_emb = nn.Dense(self.emb_ch, **kw)(logsnr_emb)
         logsnr_emb = nn.Dense(self.emb_ch, **kw)(nonlinearity(logsnr_emb))
+
+        # --- scene-category embedding (data/corpus.py mixed batches) ---
+        # Rides the logsnr channel so it reaches every FiLM site without
+        # touching the pose-embedding shapes, and sits BEFORE the
+        # precomputed-pose early return so the serving/sampling fast
+        # paths stay category-aware. A batch without a `category` field
+        # conditions on nothing (zero vector) — old single-corpus batches
+        # are numerically unchanged even with the table present.
+        if self.num_classes > 0:
+            table = self.param("category_emb", nn.initializers.zeros,
+                               (self.num_classes, self.emb_ch),
+                               self.param_dtype)
+            if "category" in batch:
+                cat_emb = jnp.take(table.astype(self.dtype),
+                                   batch["category"], axis=0)
+                if cond_mask is not None:
+                    # CFG cond-drop: the category drops with the pose
+                    # conditioning (one mask, one uncond branch) so
+                    # guidance and distillation survive unchanged.
+                    assert cond_mask.shape == (B,), cond_mask.shape
+                    cat_emb = jnp.where(cond_mask[:, None], cat_emb,
+                                        jnp.zeros_like(cat_emb))
+                logsnr_emb = logsnr_emb + cat_emb
 
         # Precomputed pose path (sampling): the pose embeddings depend only
         # on the cameras, not on (z_t, logsnr) — a sampler can compute them
@@ -208,6 +239,7 @@ def precompute_pose_embs(model: "XUNet", params, cond: dict,
         num_resolutions=len(cfg.ch_mult),
         use_pos_emb=cfg.use_pos_emb,
         use_ref_pose_emb=cfg.use_ref_pose_emb,
+        num_classes=cfg.num_classes,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),
     )
@@ -375,6 +407,7 @@ class XUNet(nn.Module):
                     num_resolutions=num_resolutions,
                     use_pos_emb=cfg.use_pos_emb,
                     use_ref_pose_emb=cfg.use_ref_pose_emb,
+                    num_classes=cfg.num_classes,
                     name=info["cond"],
                     **kw,
                 )(batch, cond_mask)

@@ -14,6 +14,7 @@ the telemetry files itself.
 """
 
 from novel_view_synthesis_3d_tpu.registry.gate import (  # noqa: F401
+    GateMatrixResult,
     GateResult,
     decide,
     make_psnr_probe,
@@ -21,6 +22,7 @@ from novel_view_synthesis_3d_tpu.registry.gate import (  # noqa: F401
     promote,
     rollback,
     run_gate,
+    run_gate_matrix,
 )
 from novel_view_synthesis_3d_tpu.registry.manifest import (  # noqa: F401
     PARAMS_FILE,

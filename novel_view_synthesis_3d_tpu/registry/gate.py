@@ -231,6 +231,88 @@ def run_gate(store: RegistryStore, candidate_vid: str, *, channel: str,
     return result
 
 
+@dataclasses.dataclass(frozen=True)
+class GateMatrixResult:
+    """Per-(corpus × resolution) gate verdict (the ladder/mixer gate).
+
+    `cells` rows: corpus, resolution, metric, candidate_psnr,
+    incumbent_psnr, delta_db, passed, reason. The matrix passes only
+    when EVERY cell passes — one regressed corpus or rung resolution
+    blocks the promotion, margin-checked with the same decide() rule as
+    the scalar gate."""
+
+    passed: bool
+    candidate: str
+    incumbent: Optional[str]
+    margin_db: float
+    cells: tuple
+
+    @property
+    def worst(self) -> Optional[dict]:
+        deltas = [c for c in self.cells if c["delta_db"] is not None]
+        if not deltas:
+            return None
+        return min(deltas, key=lambda c: c["delta_db"])
+
+
+def run_gate_matrix(store: RegistryStore, candidate_vid: str, *,
+                    channel: str, cells, margin_db: float,
+                    event_cb: Optional[EventCb] = None
+                    ) -> GateMatrixResult:
+    """Score candidate vs incumbent on EVERY (corpus, resolution) cell.
+
+    `cells` is a sequence of dicts {corpus, resolution, metric,
+    probe_fn} — cli._run_gates builds one per corpus of the mix × rung
+    resolution of the ladder (registry item 5's eval matrix). Both
+    versions are loaded ONCE and every probe scores the same trees, so
+    an R×C matrix costs R·C probe runs, not R·C payload loads. Never
+    moves pointers; emits one gate_pass/gate_fail audit event naming
+    the worst cell."""
+    incumbent_vid = store.read_channel(channel)
+    cand_manifest = store.verify(candidate_vid)
+    candidate_params = store.load_params(candidate_vid, verify=False)
+    incumbent_params = None
+    if incumbent_vid == candidate_vid:
+        incumbent_vid = None  # re-promoting the incumbent: bootstrap rule
+    elif incumbent_vid:
+        incumbent_params = store.load_params(incumbent_vid)
+    rows = []
+    for cell in cells:
+        cand = cell["probe_fn"](candidate_params)
+        inc = (cell["probe_fn"](incumbent_params)
+               if incumbent_params is not None else None)
+        passed, reason = decide(cand, inc, margin_db)
+        rows.append({
+            "corpus": cell["corpus"],
+            "resolution": int(cell["resolution"]),
+            "metric": cell.get("metric", "psnr"),
+            "candidate_psnr": cand,
+            "incumbent_psnr": inc,
+            "delta_db": None if inc is None else cand - inc,
+            "passed": passed,
+            "reason": reason,
+        })
+    result = GateMatrixResult(
+        passed=all(r["passed"] for r in rows),
+        candidate=candidate_vid, incumbent=incumbent_vid,
+        margin_db=margin_db, cells=tuple(rows))
+    if event_cb is not None:
+        failed = [r for r in rows if not r["passed"]]
+        worst = (min(failed, key=lambda r: r["delta_db"] or 0.0)
+                 if failed else result.worst)
+        detail = (f"channel {channel} matrix: {len(rows)} cells, "
+                  f"{len(rows) - len(failed)} passed")
+        if worst is not None:
+            detail += (f"; worst {worst['corpus']}@{worst['resolution']}px"
+                       f" [{worst['metric']}] {worst['candidate_psnr']:.2f}"
+                       " dB" + (f" ({worst['delta_db']:+.2f} dB)"
+                                if worst["delta_db"] is not None else ""))
+        event_cb(cand_manifest.step,
+                 "gate_pass" if result.passed else "gate_fail",
+                 detail, candidate_vid)
+    return result
+
+
 def promote(store: RegistryStore, vid: str, *, channel: str = "stable",
             gate: Optional[GateResult] = None,
             event_cb: Optional[EventCb] = None) -> None:

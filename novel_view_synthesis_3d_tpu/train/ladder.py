@@ -1,0 +1,315 @@
+"""Resolution ladder: progressive 64→128 training over one checkpoint.
+
+ROADMAP item 5's training schedule. `train.ladder="64:N,128:M"` runs the
+run as consecutive RUNGS against ONE checkpoint_dir: rung r trains at its
+resolution from the previous rung's final state (the XUNet is fully
+convolutional — conv/norm/emb param shapes are resolution-independent,
+so every rung shares one param tree; model.attn_resolutions must select
+the SAME UNet levels at every rung, which Config.validate and
+`attention_levels` below enforce). The contracts:
+
+  - rung boundaries are CANONICAL checkpoint boundaries: each rung ends
+    with the trainer's forced final save at its cumulative step count,
+    so a kill between rungs resumes into the next rung's fresh loader
+    with bit-identical results to an uninterrupted ladder;
+  - rung selection on resume derives from the restored step ALONE
+    (cumulative step ranges) — no side-channel rung state to corrupt;
+  - MID-rung resume is bit-identical too: the rung's loader fast-
+    forwards its plan stream by the steps already trained in the rung
+    (PipelinedLoader skip_batches), so the resumed run consumes exactly
+    the batches the uninterrupted run would have;
+  - the promotion gate probes at EVERY rung resolution
+    (registry/gate.run_gate_matrix, wired in cli._run_gates).
+
+This module also owns the VERSIONED PARAM-TREE GROWTH shim: enabling
+scene-category conditioning (model.num_classes > 0) adds a zero-init
+`category_emb` table under ConditioningProcessor_0 (plus its Adam-moment
+and EMA shadows). `restore_with_growth` lets checkpoints saved WITHOUT
+the table restore into the grown tree — it retries the restore with the
+grown leaves stripped from the template, then splices the template's
+fresh zero-init values back in, asserting they really are zero (the
+numeric-no-op contract of the growth).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Param-tree keys that version-grow the tree (old checkpoints may lack
+# them; the fresh template value is a numeric no-op by construction).
+GROWN_PARAM_KEYS = ("category_emb",)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rung:
+    """One resolution rung: train at `resolution` until global step
+    reaches `end_step` (cumulative over the ladder)."""
+
+    resolution: int
+    steps: int
+    start_step: int
+    end_step: int
+
+
+def parse_ladder(spec: str) -> List[Rung]:
+    """train.ladder string → cumulative rung schedule.
+
+    Config.validate() already rejects malformed specs at startup; this
+    re-raises on the same conditions for direct callers.
+    """
+    rungs: List[Rung] = []
+    start = 0
+    for entry in spec.split(","):
+        parts = entry.strip().split(":")
+        if len(parts) != 2:
+            raise ValueError(
+                f"ladder entry {entry.strip()!r} must be "
+                "'resolution:steps'")
+        res, steps = int(parts[0]), int(parts[1])
+        if res < 8 or res & (res - 1) != 0:
+            raise ValueError(
+                f"ladder resolution {res} must be a power of two >= 8")
+        if steps < 1:
+            raise ValueError(
+                f"ladder rung {entry.strip()!r} must train >= 1 step")
+        if rungs and res < rungs[-1].resolution:
+            raise ValueError(
+                f"ladder resolutions must be non-decreasing "
+                f"({rungs[-1].resolution} before {res})")
+        rungs.append(Rung(resolution=res, steps=steps, start_step=start,
+                          end_step=start + steps))
+        start += steps
+    if not rungs:
+        raise ValueError("empty ladder spec")
+    return rungs
+
+
+def ladder_resolutions(cfg) -> List[int]:
+    """Every resolution the run trains (and the gate must probe) at:
+    the ladder's rung resolutions, or the flat data.img_sidelength."""
+    if cfg.train.ladder:
+        seen: List[int] = []
+        for r in parse_ladder(cfg.train.ladder):
+            if r.resolution not in seen:
+                seen.append(r.resolution)
+        return seen
+    return [cfg.data.img_sidelength]
+
+
+def attention_levels(model_cfg, resolution: int) -> Tuple[int, ...]:
+    """The UNet levels whose feature maps trigger attention at this
+    input resolution (level i runs at resolution >> i). The ladder
+    requires this tuple to be IDENTICAL across rung resolutions —
+    attn_resolutions is keyed on absolute feature-map resolution, so a
+    mismatch means structurally incompatible rung param trees."""
+    return tuple(lvl for lvl in range(len(model_cfg.ch_mult))
+                 if (resolution >> lvl) in model_cfg.attn_resolutions)
+
+
+def check_ladder_attention(cfg, rungs: List[Rung]) -> None:
+    """Raise loudly when the rung resolutions place attention at
+    different UNet levels (Config.validate runs the same check; this
+    covers direct run_ladder callers with unvalidated configs)."""
+    patterns = {r.resolution: attention_levels(cfg.model, r.resolution)
+                for r in rungs}
+    if len(set(patterns.values())) > 1:
+        raise ValueError(
+            "train.ladder places attention at different UNet levels "
+            f"per rung ({ {r: list(p) for r, p in patterns.items()} }) "
+            "— the rung param trees would be structurally incompatible; "
+            "choose model.attn_resolutions that select the same levels "
+            "at every rung resolution (e.g. [] for the ladder run)")
+
+
+def rung_of_step(rungs: List[Rung], step: int) -> Rung:
+    """The rung a global step trains in (end_step exclusive; a step at
+    or past the ladder's total belongs to the final rung)."""
+    for r in rungs:
+        if step < r.end_step:
+            return r
+    return rungs[-1]
+
+
+def rung_config(cfg, rung: Rung):
+    """Derive the rung's flat Config: the rung resolution, the ladder's
+    cumulative step target, and ladder cleared (a rung is a plain run)."""
+    return cfg.override(**{
+        "data.img_sidelength": rung.resolution,
+        "train.num_steps": rung.end_step,
+        "train.ladder": "",
+    })
+
+
+def _release_rung(trainer) -> None:
+    """Release a finished rung's IO: the ladder opens one Trainer per
+    rung against the SAME checkpoint_dir, so the finished rung's decode
+    workers and async Orbax manager must not linger under the next
+    rung's (train() already drained the final forced save)."""
+    loader = getattr(trainer, "_packed_loader", None)
+    if loader is not None:
+        loader.stop()
+    trainer.ckpt.wait()
+    trainer.ckpt.close()
+
+
+def run_ladder(cfg, *, use_grain: bool = True):
+    """Drive the full ladder: one Trainer per remaining rung, sequential,
+    resuming from the shared checkpoint_dir. Returns the last Trainer
+    driven (None when every rung was already complete) so the CLI can
+    propagate its stall exit code."""
+    from novel_view_synthesis_3d_tpu.train.checkpoint import (
+        CheckpointManager)
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+    rungs = parse_ladder(cfg.train.ladder)
+    check_ladder_attention(cfg, rungs)
+    if not cfg.train.resume:
+        raise ValueError(
+            "train.ladder requires train.resume=true — every rung after "
+            "the first RESTORES the previous rung's final checkpoint "
+            "(and a mid-rung rerun restores its own); resume=false would "
+            "silently retrain each rung from scratch")
+    mgr = CheckpointManager(cfg.train.checkpoint_dir)
+    latest = mgr.latest_step() or 0
+    mgr.close()
+    trainer = None
+    for rung in rungs:
+        if latest >= rung.end_step:
+            print(f"ladder: rung {rung.resolution}px "
+                  f"[{rung.start_step}, {rung.end_step}) already "
+                  f"complete (checkpoint at step {latest}) — skipping",
+                  flush=True)
+            continue
+        # Mid-rung resume: fast-forward the rung's data stream by the
+        # steps already trained in it, so the resumed run consumes the
+        # exact batches the uninterrupted rung would have.
+        skip = max(0, latest - rung.start_step)
+        rcfg = rung_config(cfg, rung)
+        rcfg.validate()
+        print(f"ladder: rung {rung.resolution}px "
+              f"[{rung.start_step}, {rung.end_step})"
+              + (f", fast-forwarding {skip} batches" if skip else ""),
+              flush=True)
+        trainer = Trainer(config=rcfg, use_grain=use_grain,
+                          skip_batches=skip)
+        trainer.train()
+        _release_rung(trainer)
+        if trainer.stalled or getattr(trainer, "_preempted", False):
+            # The rung checkpointed and bailed; the NEXT invocation
+            # resumes INSIDE this rung (skip derived from the restored
+            # step) — advancing `latest` here would silently skip the
+            # untrained remainder.
+            print(f"ladder: interrupted inside rung {rung.resolution}px "
+                  f"at step {trainer.step}; rerun to resume this rung",
+                  flush=True)
+            return trainer
+        latest = rung.end_step
+        print(f"ladder: rung {rung.resolution}px complete at step "
+              f"{latest} (canonical checkpoint boundary)", flush=True)
+    return trainer
+
+
+# ---------------------------------------------------------------------------
+# Versioned param-tree growth (scene-category conditioning)
+# ---------------------------------------------------------------------------
+def _strip_grown(tree: Any, removed: Dict[tuple, Any],
+                 path: tuple = ()) -> Any:
+    """Copy of `tree` with every dict entry named in GROWN_PARAM_KEYS
+    removed (recorded in `removed` by path). Recurses through the
+    containers a TrainState is made of: dicts (param/moment/EMA trees),
+    tuples incl. namedtuples (optax states), lists, and (flax struct)
+    dataclasses."""
+    if isinstance(tree, dict):
+        out = {}
+        for k, v in tree.items():
+            if k in GROWN_PARAM_KEYS:
+                removed[path + (k,)] = v
+            else:
+                out[k] = _strip_grown(v, removed, path + (k,))
+        return out
+    if isinstance(tree, tuple):
+        vals = [_strip_grown(v, removed, path + (i,))
+                for i, v in enumerate(tree)]
+        return (type(tree)(*vals) if hasattr(tree, "_fields")
+                else tuple(vals))
+    if isinstance(tree, list):
+        return [_strip_grown(v, removed, path + (i,))
+                for i, v in enumerate(tree)]
+    if dataclasses.is_dataclass(tree) and not isinstance(tree, type):
+        kw = {f.name: _strip_grown(getattr(tree, f.name), removed,
+                                   path + (f.name,))
+              for f in dataclasses.fields(tree)}
+        return tree.replace(**kw) if hasattr(tree, "replace") else \
+            dataclasses.replace(tree, **kw)
+    return tree
+
+
+def _reinsert(tree: Any, removed: Dict[tuple, Any],
+              path: tuple = ()) -> Any:
+    """Inverse of _strip_grown: re-add the removed dict entries (with
+    their recorded values) into a structurally-stripped tree."""
+    if isinstance(tree, dict):
+        out = {k: _reinsert(v, removed, path + (k,))
+               for k, v in tree.items()}
+        for rp, val in removed.items():
+            if rp[:-1] == path:
+                out[rp[-1]] = val
+        return out
+    if isinstance(tree, tuple):
+        vals = [_reinsert(v, removed, path + (i,))
+                for i, v in enumerate(tree)]
+        return (type(tree)(*vals) if hasattr(tree, "_fields")
+                else tuple(vals))
+    if isinstance(tree, list):
+        return [_reinsert(v, removed, path + (i,))
+                for i, v in enumerate(tree)]
+    if dataclasses.is_dataclass(tree) and not isinstance(tree, type):
+        kw = {f.name: _reinsert(getattr(tree, f.name), removed,
+                                path + (f.name,))
+              for f in dataclasses.fields(tree)}
+        return tree.replace(**kw) if hasattr(tree, "replace") else \
+            dataclasses.replace(tree, **kw)
+    return tree
+
+
+def restore_with_growth(ckpt, template, step: Optional[int] = None
+                        ) -> Optional[Any]:
+    """CheckpointManager.restore with param-tree-growth compat.
+
+    Try the full template first (same-version checkpoints restore
+    unchanged). If that fails AND the template contains grown keys,
+    retry with the grown leaves stripped — an old (pre-growth)
+    checkpoint restores into the stripped structure — then splice the
+    template's fresh values back in, ASSERTING they are all-zero (the
+    zero-init contract is what makes the splice a numeric no-op; a
+    non-zero template value would mean the growth semantics changed and
+    this shim must not silently guess).
+    """
+    import jax
+
+    try:
+        return ckpt.restore(template, step=step)
+    except Exception:
+        removed: Dict[tuple, Any] = {}
+        stripped = _strip_grown(template, removed)
+        if not removed:
+            raise  # not a growth mismatch — surface the original error
+        restored = ckpt.restore(stripped, step=step)
+        if restored is None:
+            return None
+        for path, val in removed.items():
+            arr = np.asarray(jax.device_get(val))
+            if arr.size and np.any(arr):
+                raise RuntimeError(
+                    "param-tree growth compat: template value at "
+                    f"{'/'.join(map(str, path))} is not zero-init — "
+                    "refusing to splice a non-neutral value into a "
+                    "restored checkpoint")
+        print("checkpoint predates param-tree growth: spliced "
+              f"{len(removed)} zero-init leaf/leaves "
+              f"({', '.join(sorted({str(p[-1]) for p in removed}))}) "
+              "into the restored state", flush=True)
+        return _reinsert(restored, removed)

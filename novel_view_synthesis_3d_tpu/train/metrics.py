@@ -57,7 +57,8 @@ class MetricsLogger:
         self._last_time: Optional[float] = None
         self._last_step: Optional[int] = None
 
-    def log(self, step: int, metrics: dict, batch_size: int) -> dict:
+    def log(self, step: int, metrics: dict, batch_size: int,
+            extra: Optional[dict] = None) -> dict:
         now = time.perf_counter()
         steps_per_sec = 0.0
         if self._last_time is not None and step > self._last_step:
@@ -75,13 +76,24 @@ class MetricsLogger:
         restarts = int(metrics.get("restarts", 0))
         device_mem_gb = float(metrics.get("device_mem_gb", float("nan")))
         mfu = float(metrics.get("mfu", float("nan")))
-        self.bus.metrics_row(self.HEADER, [
+        header = list(self.HEADER)
+        row = [
             step, loss, gnorm, f"{lr:.3e}",
             f"{steps_per_sec:.3f}",
             f"{imgs_per_sec_per_chip:.3f}",
             anomalies, rollbacks, restarts,
             "" if math.isnan(device_mem_gb) else f"{device_mem_gb:.3f}",
-            "" if math.isnan(mfu) else f"{mfu:.4f}"])
+            "" if math.isnan(mfu) else f"{mfu:.4f}"]
+        if extra:
+            # Run-specific trailing columns (per-corpus loss attribution,
+            # data/corpus.py): sorted so the schema is deterministic; the
+            # bus's header-rotation handles a resume with a different
+            # corpus set.
+            for k in sorted(extra):
+                header.append(k)
+                v = float(extra[k])
+                row.append("" if math.isnan(v) else f"{v:.6f}")
+        self.bus.metrics_row(header, row)
         if self._tb is not None:
             import tensorflow as tf
 

@@ -135,6 +135,26 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
                                      shard_local=zero)
     full_clip = (optax.clip_by_global_norm(tcfg.grad_clip)
                  if zero and tcfg.grad_clip > 0 else None)
+    # Corpus mixer (data/corpus.py): per-corpus loss attribution. The mix
+    # spec fixes the number of corpora at TRACE time (static C), so the
+    # segment_sum below compiles to a fixed-shape (C,) reduction — no
+    # dynamic shapes, no recompiles as corpus proportions drift per batch.
+    if config.data.mix:
+        from novel_view_synthesis_3d_tpu.data.corpus import parse_mix_spec
+        corpus_count = len(parse_mix_spec(config.data.mix))
+    else:
+        corpus_count = 0
+    if corpus_count and tcfg.loss != "mse":
+        raise ValueError(
+            "data.mix per-corpus loss attribution requires train.loss="
+            "'mse' — the whole-tensor frobenius norm has no per-sample "
+            "terms to attribute to a corpus")
+    if stages > 1 and (corpus_count or config.model.num_classes > 0):
+        raise ValueError(
+            "data.mix / model.num_classes are not supported with "
+            "mesh.stages > 1 — the pipeline-staged step streams only "
+            "MODEL_KEYS through its stage shard_map; run the corpus "
+            "mixer on the sequential (stages=1) step")
     if stages > 1:
         from novel_view_synthesis_3d_tpu.parallel import (
             pipeline as pipeline_lib)
@@ -204,6 +224,13 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
             snr = acp / (1.0 - acp)
             full["loss_weight"] = min_snr_weight(
                 snr, tcfg.min_snr_gamma, objective)
+        # Mixed-corpus batches (data/corpus.py): category feeds the
+        # conditioning table (only when the model grew one), corpus_id
+        # feeds loss attribution (never the model).
+        if config.model.num_classes > 0 and "category" in batch:
+            full["category"] = batch["category"]
+        if corpus_count and "corpus_id" in batch:
+            full["corpus_id"] = batch["corpus_id"]
         return full
 
     def train_step(state: TrainState, batch: dict) -> Tuple[TrainState, dict]:
@@ -243,18 +270,54 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
 
         full = derive_fields(batch, k_t, k_noise, k_mask, B, None)
 
+        def model_keys_of(mb):
+            # corpus_id/regression_target/... never reach the model;
+            # category does, iff the batch carries it (the model grew a
+            # conditioning table — derive_fields gates on num_classes).
+            return (MODEL_KEYS + ("category",) if "category" in mb
+                    else MODEL_KEYS)
+
         def micro_loss(params, mb):
             pred = model.apply(
                 {"params": params},
-                {k: mb[k] for k in MODEL_KEYS},
+                {k: mb[k] for k in model_keys_of(mb)},
                 cond_mask=mb["cond_mask"], train=True,
                 rngs={"dropout": mb["dropout_key"]})
             return compute_loss(pred, mb["regression_target"], tcfg.loss,
                                 weight=mb.get("loss_weight"))
 
+        def micro_loss_attributed(params, mb):
+            """micro_loss + per-corpus (loss_sum, count) aux — the same
+            per-sample terms the scalar mean reduces, bucketed by
+            corpus_id with a static-C segment_sum."""
+            pred = model.apply(
+                {"params": params},
+                {k: mb[k] for k in model_keys_of(mb)},
+                cond_mask=mb["cond_mask"], train=True,
+                rngs={"dropout": mb["dropout_key"]})
+            per_sample = jnp.mean(
+                jnp.square(pred - mb["regression_target"]).reshape(
+                    pred.shape[0], -1), axis=-1)
+            w = mb.get("loss_weight")
+            if w is not None:
+                per_sample = w * per_sample
+            sums = jax.ops.segment_sum(
+                per_sample, mb["corpus_id"], num_segments=corpus_count)
+            counts = jax.ops.segment_sum(
+                jnp.ones_like(per_sample), mb["corpus_id"],
+                num_segments=corpus_count)
+            return jnp.mean(per_sample), (sums, counts)
+
+        attributed = corpus_count > 0 and "corpus_id" in full
+        corpus_aux = None
         if accum == 1:
-            loss, grads = jax.value_and_grad(micro_loss)(
-                state.params, dict(full, dropout_key=k_dropout))
+            if attributed:
+                (loss, corpus_aux), grads = jax.value_and_grad(
+                    micro_loss_attributed, has_aux=True)(
+                        state.params, dict(full, dropout_key=k_dropout))
+            else:
+                loss, grads = jax.value_and_grad(micro_loss)(
+                    state.params, dict(full, dropout_key=k_dropout))
         else:
             # lax.scan over micro-batches: activations live one slice at a
             # time; gradients accumulate in a params-shaped f32 tree. Equal
@@ -264,26 +327,44 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
                                     + a.shape[1:]), full)
             micro["dropout_key"] = jax.random.split(k_dropout, accum)
 
-            def body(carry, mb):
-                loss_sum, grad_sum = carry
-                l, g = jax.value_and_grad(micro_loss)(state.params, mb)
-                return (loss_sum + l,
-                        jax.tree.map(
-                            lambda s, x: s + x.astype(jnp.float32),
-                            grad_sum, g)), None
+            if attributed:
+                def body(carry, mb):
+                    loss_sum, grad_sum, (s_sum, c_sum) = carry
+                    (l, (s, c)), g = jax.value_and_grad(
+                        micro_loss_attributed, has_aux=True)(
+                            state.params, mb)
+                    return (loss_sum + l,
+                            jax.tree.map(
+                                lambda a, x: a + x.astype(jnp.float32),
+                                grad_sum, g),
+                            (s_sum + s, c_sum + c)), None
+            else:
+                def body(carry, mb):
+                    loss_sum, grad_sum, aux = carry
+                    l, g = jax.value_and_grad(micro_loss)(state.params, mb)
+                    return (loss_sum + l,
+                            jax.tree.map(
+                                lambda s, x: s + x.astype(jnp.float32),
+                                grad_sum, g),
+                            aux), None
 
             # Accumulate in f32 regardless of param_dtype — bf16 sums would
             # swallow small per-micro-batch contributions — then cast back.
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            (loss, grads), _ = jax.lax.scan(body, (0.0, zero_grads), micro)
+            zero_aux = (jnp.zeros((corpus_count,), jnp.float32),
+                        jnp.zeros((corpus_count,), jnp.float32))
+            (loss, grads, corpus_aux), _ = jax.lax.scan(
+                body, (0.0, zero_grads, zero_aux), micro)
+            if not attributed:
+                corpus_aux = None
             loss = loss / accum
             grads = jax.tree.map(
                 lambda g, p: (g / accum).astype(p.dtype),
                 grads, state.params)
-        return finish_step(state, loss, grads)
+        return finish_step(state, loss, grads, corpus_aux)
 
-    def finish_step(state: TrainState, loss, grads):
+    def finish_step(state: TrainState, loss, grads, corpus_aux=None):
         """Everything after the forward/backward: fault injection, clip,
         (possibly ZeRO-sharded) update, anomaly guard, metrics. Shared by
         the sequential and pipeline-staged paths."""
@@ -376,6 +457,12 @@ def make_train_step(config: Config, model, schedule: DiffusionSchedule,
         if new_guard is not None:
             metrics["anomalies"] = new_guard.anomalies.astype(jnp.float32)
             metrics["strikes"] = new_guard.strikes.astype(jnp.float32)
+        if corpus_aux is not None:
+            # (C,) per-corpus loss sums and sample counts; the trainer's
+            # host side divides at log time (mean of sums / mean of counts
+            # across a fused window reduces to the same ratio).
+            metrics["corpus_loss_sum"] = corpus_aux[0]
+            metrics["corpus_count"] = corpus_aux[1]
         # Per-layer-group numerics (obs/numerics.py): read-only reductions
         # over pre-update params, the gradient, and the post-update params
         # (guard-skipped steps read update_ratio 0). ALWAYS part of the

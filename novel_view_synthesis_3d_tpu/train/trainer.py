@@ -184,6 +184,7 @@ class Trainer:
         config: Optional[Config] = None,
         data_iter: Optional[Iterator[dict]] = None,
         use_grain: bool = True,
+        skip_batches: int = 0,
     ):
         if config is None:
             config = Config()
@@ -207,9 +208,31 @@ class Trainer:
         # --- data ---
         self._native_loader = None
         self._packed_loader = None
+        # skip_batches: mid-rung ladder resume (train/ladder.py) — the
+        # loader replays that many batches' PLANNING before yielding, so
+        # a resumed rung consumes the exact batches the uninterrupted
+        # run would have. Only the pipelined packed loader implements it.
+        if skip_batches and (data_iter is not None
+                             or config.data.backend != "packed"):
+            raise ValueError(
+                "skip_batches (ladder mid-rung resume) requires "
+                "data.backend='packed' with no injected data_iter — the "
+                "other backends have no deterministic plan stream to "
+                "fast-forward")
         if data_iter is not None:
             self.data_iter = data_iter
             self.dataset = None
+        elif config.data.mix:
+            # Corpus mixer (data/corpus.py): N packed corpora behind one
+            # FlatViewDataset-shaped surface; validate() already pinned
+            # backend='packed' for mixes.
+            from novel_view_synthesis_3d_tpu.data.corpus import (
+                make_mixed_dataset)
+
+            self.dataset = make_mixed_dataset(
+                config.data,
+                shard_index=jax.process_index(),
+                shard_count=jax.process_count())
         else:
             self.dataset = make_dataset(
                 config.data,
@@ -219,6 +242,7 @@ class Trainer:
                 # sharding happens at the index-sampler level).
                 shard_index=jax.process_index(),
                 shard_count=jax.process_count())
+        if self.dataset is not None:
             assert len(self.dataset) > 0
             local_bs = dist.local_batch_size(tcfg.batch_size)
             num_cond = config.model.num_cond_frames
@@ -240,17 +264,32 @@ class Trainer:
             if config.data.backend == "packed":
                 # Compute-overlapped pipelined loader (decode worker pool
                 # feeding the _DevicePrefetcher below); `loader`/use_grain
-                # govern the files backend only.
-                from novel_view_synthesis_3d_tpu.data.pipeline import (
-                    make_packed_loader)
+                # govern the files backend only. A data.mix runs the
+                # weighted mixer variant over the MixedDataset built above.
+                if config.data.mix:
+                    from novel_view_synthesis_3d_tpu.data.corpus import (
+                        make_mixed_loader)
 
-                self._packed_loader = make_packed_loader(
-                    self.dataset, local_bs,
-                    seed=config.data.shuffle_seed,
-                    shard_index=jax.process_index(),
-                    num_cond=num_cond,
-                    workers=config.data.num_workers,
-                    depth=config.data.prefetch)
+                    self._packed_loader = make_mixed_loader(
+                        self.dataset, local_bs,
+                        seed=config.data.shuffle_seed,
+                        shard_index=jax.process_index(),
+                        num_cond=num_cond,
+                        workers=config.data.num_workers,
+                        depth=config.data.prefetch,
+                        skip_batches=skip_batches)
+                else:
+                    from novel_view_synthesis_3d_tpu.data.pipeline import (
+                        make_packed_loader)
+
+                    self._packed_loader = make_packed_loader(
+                        self.dataset, local_bs,
+                        seed=config.data.shuffle_seed,
+                        shard_index=jax.process_index(),
+                        num_cond=num_cond,
+                        workers=config.data.num_workers,
+                        depth=config.data.prefetch,
+                        skip_batches=skip_batches)
                 self.data_iter = iter(self._packed_loader)
             elif backend == "native":
                 from novel_view_synthesis_3d_tpu.data import native_io
@@ -459,7 +498,14 @@ class Trainer:
         self._rollbacks = 0
         self._anomalies_seen = 0
         if tcfg.resume:
-            restored = self.ckpt.restore(self._ckpt_state())
+            # restore_with_growth (train/ladder.py): a checkpoint saved
+            # before model.num_classes grew the category table restores
+            # with the table's zero-init spliced in (asserted neutral);
+            # same-version checkpoints take the plain path inside.
+            from novel_view_synthesis_3d_tpu.train.ladder import (
+                restore_with_growth)
+
+            restored = restore_with_growth(self.ckpt, self._ckpt_state())
             if restored is not None:
                 restored = self._adopt_restored_state(restored)
                 # Restore provenance line: which step actually resumed, and
@@ -996,12 +1042,14 @@ class Trainer:
                 with self.tracer.span("d2h", step=step_now):
                     host_metrics = jax.device_get(step_metrics)
                 util = self._utilization_metrics()
+                corpus_cols = self._publish_corpus_stats(step_now,
+                                                         host_metrics)
                 logged = self.metrics.log(
                     step_now,
                     dict(host_metrics,
                          rollbacks=self._rollbacks,
                          restarts=self._restarts, **util),
-                    tcfg.batch_size)
+                    tcfg.batch_size, extra=corpus_cols)
                 # Overhead-exclusion contract (obs.profile): a log
                 # interval that overlapped a profile window carries the
                 # window's arm/parse host time in its wall clock, so its
@@ -1098,6 +1146,40 @@ class Trainer:
             print(f"step timing: {timing}")
 
     # -- telemetry helpers (obs/) --------------------------------------
+    def _publish_corpus_stats(self, step_now: int,
+                              host_metrics: dict) -> Optional[dict]:
+        """Per-corpus attribution at log time (data/corpus.py mixes).
+
+        Consumes the step's (C,) corpus_loss_sum/corpus_count aux (popped
+        so the scalar logger never sees array values) and joins it with
+        the MixedDataset's quarantine/decode stats and the MixedLoader's
+        draw counts: one telemetry.jsonl row per corpus via the bus, a
+        per-corpus loss gauge, and the `loss_<corpus>` extra columns for
+        metrics.csv. Returns None on unmixed runs."""
+        sums = host_metrics.pop("corpus_loss_sum", None)
+        counts = host_metrics.pop("corpus_count", None)
+        stats_fn = getattr(self.dataset, "corpus_stats", None)
+        if sums is None or stats_fn is None:
+            return None
+        draws = getattr(self._packed_loader, "corpus_draws", None)
+        cols: dict = {}
+        reg = self.telemetry.registry
+        for i, row in enumerate(stats_fn()):
+            name = row["corpus"]
+            n = float(counts[i])
+            mean_loss = float(sums[i]) / n if n else float("nan")
+            cols[f"loss_{name}"] = mean_loss
+            if not np.isnan(mean_loss):
+                reg.gauge(
+                    f"nvs3d_corpus_{name}_loss",
+                    f"last logged train loss attributed to corpus "
+                    f"{name!r}").set(mean_loss)
+            self.telemetry.bus.jsonl_row(dict(
+                row, kind="corpus_stats", step=step_now,
+                loss=mean_loss, samples=n,
+                draws=(int(draws[i]) if draws is not None else None)))
+        return cols
+
     def _health_snapshot(self) -> dict:
         """/healthz body (obs/server.py health provider): progress facts
         an external probe can alarm on — a wedged trainer keeps /metrics

@@ -1,0 +1,560 @@
+"""Corpus mixer + resolution ladder tests (data/corpus.py, train/ladder.py).
+
+The contract under test (ISSUE 20 acceptance):
+  - a ONE-corpus mix is BIT-identical to `backend='packed'` (the mixer
+    consumes the single sequential rng exactly like the plain loader);
+  - the two-corpus draw sequence is deterministic in the seed (stable
+    across restarts), weight-proportional, and skip_batches fast-forward
+    reproduces the uninterrupted stream's tail exactly;
+  - `nvs3d pack` records corpus metadata and `pack --verify` cross-checks
+    it; the mixer REFUSES a resolution-mismatched corpus loudly;
+  - scene-category conditioning is a numeric no-op at zero init, rides
+    the CFG cond-drop mask (uncond branch unchanged), and old
+    num_classes=0 checkpoints load into the grown tree with the zero
+    table spliced in (asserted neutral);
+  - a 64→128-style ladder run is bit-identical whether run straight
+    through or interrupted at a rung boundary AND mid-rung, and lands
+    per-corpus loss/quarantine rows in telemetry.jsonl + metrics.csv.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from novel_view_synthesis_3d_tpu.config import (
+    Config,
+    DataConfig,
+    DiffusionConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from novel_view_synthesis_3d_tpu.data import records
+from novel_view_synthesis_3d_tpu.data.corpus import (
+    check_corpus_resolution,
+    corpus_meta,
+    make_mixed_dataset,
+    make_mixed_loader,
+    parse_mix_spec,
+)
+from novel_view_synthesis_3d_tpu.data.pipeline import make_packed_loader
+from novel_view_synthesis_3d_tpu.data.synthetic import write_synthetic_srn
+from novel_view_synthesis_3d_tpu.train import ladder
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def corpus_a(tmp_path_factory):
+    src = tmp_path_factory.mktemp("srn_a")
+    write_synthetic_srn(str(src), num_instances=4, views_per_instance=6,
+                        image_size=32)
+    out = tmp_path_factory.mktemp("packed_a")
+    records.pack_srn(str(src), str(out), shard_mb=0.001)
+    return str(out)
+
+
+@pytest.fixture(scope="module")
+def corpus_b(tmp_path_factory):
+    src = tmp_path_factory.mktemp("srn_b")
+    write_synthetic_srn(str(src), num_instances=3, views_per_instance=4,
+                        image_size=32)
+    out = tmp_path_factory.mktemp("packed_b")
+    records.pack_srn(str(src), str(out), shard_mb=0.001)
+    return str(out)
+
+
+def _mix_data_config(pa, pb=None, *, weights=(3, 1), sidelength=16):
+    if pb is None:
+        mix = f"a:{weights[0]}:{pa}"
+    else:
+        mix = f"a:{weights[0]}:{pa},b:{weights[1]}:{pb}"
+    return DataConfig(root_dir=pa, backend="packed",
+                      img_sidelength=sidelength, mix=mix)
+
+
+def _collect(loader, n):
+    try:
+        return [next(loader) for _ in range(n)]
+    finally:
+        loader.stop()
+
+
+# ---------------------------------------------------------------------------
+# Mix spec + resolution guard
+# ---------------------------------------------------------------------------
+def test_parse_mix_spec_loud_errors():
+    specs = parse_mix_spec("cars:3:/data/cars,chairs:1:/data/chairs")
+    assert [s.name for s in specs] == ["cars", "chairs"]
+    assert [s.weight for s in specs] == [3.0, 1.0]
+    with pytest.raises(ValueError, match="name:weight:path"):
+        parse_mix_spec("cars:3")
+    with pytest.raises(ValueError, match="twice"):
+        parse_mix_spec("cars:3:/a,cars:1:/b")
+    with pytest.raises(ValueError, match="> 0"):
+        parse_mix_spec("cars:0:/a")
+
+
+def test_resolution_mismatched_corpus_refused(corpus_a):
+    # 32px-native synthetic corpus: honest at 16/32, refused at 64.
+    check_corpus_resolution("a", corpus_a, 16)
+    check_corpus_resolution("a", corpus_a, 32)
+    with pytest.raises(ValueError, match="native resolution 32"):
+        check_corpus_resolution("a", corpus_a, 64)
+    with pytest.raises(ValueError) as exc:
+        make_mixed_dataset(_mix_data_config(corpus_a, sidelength=64))
+    assert "'a'" in str(exc.value) and "UPSAMPLE" in str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# One-corpus mix == plain packed loader (bit-identity)
+# ---------------------------------------------------------------------------
+def test_one_corpus_mix_bit_identical_to_packed(corpus_a):
+    mds = make_mixed_dataset(_mix_data_config(corpus_a))
+    mixed = make_mixed_loader(mds, 4, seed=7, workers=2, depth=2)
+    plain = make_packed_loader(
+        records.PackedDataset(corpus_a, img_sidelength=16), 4, seed=7,
+        workers=2, depth=2)
+    got = _collect(mixed, 10)
+    want = _collect(plain, 10)
+    for i, (bm, bp) in enumerate(zip(got, want)):
+        # The mixer's extra fields, and nothing else, on top of the
+        # plain packed batch — bitwise.
+        assert set(bm) == set(bp) | {"corpus_id", "category"}
+        for k in bp:
+            np.testing.assert_array_equal(bm[k], bp[k],
+                                          err_msg=f"batch {i} key {k}")
+        assert bm["corpus_id"].dtype == np.int32
+        assert not bm["corpus_id"].any() and not bm["category"].any()
+
+
+# ---------------------------------------------------------------------------
+# Two-corpus mix: determinism, weighting, skip_batches fast-forward
+# ---------------------------------------------------------------------------
+def test_two_corpus_mix_deterministic_and_weighted(corpus_a, corpus_b):
+    def run():
+        mds = make_mixed_dataset(_mix_data_config(corpus_a, corpus_b))
+        loader = make_mixed_loader(mds, 8, seed=3, workers=2, depth=2)
+        batches = _collect(loader, 10)
+        return mds, loader, batches
+
+    mds1, ld1, run1 = run()
+    mds2, _, run2 = run()
+    # Restart determinism: the draw sequence (corpus choice included) is
+    # a pure function of the seed.
+    for i, (b1, b2) in enumerate(zip(run1, run2)):
+        for k in b1:
+            np.testing.assert_array_equal(b1[k], b2[k],
+                                          err_msg=f"batch {i} key {k}")
+    ids = np.concatenate([b["corpus_id"] for b in run1])
+    cats = np.concatenate([b["category"] for b in run1])
+    np.testing.assert_array_equal(ids, cats)  # category defaults to corpus
+    assert set(np.unique(ids)) == {0, 1}  # both corpora drawn
+    # 3:1 weights → corpus a dominates the draws (the counter includes
+    # the pipelined loader's planned-ahead batches, so >= the consumed 80).
+    assert sum(ld1.corpus_draws) >= 80
+    assert ld1.corpus_draws[0] > ld1.corpus_draws[1]
+    # Per-corpus stats rows: identity + quarantine health, per corpus.
+    stats = mds1.corpus_stats()
+    assert [r["corpus"] for r in stats] == ["a", "b"]
+    assert [r["records"] for r in stats] == [24, 12]
+    assert stats[0]["weight"] == pytest.approx(0.75)
+    assert all(r["quarantined"] == 0 and r["decode_errors"] == 0
+               for r in stats)
+
+
+def test_mixed_loader_skip_batches_bit_identity(corpus_a, corpus_b):
+    full = _collect(make_mixed_loader(
+        make_mixed_dataset(_mix_data_config(corpus_a, corpus_b)),
+        4, seed=11, workers=2, depth=2), 10)
+    tail = _collect(make_mixed_loader(
+        make_mixed_dataset(_mix_data_config(corpus_a, corpus_b)),
+        4, seed=11, workers=2, depth=2, skip_batches=4), 6)
+    for i, (bf, bt) in enumerate(zip(full[4:], tail)):
+        for k in bf:
+            np.testing.assert_array_equal(
+                bf[k], bt[k], err_msg=f"batch {4 + i} key {k}")
+
+
+# ---------------------------------------------------------------------------
+# nvs3d pack: corpus metadata + --verify cross-check
+# ---------------------------------------------------------------------------
+def test_pack_meta_and_verify_crosscheck(tmp_path, capsys):
+    from novel_view_synthesis_3d_tpu.cli import main
+
+    src = tmp_path / "srn"
+    write_synthetic_srn(str(src), num_instances=4, views_per_instance=6,
+                        image_size=32)
+    out = str(tmp_path / "corpus")
+    rc = main(["pack", str(src), "--out", out, "--shard-mb", "0.002",
+               "--verify", "--name", "cars", "--class", "car",
+               "--class", "suv"])
+    assert rc == 0
+    capsys.readouterr()
+    meta = corpus_meta(out)
+    assert meta == {"name": "cars", "resolution": 32, "num_scenes": 4,
+                    "num_views": 24, "classes": ["car", "suv"]}
+    # A stale/tampered meta block must fail verify (the mixer's
+    # resolution guard trusts it).
+    index_path = os.path.join(out, records.INDEX_NAME)
+    with open(index_path) as fh:
+        index = json.load(fh)
+    index["meta"]["num_scenes"] = 99
+    index["meta"]["resolution"] = 64
+    with open(index_path, "w") as fh:
+        json.dump(index, fh)
+    problems = " ".join(records.verify_packed(out))
+    assert "meta.num_scenes=99" in problems
+    assert "meta.resolution=64" in problems
+    assert main(["pack", out, "--verify"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Config validation + ladder schedule parsing (loud at startup)
+# ---------------------------------------------------------------------------
+def _base_cfg(**over):
+    cfg = Config(
+        model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
+                          attn_resolutions=(), dropout=0.0),
+        diffusion=DiffusionConfig(timesteps=8, sample_timesteps=4),
+        data=DataConfig(backend="packed", img_sidelength=16),
+        train=TrainConfig(batch_size=8),
+        mesh=MeshConfig(data=-1),
+    )
+    return cfg.override(**over) if over else cfg
+
+
+def test_config_mix_validation_is_loud():
+    with pytest.raises(ValueError, match="name:weight:path"):
+        _base_cfg(**{"data.mix": "cars:3"}).validate()
+    with pytest.raises(ValueError, match="twice"):
+        _base_cfg(**{"data.mix": "a:1:/x,a:2:/y"}).validate()
+    with pytest.raises(ValueError, match="must be a number > 0"):
+        _base_cfg(**{"data.mix": "a:zero:/x"}).validate()
+    with pytest.raises(ValueError, match="requires data.backend='packed'"):
+        _base_cfg(**{"data.mix": "a:1:/x",
+                     "data.backend": "files"}).validate()
+
+
+def test_config_ladder_validation_is_loud():
+    with pytest.raises(ValueError, match="resolution:steps"):
+        _base_cfg(**{"train.ladder": "64"}).validate()
+    with pytest.raises(ValueError, match="power of two"):
+        _base_cfg(**{"train.ladder": "48:100"}).validate()
+    with pytest.raises(ValueError, match="non-decreasing"):
+        _base_cfg(**{"train.ladder": "128:10,64:10"}).validate()
+    # attn_resolutions is keyed on ABSOLUTE feature-map resolution: with
+    # ch_mult=(1,1) and attn at 32px, a 64px rung attends at level 1 and
+    # a 128px rung nowhere — structurally incompatible param trees.
+    with pytest.raises(ValueError, match="different UNet levels"):
+        _base_cfg(**{"train.ladder": "64:2,128:2",
+                     "model.ch_mult": (1, 1),
+                     "model.attn_resolutions": (32,)}).validate()
+
+
+def test_parse_ladder_schedule():
+    rungs = ladder.parse_ladder("64:20000,128:10000")
+    assert [(r.resolution, r.start_step, r.end_step) for r in rungs] == \
+        [(64, 0, 20000), (128, 20000, 30000)]
+    assert ladder.rung_of_step(rungs, 0).resolution == 64
+    assert ladder.rung_of_step(rungs, 19999).resolution == 64
+    assert ladder.rung_of_step(rungs, 20000).resolution == 128
+    assert ladder.rung_of_step(rungs, 99999).resolution == 128
+    cfg = _base_cfg(**{"train.ladder": "64:20000,128:10000"})
+    assert ladder.ladder_resolutions(cfg) == [64, 128]
+    assert ladder.ladder_resolutions(_base_cfg()) == [16]
+    rcfg = ladder.rung_config(cfg, rungs[1])
+    assert rcfg.data.img_sidelength == 128
+    assert rcfg.train.num_steps == 30000 and rcfg.train.ladder == ""
+
+
+def test_run_ladder_requires_resume():
+    cfg = _base_cfg(**{"train.ladder": "16:2", "train.resume": False})
+    with pytest.raises(ValueError, match="train.resume=true"):
+        ladder.run_ladder(cfg, use_grain=False)
+
+
+# ---------------------------------------------------------------------------
+# Scene-category conditioning: zero-init no-op + CFG cond-drop
+# ---------------------------------------------------------------------------
+def test_category_embedding_zero_init_and_cfg_cond_drop():
+    import flax
+    import jax
+    import jax.numpy as jnp
+
+    from novel_view_synthesis_3d_tpu.data.synthetic import make_example_batch
+    from novel_view_synthesis_3d_tpu.models.xunet import XUNet
+
+    raw = make_example_batch(batch_size=2, sidelength=8, seed=0)
+    base = {
+        "x": jnp.asarray(raw["x"]), "z": jnp.asarray(raw["target"]),
+        "logsnr": jnp.zeros((2,)),
+        "R1": jnp.asarray(raw["R1"]), "t1": jnp.asarray(raw["t1"]),
+        "R2": jnp.asarray(raw["R2"]), "t2": jnp.asarray(raw["t2"]),
+        "K": jnp.asarray(raw["K"]),
+    }
+    with_cat = dict(base, category=jnp.asarray([0, 1], jnp.int32))
+    model = XUNet(ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
+                              attn_resolutions=(), dropout=0.0,
+                              num_classes=3))
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)},
+                        base, cond_mask=jnp.ones((2,)),
+                        train=False)["params"]
+    params = flax.core.unfreeze(params)
+    table = np.asarray(params["ConditioningProcessor_0"]["category_emb"])
+    # The table exists even when the init batch has no category field
+    # (param tree is batch-independent) and is ZERO-init — the numeric
+    # no-op that makes growth checkpoint-compatible.
+    assert table.shape[0] == 3 and not table.any()
+
+    # Fresh-init XUNets are conditioning-insensitive (zero-init output
+    # convs) — perturb everything, then pin the table explicitly.
+    rng = np.random.default_rng(0)
+    params = jax.tree.map(
+        lambda a: np.asarray(a) + 0.05 * rng.standard_normal(
+            a.shape).astype(np.asarray(a).dtype), params)
+
+    def apply(batch, mask_val, table_val):
+        params["ConditioningProcessor_0"]["category_emb"] = \
+            np.full_like(table, table_val)
+        return np.asarray(model.apply(
+            {"params": params}, batch,
+            cond_mask=jnp.full((2,), mask_val), train=False))
+
+    # Zero table: categories condition on nothing — bit-identical to a
+    # category-free batch.
+    np.testing.assert_array_equal(apply(with_cat, 1.0, 0.0),
+                                  apply(base, 1.0, 0.0))
+    # Trained (non-zero) table: the conditioned branch sees the category…
+    assert np.abs(apply(with_cat, 1.0, 1.0)
+                  - apply(base, 1.0, 1.0)).max() > 0
+    # …but the CFG uncond branch (cond_mask=0) drops it with the pose
+    # conditioning: guidance's uncond forward is category-free.
+    np.testing.assert_array_equal(apply(with_cat, 0.0, 1.0),
+                                  apply(base, 0.0, 1.0))
+
+
+# ---------------------------------------------------------------------------
+# Versioned param-tree growth (restore_with_growth)
+# ---------------------------------------------------------------------------
+def _dict_paths(tree, prefix=()):
+    if isinstance(tree, dict):
+        out = []
+        for k, v in tree.items():
+            out += _dict_paths(v, prefix + (k,))
+        return out
+    return [prefix]
+
+
+class _FakeCkpt:
+    """Structure-strict restore: succeeds iff the template's dict paths
+    match the saved tree's (what Orbax enforces), returning the saved
+    values."""
+
+    def __init__(self, saved):
+        self.saved = saved
+
+    def restore(self, template, step=None):
+        if sorted(_dict_paths(template)) != sorted(_dict_paths(self.saved)):
+            raise ValueError("tree structure mismatch")
+        return self.saved
+
+
+def test_restore_with_growth_splices_zero_table():
+    saved = {"params": {"Dense_0": {"kernel": np.arange(4.0)}}}
+    template = {"params": {"Dense_0": {"kernel": np.zeros(4)},
+                           "category_emb": np.zeros((2, 8))}}
+    out = ladder.restore_with_growth(_FakeCkpt(saved), template)
+    np.testing.assert_array_equal(out["params"]["Dense_0"]["kernel"],
+                                  np.arange(4.0))
+    np.testing.assert_array_equal(out["params"]["category_emb"],
+                                  np.zeros((2, 8)))
+    # Same-version template: the plain restore path, untouched.
+    out2 = ladder.restore_with_growth(_FakeCkpt(saved),
+                                      {"params": {"Dense_0":
+                                                  {"kernel": np.zeros(4)}}})
+    assert out2 is _FakeCkpt(saved).saved or out2 == saved
+
+
+def test_restore_with_growth_refuses_nonzero_template():
+    saved = {"params": {"Dense_0": {"kernel": np.arange(4.0)}}}
+    template = {"params": {"Dense_0": {"kernel": np.zeros(4)},
+                           "category_emb": np.ones((2, 8))}}
+    with pytest.raises(RuntimeError, match="not zero-init"):
+        ladder.restore_with_growth(_FakeCkpt(saved), template)
+    # A mismatch NOT explained by growth re-raises the original error.
+    with pytest.raises(ValueError, match="structure mismatch"):
+        ladder.restore_with_growth(
+            _FakeCkpt(saved), {"params": {"Other": {"w": np.zeros(1)}}})
+
+
+# ---------------------------------------------------------------------------
+# Promotion gate: per-corpus × per-resolution PSNR matrix
+# ---------------------------------------------------------------------------
+def test_gate_matrix_scores_every_cell(tmp_path):
+    from novel_view_synthesis_3d_tpu.registry import RegistryStore
+    from novel_view_synthesis_3d_tpu.registry.gate import run_gate_matrix
+
+    store = RegistryStore(str(tmp_path))
+
+    def tree(scale):
+        return {"w": np.full((2, 2), scale, np.float32)}
+
+    inc = store.publish_params(tree(1.0), step=10, ema=False)
+    cand = store.publish_params(tree(2.0), step=20, ema=False)
+    store.set_channel("stable", inc.version)
+
+    # Synthetic probes keyed on the published payloads: candidate wins
+    # everywhere except chairs@128, which regresses past any margin.
+    scores = {("cars", 64): (30.0, 29.0), ("cars", 128): (28.0, 27.5),
+              ("chairs", 64): (31.0, 30.0), ("chairs", 128): (20.0, 27.0)}
+
+    def probe(corpus, res):
+        def fn(params):
+            c, i = scores[(corpus, res)]
+            return c if float(params["w"][0, 0]) == 2.0 else i
+        return fn
+
+    cells = [{"corpus": c, "resolution": r, "metric": "psnr",
+              "probe_fn": probe(c, r)}
+             for c in ("cars", "chairs") for r in (64, 128)]
+    events = []
+    result = run_gate_matrix(
+        store, cand.version, channel="stable", cells=cells,
+        margin_db=0.5,
+        event_cb=lambda step, kind, detail, vid: events.append(
+            (kind, detail)))
+    # One regressed cell fails the WHOLE matrix, and the audit event
+    # names it.
+    assert not result.passed
+    rows = {(r["corpus"], r["resolution"]): r for r in result.cells}
+    assert len(rows) == 4
+    assert rows[("cars", 64)]["passed"]
+    bad = rows[("chairs", 128)]
+    assert not bad["passed"] and bad["delta_db"] == pytest.approx(-7.0)
+    assert events[0][0] == "gate_fail" and "chairs@128px" in events[0][1]
+
+    # No incumbent on the channel → bootstrap rule: absolute scores only,
+    # every cell passes, incumbent rendered as None.
+    store2 = RegistryStore(str(tmp_path / "fresh"))
+    cand2 = store2.publish_params(tree(2.0), step=20, ema=False)
+    boot = run_gate_matrix(store2, cand2.version, channel="stable",
+                           cells=cells, margin_db=0.5)
+    assert boot.passed and all(r["incumbent_psnr"] is None
+                               for r in boot.cells)
+
+
+# ---------------------------------------------------------------------------
+# Train e2e: growth compat + ladder bit-exact resume + per-corpus telemetry
+# ---------------------------------------------------------------------------
+def _train_cfg(tmp, pa, pb=None, **over):
+    data_kw = dict(root_dir=pa, backend="packed", img_sidelength=16,
+                   num_workers=2, prefetch=2)
+    if pb is not None:
+        data_kw["mix"] = f"a:3:{pa},b:1:{pb}"
+    cfg = Config(
+        model=ModelConfig(ch=32, ch_mult=(1,), num_res_blocks=1,
+                          attn_resolutions=(), dropout=0.0),
+        diffusion=DiffusionConfig(timesteps=8, sample_timesteps=4),
+        data=DataConfig(**data_kw),
+        train=TrainConfig(batch_size=8, lr=1e-3, num_steps=2,
+                          save_every=0, log_every=1, seed=0, resume=True,
+                          checkpoint_dir=os.path.join(str(tmp), "ckpt"),
+                          results_folder=os.path.join(str(tmp), "results")),
+        mesh=MeshConfig(data=-1),
+    )
+    return cfg.override(**over).validate() if over else cfg.validate()
+
+
+def test_old_checkpoint_loads_into_grown_model(tmp_path, corpus_a, capsys):
+    import jax
+
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+    cfg = _train_cfg(tmp_path, corpus_a)
+    t1 = Trainer(config=cfg, use_grain=False)
+    t1.train()
+    t1.ckpt.wait()
+    saved = jax.device_get(t1.state.params)
+    t1.ckpt.close()
+    capsys.readouterr()
+
+    # Same checkpoint, grown model: the num_classes=0 checkpoint restores
+    # with the fresh zero table spliced in — loudly, and numerically a
+    # no-op on every pre-existing leaf.
+    t2 = Trainer(config=cfg.override(**{"model.num_classes": 2}),
+                 use_grain=False)
+    assert "predates param-tree growth" in capsys.readouterr().out
+    assert t2.step == 2
+    grown = jax.device_get(t2.state.params)
+    table = np.asarray(grown["ConditioningProcessor_0"]["category_emb"])
+    assert table.shape[0] == 2 and not table.any()
+    stripped = ladder._strip_grown(grown, {})
+    for a, b in zip(jax.tree.leaves(stripped), jax.tree.leaves(saved),
+                    strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    t2.ckpt.close()
+
+
+def test_ladder_resume_bit_identical_and_corpus_telemetry(
+        tmp_path, corpus_a, corpus_b):
+    import jax
+
+    from novel_view_synthesis_3d_tpu.train.trainer import Trainer
+
+    over = {"train.ladder": "8:2,16:3", "train.num_steps": 5,
+            "model.num_classes": 2}
+
+    # Run A: the whole ladder, uninterrupted.
+    cfg_a = _train_cfg(tmp_path / "A", corpus_a, corpus_b, **over)
+    t_a = ladder.run_ladder(cfg_a, use_grain=False)
+    assert t_a is not None and t_a.step == 5
+    params_a = jax.device_get(t_a.state.params)
+
+    # Run B: killed at the rung boundary (rung 1 only), relaunched and
+    # killed again MID-rung-2 (emulated by a shorter num_steps — lr is
+    # constant, so the truncated run's math matches the full run's
+    # prefix), then relaunched to finish. Same checkpoint_dir
+    # throughout; rung selection + fast-forward derive from the restored
+    # step alone.
+    cfg_b = _train_cfg(tmp_path / "B", corpus_a, corpus_b, **over)
+    t = ladder.run_ladder(
+        cfg_b.override(**{"train.ladder": "8:2"}), use_grain=False)
+    assert t is not None and t.step == 2
+    rungs = ladder.parse_ladder("8:2,16:3")
+    part_cfg = ladder.rung_config(cfg_b, rungs[1]).override(
+        **{"train.num_steps": 4})
+    t_part = Trainer(config=part_cfg, use_grain=False)
+    t_part.train()
+    assert t_part.step == 4
+    t_part.ckpt.wait()
+    t_part.ckpt.close()
+    t_b = ladder.run_ladder(cfg_b, use_grain=False)
+    assert t_b is not None and t_b.step == 5
+    params_b = jax.device_get(t_b.state.params)
+
+    for a, b in zip(jax.tree.leaves(params_a), jax.tree.leaves(params_b),
+                    strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Per-corpus attribution landed: one corpus_stats row per corpus per
+    # log with a finite attributed loss, and metrics.csv carries the
+    # loss_<corpus> columns.
+    rows = []
+    with open(os.path.join(str(tmp_path / "A"), "results",
+                           "telemetry.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") == "corpus_stats":
+                rows.append(rec)
+    assert {r["corpus"] for r in rows} == {"a", "b"}
+    assert all(r["quarantined"] == 0 for r in rows)
+    assert any(np.isfinite(r["loss"]) and r["samples"] > 0 for r in rows)
+    assert all(r["draws"] is not None for r in rows)
+    with open(os.path.join(str(tmp_path / "A"), "results",
+                           "metrics.csv")) as fh:
+        header = fh.readline().strip().split(",")
+    assert "loss_a" in header and "loss_b" in header

@@ -476,6 +476,58 @@ def test_memory_and_bubble_gauges_route_through_bus():
                for e in emitters)
 
 
+def test_corpus_mixer_writers_route_through_bus():
+    """The corpus-mixer / ladder telemetry (PR 20: per-corpus
+    quarantine/decode-error gauges, per-corpus loss gauges, and the
+    `corpus_stats` telemetry.jsonl rows) is a NEW writer surface — every
+    module outside obs/ that names the corpus_stats kind or an
+    nvs3d_corpus_* gauge must route through obs (get_registry gauges /
+    the bus jsonl sink): no `import csv`, no private telemetry path (the
+    walk above already bans the file literals)."""
+    import novel_view_synthesis_3d_tpu as pkg
+
+    pkg_root = os.path.dirname(os.path.abspath(pkg.__file__))
+    emitters = []
+    for root, _, files in os.walk(pkg_root):
+        if os.path.basename(root) == "obs":
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+            names_corpus = imports_csv = False
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and (node.value == "corpus_stats"
+                             or node.value.startswith("nvs3d_corpus_"))):
+                    names_corpus = True
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    mod = getattr(node, "module", None) or ""
+                    if "csv" in [a.name for a in node.names] \
+                            or mod == "csv":
+                        imports_csv = True
+            if names_corpus:
+                rel = os.path.relpath(path, pkg_root)
+                emitters.append(rel)
+                assert not imports_csv, (
+                    f"{rel} names corpus telemetry AND imports csv — "
+                    "telemetry writes belong to obs.bus only")
+                assert "obs" in src or "telemetry" in src, (
+                    f"{rel} names corpus telemetry but has no bus-routed "
+                    "path")
+    # The writer surfaces this PR promises actually exist: the mixer
+    # (quarantine/decode gauges) and the trainer (corpus_stats rows +
+    # per-corpus loss gauges).
+    assert any(e.endswith(os.path.join("data", "corpus.py"))
+               for e in emitters)
+    assert any(e.endswith(os.path.join("train", "trainer.py"))
+               for e in emitters)
+
+
 def test_reqtrace_slo_writer_surfaces_route_through_bus():
     """The request-trace spans (request_submit/request_respond), the
     SLO breach events + nvs3d_slo_* gauges, and the flight-dump path

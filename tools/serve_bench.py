@@ -228,6 +228,116 @@ def mixed_size_sweep(service, conds, buckets) -> dict:
     }
 
 
+def mixed_res_bench(args) -> dict:
+    """Judged mixed-resolution serving scenario: the resolution ladder's
+    serving counterpart (train.ladder trains ONE param tree across
+    rungs; the fleet then serves BOTH rung resolutions side by side).
+
+    One fully-convolutional param tree, one SamplingService PER
+    resolution (the sampler program is shape-specialised on H/W, so each
+    resolution owns its bucket family). Every service's buckets are
+    warmed, then one interleaved mixed-resolution trace is replayed
+    through the warm services CONCURRENTLY — the assert is that warm
+    mixed traffic never compiles a new sampler program in ANY lane
+    (compile-counter deltas zero per resolution; rc=1 + compile-ledger
+    culprit on violation)."""
+    from novel_view_synthesis_3d_tpu.config import ServeConfig
+    from novel_view_synthesis_3d_tpu.sample.service import SamplingService
+
+    sidelengths = sorted({int(s) for s in args.mr_sidelengths.split(",")
+                          if s.strip()})
+    if len(sidelengths) < 2:
+        raise SystemExit("--mr-sidelengths must name >= 2 distinct "
+                         f"resolutions (got {args.mr_sidelengths!r})")
+    # Attention OFF: attn_resolutions is keyed on absolute feature-map
+    # resolution, so attention would land at DIFFERENT UNet levels per
+    # rung and the param trees would diverge — the same constraint
+    # Config.validate enforces on train.ladder itself.
+    overrides = [("model.num_res_blocks", 1),
+                 ("model.attn_resolutions", [])]
+    # ONE param tree serves every rung: the XUNet is fully convolutional
+    # (param shapes are resolution-independent), so the params built at
+    # the smallest rung ARE the ladder-trained deployment's params.
+    _, model, params, _ = build(args.preset, sidelengths[0],
+                                args.mr_steps, extra_overrides=overrides)
+    buckets = [1]
+    while buckets[-1] * 2 <= args.mr_max_batch:
+        buckets.append(buckets[-1] * 2)
+    results_folder = "/tmp/nvs3d_serve_bench_mixed_res"
+    lanes = {}
+    services = {}
+    try:
+        for sl in sidelengths:
+            rcfg, _, _, conds_r = build(args.preset, sl, args.mr_steps,
+                                        extra_overrides=overrides)
+            scfg = ServeConfig(
+                scheduler="step", max_batch=args.mr_max_batch,
+                flush_timeout_ms=args.flush_timeout_ms,
+                queue_depth=max(64, 2 * args.mr_requests),
+                results_folder=results_folder)
+            svc = SamplingService(model, params, rcfg.diffusion, scfg)
+            services[sl] = svc
+            warm_service(svc, conds_r, buckets)
+            lanes[sl] = {"conds": conds_r,
+                         "warm": svc.compile_counters()}
+        # Interleaved mixed replay: a seeded shuffle of the resolution
+        # sequence, all tickets in flight together so both lanes form
+        # dynamic (padded) groups under concurrent pressure.
+        rng = np.random.default_rng(args.mr_seed)
+        order = [sidelengths[i % len(sidelengths)]
+                 for i in range(args.mr_requests)]
+        rng.shuffle(order)
+        t0 = time.perf_counter()
+        tickets = []
+        for i, sl in enumerate(order):
+            conds_r = lanes[sl]["conds"]
+            tickets.append(services[sl].submit(
+                conds_r[i % len(conds_r)], seed=90_000 + i))
+        for t in tickets:
+            t.result(timeout=600)
+        elapsed = time.perf_counter() - t0
+        per_res = {}
+        for sl in sidelengths:
+            after = services[sl].compile_counters()
+            warm = lanes[sl]["warm"]
+            per_res[str(sl)] = {
+                "requests": sum(1 for o in order if o == sl),
+                "programs_built_delta": after["programs_built"]
+                - warm["programs_built"],
+                "jit_cache_entries_delta": after["jit_cache_entries"]
+                - warm["jit_cache_entries"],
+                "programs_built_total": after["programs_built"],
+            }
+        return {
+            "sidelengths": sidelengths,
+            "requests": len(order),
+            "sample_steps": args.mr_steps,
+            "rps": round(len(order) / max(elapsed, 1e-9), 3),
+            "buckets": buckets,
+            "results_folder": results_folder,
+            "per_resolution": per_res,
+        }
+    finally:
+        for svc in services.values():
+            svc.stop()
+
+
+def check_mixed_res(mr: dict) -> int:
+    """rc for --mixed-res: zero warm recompiles in EVERY resolution
+    lane, or rc=1 with the compile-ledger culprit."""
+    bad = {sl: d for sl, d in mr["per_resolution"].items()
+           if d["programs_built_delta"] or d["jit_cache_entries_delta"]}
+    if bad:
+        print("error: warm mixed-resolution traffic compiled new "
+              f"sampler program(s) ({bad}) — each resolution's bucket "
+              "family must be fully warmed before mixed traffic, and "
+              "warm traffic must never recompile", file=sys.stderr)
+        print_recompile_culprit(mr.get("results_folder",
+                                       "/tmp/nvs3d_serve_bench"))
+        return 1
+    return 0
+
+
 def _p99(latencies) -> float:
     if not latencies:
         return 0.0
@@ -3042,6 +3152,28 @@ def main() -> int:
                     help="frames per --reqtrace orbit")
     ap.add_argument("--rt-k-max", type=int, default=4,
                     help="frame-bank capacity for --reqtrace")
+    ap.add_argument("--mixed-res", action="store_true",
+                    help="judged mixed-resolution serving scenario (the "
+                         "train.ladder serving counterpart): ONE fully-"
+                         "convolutional param tree served at every rung "
+                         "resolution side by side — each resolution's "
+                         "bucket family is warmed, then one interleaved "
+                         "mixed-resolution trace replays through the "
+                         "warm services, asserting zero new sampler "
+                         "compilations in every lane (rc=1 + compile-"
+                         "ledger culprit on violation)")
+    ap.add_argument("--mr-sidelengths", default="64,128",
+                    help="comma list of >= 2 rung resolutions to serve "
+                         "concurrently (default: the canonical 64,128 "
+                         "ladder; use smaller values on CPU smoke runs)")
+    ap.add_argument("--mr-requests", type=int, default=24,
+                    help="interleaved mixed-resolution trace length")
+    ap.add_argument("--mr-steps", type=int, default=4,
+                    help="denoise steps per request for --mixed-res")
+    ap.add_argument("--mr-max-batch", type=int, default=4,
+                    help="ring capacity per resolution lane")
+    ap.add_argument("--mr-seed", type=int, default=0,
+                    help="shuffle seed for the interleaved trace")
     ap.add_argument("--precision", default=None,
                     choices=("float32", "bfloat16", "int8"),
                     help="serve.precision for the classic bench path")
@@ -3059,6 +3191,21 @@ def main() -> int:
 
     from novel_view_synthesis_3d_tpu.config import ServeConfig
     from novel_view_synthesis_3d_tpu.sample.service import SamplingService
+
+    if args.mixed_res:
+        # Its own per-resolution builds happen inside (one service per
+        # rung resolution over one shared param tree).
+        mr = mixed_res_bench(args)
+        result = {
+            "metric": f"serve_mixed_res_rps_{args.preset}",
+            "value": mr["rps"],
+            "unit": "req/s",
+            "sidelengths": mr["sidelengths"],
+            "mixed_res": mr,
+            "platform": jax.default_backend(),
+        }
+        print(json.dumps(result))
+        return check_mixed_res(mr)
 
     if args.fleet:
         # Its own light-backbone build happens inside (the parent only

@@ -561,6 +561,160 @@ def chaos_lines(rows):
     return lines
 
 
+def mixed_res_lines(rows):
+    """Per-resolution tables for serve_bench --mixed-res artifacts: the
+    ladder's serving counterpart (one param tree, one service per rung
+    resolution) with each lane's warm compile-counter deltas — the
+    zero-recompile contract, per resolution."""
+    lines = []
+    for name, d in rows:
+        mr = d.get("mixed_res")
+        if not isinstance(mr, dict):
+            continue
+        lines += ["", f"## Mixed-resolution serving — {name}", ""]
+        lines.append(
+            f"- {mr.get('requests')} interleaved requests across "
+            f"{mr.get('sidelengths')} px at {mr.get('sample_steps')} "
+            f"step(s), buckets {mr.get('buckets')}: "
+            f"{fmt(mr.get('rps', 0.0))} req/s")
+        lines += ["",
+                  "| resolution | requests | built Δ | jit Δ | "
+                  "programs |", "|---|---|---|---|---|"]
+        violated = []
+        for res, lane in sorted(mr.get("per_resolution", {}).items(),
+                                key=lambda kv: int(kv[0])):
+            lines.append("| {}px | {} | {} | {} | {} |".format(
+                res, lane.get("requests"),
+                lane.get("programs_built_delta"),
+                lane.get("jit_cache_entries_delta"),
+                lane.get("programs_built_total")))
+            if (lane.get("programs_built_delta")
+                    or lane.get("jit_cache_entries_delta")):
+                violated.append(res)
+        lines.append("")
+        if violated:
+            lines.append("- **VIOLATION: warm mixed traffic recompiled "
+                         f"in lane(s) {violated}px**")
+        else:
+            lines.append("- zero warm recompiles in every resolution "
+                         "lane (contract held)")
+    return lines
+
+
+def gate_matrix_lines(search_dirs):
+    """The promotion gate's corpus × resolution eval matrix
+    (gate_matrix.json, written by `nvs3d registry promote` when the run
+    trains a corpus mix or a resolution ladder): candidate vs incumbent
+    PSNR per cell against the margin. Rounds without the artifact are
+    named as skipped — 'no matrix' must read as 'the gate never probed a
+    matrix', never as 'all cells passed'."""
+    import glob
+
+    lines = ["", "## Gate eval matrix (corpus × resolution, from "
+                 "gate_matrix.json)", ""]
+    found = []
+    seen = set()
+    for d in search_dirs:
+        for path in sorted(glob.glob(
+                os.path.join(d, "**", "gate_matrix.json"),
+                recursive=True)):
+            if path in seen:
+                continue
+            seen.add(path)
+            try:
+                with open(path) as fh:
+                    doc = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                lines.append(f"- `{path}`: SKIPPED (malformed)")
+                continue
+            found.append((path, doc))
+    if not found:
+        lines.append("- none recorded — SKIPPED: no gate_matrix.json "
+                     "under the scanned dirs (flat single-corpus run, or "
+                     "the registry gate never ran)")
+        return lines
+    for path, doc in found:
+        lines.append(
+            f"- `{path}`: candidate {doc.get('candidate')} vs incumbent "
+            f"{doc.get('incumbent')}, margin {doc.get('margin_db')} dB — "
+            + ("**PASSED**" if doc.get("passed") else "**FAILED**"))
+        lines += ["",
+                  "| corpus | resolution | candidate (dB) | incumbent "
+                  "(dB) | Δ (dB) | verdict |",
+                  "|---|---|---|---|---|---|"]
+        for cell in doc.get("cells", []):
+            lines.append(
+                "| {} | {}px | {} | {} | {} | {} |".format(
+                    cell.get("corpus"), cell.get("resolution"),
+                    fmt(cell.get("candidate_psnr", 0.0)),
+                    fmt(cell.get("incumbent_psnr"))
+                    if cell.get("incumbent_psnr") is not None else "—",
+                    fmt(cell.get("delta_db", 0.0)),
+                    "pass" if cell.get("passed")
+                    else f"FAIL ({cell.get('reason')})"))
+        lines.append("")
+    return lines
+
+
+def corpus_lines(search_dirs):
+    """Per-corpus health + loss attribution from telemetry.jsonl
+    `corpus_stats` rows (the mixer publishes one row per corpus per log
+    interval): last-seen records/quarantine/decode-error counters next
+    to the per-corpus training loss. Single-corpus runs are skipped
+    LOUDLY, not silently."""
+    import glob
+
+    lines = ["", "## Corpus mix (per-corpus quarantine / loss, from "
+                 "telemetry.jsonl corpus_stats rows)", ""]
+    found = []
+    for d in search_dirs:
+        for path in sorted(glob.glob(
+                os.path.join(d, "**", "telemetry.jsonl"),
+                recursive=True)):
+            last = {}   # corpus -> latest corpus_stats row
+            steps = 0
+            try:
+                with open(path) as fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue  # torn tail line
+                        if rec.get("kind") != "corpus_stats":
+                            continue
+                        steps = max(steps, int(rec.get("step") or 0))
+                        last[rec.get("corpus", "?")] = rec
+            except OSError:
+                continue
+            if last:
+                found.append((path, steps, last))
+    if not found:
+        lines.append("- none recorded — SKIPPED: no corpus_stats rows in "
+                     "any scanned telemetry.jsonl (single-corpus run, or "
+                     "a pre-mixer round)")
+        return lines
+    for path, steps, last in found:
+        lines.append(f"- `{path}` (through step {steps}):")
+        lines += ["",
+                  "| corpus | weight | records | quarantined | decode "
+                  "errs | draws | loss | samples |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for name, rec in sorted(last.items()):
+            loss = rec.get("loss")
+            lines.append(
+                "| {} | {} | {} | {} | {} | {} | {} | {} |".format(
+                    name, fmt(rec.get("weight", 0.0)),
+                    rec.get("records"), rec.get("quarantined"),
+                    rec.get("decode_errors"),
+                    rec.get("draws") if rec.get("draws") is not None
+                    else "—",
+                    fmt(loss) if isinstance(loss, (int, float))
+                    and loss == loss else "—",
+                    fmt(rec.get("samples", 0.0))))
+        lines.append("")
+    return lines
+
+
 def numerics_lines(search_dirs):
     """Numerics-observatory digest per numerics.jsonl (obs/numerics.py):
     row/spike counts, the worst spike (group + z), and any anomaly
@@ -834,6 +988,8 @@ def main() -> int:
     lines += cond_cache_lines(rows)
     # Survivability drill tables for any --chaos artifacts.
     lines += chaos_lines(rows)
+    # Per-resolution zero-recompile lanes for --mixed-res artifacts.
+    lines += mixed_res_lines(rows)
     # The restored CPU-lane trajectory from the repo-root BENCH archives,
     # and the multichip dry-run contract trajectory next to it.
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -884,6 +1040,11 @@ def main() -> int:
     # costmap.json (or the copy embedded in a judged bench record).
     lines += numerics_lines([out_dir] + quality_dirs)
     lines += costmap_lines([out_dir] + quality_dirs, rows)
+    # Corpus mixer + ladder observability: per-corpus quarantine/loss
+    # tables from telemetry and the promotion gate's corpus × resolution
+    # eval matrix. Both are loud about absence.
+    lines += corpus_lines([out_dir] + quality_dirs)
+    lines += gate_matrix_lines([out_dir] + quality_dirs)
     # Performance observatory: ranked doctor findings + roofline
     # headroom for runs that captured continuous-profiler windows.
     lines += doctor_lines([out_dir] + quality_dirs, repo_root)
